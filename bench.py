@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Benchmark: ERNIE/BERT-base pretrain step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+MFU / the 0.35 MFU target from BASELINE.json. Runs on the real chip (does NOT
+override JAX_PLATFORMS).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" or "tpu" in str(dev).lower()
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    # BERT-base config; bf16 matmuls via default precision on TPU.
+    cfg = bert.BertConfig(num_layers=12, hidden_size=768, num_heads=12,
+                          ffn_size=3072, vocab_size=30522,
+                          hidden_dropout=0.1, attn_dropout=0.1)
+    batch, seq = (8, 512) if on_tpu else (2, 128)
+
+    main_prog, startup, feeds, loss = bert.build_pretrain_program(
+        cfg, batch, seq,
+        optimizer_factory=lambda: fluid.optimizer.Adam(1e-4))
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"),
+        "pos_ids": np.tile(np.arange(seq), (batch, 1)).astype("int64"),
+        "sent_ids": np.zeros((batch, seq), dtype="int64"),
+        "input_mask": np.ones((batch, seq), dtype="float32"),
+        "mlm_labels": rng.randint(0, cfg.vocab_size, (batch, seq, 1)).astype("int64"),
+    }
+
+    # warmup (compile)
+    exe.run(main_prog, feed=feed, fetch_list=[loss])
+    exe.run(main_prog, feed=feed, fetch_list=[loss])
+
+    iters = 10 if on_tpu else 3
+    t0 = time.time()
+    for _ in range(iters):
+        out = exe.run(main_prog, feed=feed, fetch_list=[loss])
+    # fetch forces sync
+    dt = (time.time() - t0) / iters
+
+    tokens_per_sec = batch * seq / dt
+    n_params = bert.param_count(cfg)
+    flops_per_token = 6 * n_params  # fwd+bwd dense estimate
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; CPU placeholder
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    print(json.dumps({
+        "metric": "ernie_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {"mfu": round(mfu, 4), "batch": batch, "seq_len": seq,
+                  "params": n_params, "step_ms": round(dt * 1e3, 2),
+                  "device": str(dev)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up re-design of the capabilities of PaddlePaddle Fluid (reference:
+/root/reference, see SURVEY.md) in the TPU idiom: programs trace to XLA,
+parallelism is GSPMD sharding over `jax.sharding.Mesh`, hot kernels are
+Pallas, collectives ride ICI.
+
+API shape follows fluid for migration friendliness::
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data("x", [784])
+    y = fluid.layers.fc(x, 10, act="softmax")
+    ...
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={...}, fetch_list=[loss])
+"""
+
+from . import initializer  # noqa: F401
+from . import ops  # registers all ops  # noqa: F401
+from . import layers  # noqa: F401
+from . import clip  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .core import (  # noqa: F401
+    Block,
+    BuildStrategy,
+    CompiledProgram,
+    CPUPlace,
+    CUDAPlace,
+    ExecutionStrategy,
+    Executor,
+    Operator,
+    Parameter,
+    Place,
+    Program,
+    Scope,
+    TPUPlace,
+    Variable,
+    append_backward,
+    calc_gradient,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    gradients,
+    in_dygraph_mode,
+    program_guard,
+    scope_guard,
+)
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def _late_imports():
+    """Attach subpackages that depend on the core being importable."""
+    from . import backward  # noqa: F401
+
+
+class backward:  # namespace parity: fluid.backward.append_backward
+    from .core.backward import append_backward, calc_gradient, gradients
+
+    append_backward = staticmethod(append_backward)
+    calc_gradient = staticmethod(calc_gradient)
+    gradients = staticmethod(gradients)

@@ -1,0 +1,18 @@
+"""Core framework: IR, registry, scope, executor, autodiff, compiler."""
+from .backward import append_backward, calc_gradient, gradients  # noqa: F401
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .executor import CPUPlace, CUDAPlace, Executor, Place, TPUPlace  # noqa: F401
+from .program import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+    in_dygraph_mode,
+    program_guard,
+)
+from .registry import get_op, has_op, register_op, registered_ops  # noqa: F401
+from .scope import Scope, global_scope, scope_guard  # noqa: F401

@@ -1,0 +1,98 @@
+"""append_backward / gradients — autodiff surface over the Program IR.
+
+Reference analog: ``python/paddle/fluid/backward.py`` (append_backward:558,
+calc_gradient:820, gradients:938). There, backward is a graph-rewrite pass
+emitting one grad-op per forward op with explicit accumulation ops; here the
+same contract (grad variables named ``<var>@GRAD`` appear in the block and can
+be consumed by optimizer ops) is met by inserting a single `autodiff`
+pseudo-op that the executor lowers into a reverse jax.vjp tape walk — XLA sees
+exactly the fused forward+backward graph a hand-written pass would produce.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .program import Parameter, Program, Variable, grad_var_name
+
+
+def _collect_params(program: Program, parameter_list, no_grad_set) -> List[str]:
+    if parameter_list is not None:
+        names = [p.name if isinstance(p, Variable) else p for p in parameter_list]
+    else:
+        names = [p.name for p in program.all_parameters() if p.trainable]
+    no_grad = {v.name if isinstance(v, Variable) else v for v in (no_grad_set or set())}
+    return [n for n in names if n not in no_grad]
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set=None,
+    callbacks=None,
+) -> List[Tuple[Variable, Variable]]:
+    """Create ``param@GRAD`` vars for every trainable parameter reachable from
+    `loss` and schedule the reverse pass. Returns [(param, grad_var)] like the
+    reference (backward.py:558)."""
+    block = loss.block
+    program = block.program
+    targets = _collect_params(program, parameter_list, no_grad_set)
+
+    grad_vars = []
+    for t in targets:
+        tv = block.var(t)
+        gv = block.create_var(
+            name=grad_var_name(t), shape=tv.shape, dtype=tv.dtype,
+            persistable=False, stop_gradient=True)
+        grad_vars.append((tv, gv))
+
+    loss_grad = block.create_var(
+        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype,
+        persistable=False, stop_gradient=True)
+
+    block.append_op(
+        type="autodiff",
+        inputs={"Loss": [loss.name]},
+        outputs={"Grads": [grad_var_name(t) for t in targets] + [loss_grad.name]},
+        attrs={"loss_name": loss.name, "targets": list(targets) + [loss.name]},
+    )
+    program._appended_backward = True
+    return grad_vars
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference backward.py:938 — grads of `targets` wrt arbitrary `inputs`."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("gradients() currently supports one target")
+    loss = targets[0]
+    block = loss.block
+    names = [v.name if isinstance(v, Variable) else v for v in inputs]
+    no_grad = {v.name if isinstance(v, Variable) else v for v in (no_grad_set or set())}
+    names = [n for n in names if n not in no_grad]
+
+    outs = []
+    for n in names:
+        v = block.var(n)
+        gv = block.create_var(name=grad_var_name(n), shape=v.shape, dtype=v.dtype,
+                              persistable=False, stop_gradient=True)
+        outs.append(gv)
+
+    attrs = {"loss_name": loss.name, "targets": names}
+    inputs_map = {"Loss": [loss.name]}
+    if target_gradients is not None:
+        tg = target_gradients[0] if isinstance(target_gradients, (list, tuple)) else target_gradients
+        attrs["init_grad_name"] = tg.name
+        inputs_map["InitGrad"] = [tg.name]
+    block.append_op(
+        type="autodiff",
+        inputs=inputs_map,
+        outputs={"Grads": [grad_var_name(n) for n in names]},
+        attrs=attrs,
+    )
+    return outs
+
+
+calc_gradient = gradients

@@ -1,0 +1,43 @@
+"""Dtype utilities bridging Paddle-style dtype strings and JAX dtypes.
+
+Reference analog: ``paddle/fluid/framework/framework.proto`` VarType (:105) and
+``python/paddle/fluid/data_feeder.py`` dtype conversion. TPU-first difference:
+bfloat16 is a first-class training dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_STR2DTYPE = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str | np/jnp dtype) to a jnp dtype object."""
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"unsupported dtype string: {dtype}")
+        return _STR2DTYPE[dtype]
+    return jnp.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_str(dtype) -> str:
+    return np.dtype(convert_dtype(dtype)).name if convert_dtype(dtype) is not jnp.bfloat16 else "bfloat16"
+
+
+def is_floating(dtype) -> bool:
+    d = jnp.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.floating)

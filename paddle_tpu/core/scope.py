@@ -1,0 +1,79 @@
+"""Scope: name -> device value map with parent lookup.
+
+Reference analog: ``paddle/fluid/framework/scope.h`` (Scope::NewScope/FindVar).
+TPU-native: values are jax.Arrays already resident in HBM; the executor reads
+the scope into a pytree, runs a jitted step (donating the old state), and
+writes the new state back — functional update instead of in-place mutation.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+        self.kids = []
+
+    def new_scope(self) -> "Scope":
+        s = Scope(self)
+        self.kids.append(s)
+        return s
+
+    def drop_kids(self):
+        self.kids = []
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def var_names(self):
+        return list(self._vars.keys())
+
+    def find_np(self, name: str) -> np.ndarray:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(name)
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+_current_scope = _global_scope
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _scope() -> Scope:
+    return _current_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """Reference executor.py scope_guard parity."""
+    global _current_scope
+    old = _current_scope
+    _current_scope = scope
+    try:
+        yield
+    finally:
+        _current_scope = old

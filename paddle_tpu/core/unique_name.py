@@ -1,0 +1,39 @@
+"""Unique-name generator for variables and ops.
+
+Capability parity with the reference's ``python/paddle/fluid/unique_name.py``
+(prefix-counter generator + guard), re-implemented for the TPU-native build.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids: dict = defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_prefix: str = ""):
+    """Swap in a fresh generator (used by tests for reproducible names)."""
+    global _generator
+    old = _generator
+    _generator = UniqueNameGenerator(new_prefix)
+    try:
+        yield
+    finally:
+        _generator = old

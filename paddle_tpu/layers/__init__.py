@@ -1,0 +1,17 @@
+"""Layers DSL (reference python/paddle/fluid/layers/)."""
+from . import detection  # noqa: F401
+from . import sequence  # noqa: F401
+from .metric_op import accuracy, auc  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .nn import data  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .reduce import (  # noqa: F401
+    reduce_all,
+    reduce_any,
+    reduce_max,
+    reduce_mean,
+    reduce_min,
+    reduce_prod,
+    reduce_sum,
+)
+from .tensor import *  # noqa: F401,F403

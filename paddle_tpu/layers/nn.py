@@ -1,0 +1,405 @@
+"""Layers DSL — the user-facing graph-building API.
+
+Reference analog: ``python/paddle/fluid/layers/nn.py`` (184 layers; SURVEY
+§2.3). Each function appends ops to the current program block and returns the
+output Variable(s). Shape metadata is best-effort (execution shapes come from
+the actual arrays at trace time; XLA owns layout).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype, dtype_str
+from ..core.program import Variable, default_main_program
+from ..initializer import ConstantInitializer, NormalInitializer, XavierInitializer
+from ..layer_helper import LayerHelper
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _conv_out_dim(size, k, pad, stride, dilation=1):
+    if size is None or size < 0:
+        return -1
+    eff = dilation * (k - 1) + 1
+    return (size + 2 * pad - eff) // stride + 1
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", lod_level: int = 0,
+         append_batch_size: bool = True) -> Variable:
+    """Input placeholder (reference layers/io.py data). With
+    append_batch_size=True a leading -1 batch dim is added (paddle behavior)."""
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    return block.create_var(name=name, shape=shape, dtype=convert_dtype(dtype),
+                            is_data=True, stop_gradient=True, lod_level=lod_level)
+
+
+def fc(input: Variable, size: int, num_flatten_dims: int = 1, param_attr=None,
+       bias_attr=None, act: Optional[str] = None, name: Optional[str] = None) -> Variable:
+    """Fully-connected (reference layers/nn.py fc): flattens input at
+    num_flatten_dims, gemm on the MXU, optional bias + activation."""
+    helper = LayerHelper("fc", name=name)
+    in_shape = input.shape
+    reduced = int(np.prod([d for d in in_shape[num_flatten_dims:]])) if in_shape else None
+    w = helper.create_parameter(param_attr, shape=[reduced, size], dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=tuple(in_shape[:num_flatten_dims]) + (size,) if in_shape else None)
+    helper.append_op(
+        type="mul", inputs={"X": [input.name], "Y": [w.name]},
+        outputs={"Out": [out.name]},
+        attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[size], dtype=input.dtype, is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype, out.shape)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out.name], "Y": [b.name]},
+                         outputs={"Out": [tmp.name]}, attrs={"axis": -1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def embedding(input: Variable, size: Sequence[int], is_sparse: bool = False,
+              is_distributed: bool = False, padding_idx: Optional[int] = None,
+              param_attr=None, dtype="float32", name=None) -> Variable:
+    """layers/nn.py embedding → lookup_table op. is_sparse is accepted for API
+    parity; on TPU the gradient is an XLA scatter-add either way."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype,
+                                default_initializer=XavierInitializer())
+    out_shape = None
+    if input.shape is not None:
+        ids_shape = input.shape[:-1] if input.shape[-1] == 1 else input.shape
+        out_shape = tuple(ids_shape) + (size[-1],)
+    out = helper.create_variable_for_type_inference(dtype, out_shape)
+    helper.append_op(
+        type="lookup_table", inputs={"W": [w.name], "Ids": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
+               "is_sparse": is_sparse, "is_distributed": is_distributed})
+    return out
+
+
+def conv2d(input: Variable, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups: int = 1, param_attr=None, bias_attr=None,
+           use_cudnn: bool = True, act: Optional[str] = None, name=None,
+           data_format: str = "NCHW") -> Variable:
+    helper = LayerHelper("conv2d", name=name)
+    fh, fw = _pair(filter_size)
+    num_channels = input.shape[1] if input.shape else None
+    # fan-in init (reference layers/nn.py:2404: std = sqrt(2/(k*k*C_in)))
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, num_channels // groups, fh, fw],
+        dtype=input.dtype,
+        default_initializer=NormalInitializer(0.0, (2.0 / (fh * fw * num_channels)) ** 0.5))
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    out_shape = None
+    if input.shape is not None and len(input.shape) == 4:
+        out_shape = (input.shape[0], num_filters,
+                     _conv_out_dim(input.shape[2], fh, ph, sh, dh),
+                     _conv_out_dim(input.shape[3], fw, pw, sw, dw))
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op(
+        type="conv2d", inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Out": [out.name]},
+        attrs={"strides": [sh, sw], "paddings": [ph, pw],
+               "dilations": [dh, dw], "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters], dtype=input.dtype, is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype, out_shape)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out.name], "Y": [b.name]},
+                         outputs={"Out": [tmp.name]}, attrs={"axis": 1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None) -> Variable:
+    helper = LayerHelper("conv2d_transpose", name=name)
+    fh, fw = _pair(filter_size)
+    num_channels = input.shape[1]
+    w = helper.create_parameter(param_attr, shape=[num_channels, num_filters // groups, fh, fw],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv2d_transpose", inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Out": [out.name]},
+        attrs={"strides": list(_pair(stride)), "paddings": list(_pair(padding)),
+               "dilations": list(_pair(dilation)), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters], dtype=input.dtype, is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="elementwise_add", inputs={"X": [out.name], "Y": [b.name]},
+                         outputs={"Out": [tmp.name]}, attrs={"axis": 1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def pool2d(input: Variable, pool_size=2, pool_type: str = "max", pool_stride=None,
+           pool_padding=0, global_pooling: bool = False, use_cudnn: bool = True,
+           ceil_mode: bool = False, exclusive: bool = True, name=None) -> Variable:
+    helper = LayerHelper("pool2d", name=name)
+    kh, kw = _pair(pool_size)
+    sh, sw = _pair(pool_stride if pool_stride is not None else pool_size)
+    ph, pw = _pair(pool_padding)
+    out_shape = None
+    if input.shape is not None and len(input.shape) == 4:
+        if global_pooling:
+            out_shape = (input.shape[0], input.shape[1], 1, 1)
+        else:
+            out_shape = (input.shape[0], input.shape[1],
+                         _conv_out_dim(input.shape[2], kh, ph, sh),
+                         _conv_out_dim(input.shape[3], kw, pw, sw))
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op(
+        type="pool2d", inputs={"X": [input.name]}, outputs={"Out": [out.name]},
+        attrs={"pooling_type": pool_type, "ksize": [kh, kw],
+               "strides": [sh, sw], "paddings": [ph, pw],
+               "global_pooling": global_pooling, "exclusive": exclusive})
+    return out
+
+
+def batch_norm(input: Variable, act: Optional[str] = None, is_test: bool = False,
+               momentum: float = 0.9, epsilon: float = 1e-5, param_attr=None,
+               bias_attr=None, data_layout: str = "NCHW", name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               use_global_stats: bool = False) -> Variable:
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, shape=[c], dtype=input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=input.dtype, is_bias=True)
+    mean = helper.create_global_variable([c], input.dtype, name=moving_mean_name,
+                                         initializer=ConstantInitializer(0.0))
+    var = helper.create_global_variable([c], input.dtype, name=moving_variance_name,
+                                        initializer=ConstantInitializer(1.0))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    saved_mean = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input.name], "Scale": [scale.name], "Bias": [bias.name],
+                "Mean": [mean.name], "Variance": [var.name]},
+        outputs={"Y": [out.name], "MeanOut": [mean.name], "VarianceOut": [var.name],
+                 "SavedMean": [saved_mean.name], "SavedVariance": [saved_var.name]},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "is_test": is_test or use_global_stats, "data_layout": data_layout})
+    return helper.append_activation(out, act)
+
+
+def layer_norm(input: Variable, scale: bool = True, shift: bool = True,
+               begin_norm_axis: int = 1, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None) -> Variable:
+    helper = LayerHelper("layer_norm", name=name)
+    if input.shape is None:
+        raise ValueError(
+            f"layer_norm needs input shape metadata to size its scale/bias "
+            f"(input var {input.name} has none — ensure upstream layers "
+            f"propagate shapes)")
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    ins = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(param_attr, shape=norm_shape, dtype=input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+        ins["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(bias_attr, shape=norm_shape, dtype=input.dtype, is_bias=True)
+        ins["Bias"] = [b.name]
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    mean = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(type="layer_norm", inputs=ins,
+                     outputs={"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+                     attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None) -> Variable:
+    helper = LayerHelper("group_norm", name=name)
+    c = input.shape[1]
+    ins = {"X": [input.name]}
+    if param_attr is not False:
+        s = helper.create_parameter(param_attr, shape=[c], dtype=input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+        ins["Scale"] = [s.name]
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[c], dtype=input.dtype, is_bias=True)
+        ins["Bias"] = [b.name]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(type="group_norm", inputs=ins,
+                     outputs={"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def dropout(x: Variable, dropout_prob: float, is_test: bool = False, seed=None,
+            name=None, dropout_implementation: str = "downgrade_in_infer") -> Variable:
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(type="dropout", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Mask": [mask.name]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "dropout_implementation": dropout_implementation})
+    return out
+
+
+def softmax(input: Variable, axis: int = -1, use_cudnn: bool = False, name=None) -> Variable:
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="softmax", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def matmul(x: Variable, y: Variable, transpose_x: bool = False,
+           transpose_y: bool = False, alpha: float = 1.0, name=None) -> Variable:
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="matmul", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                            "alpha": alpha})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None) -> Variable:
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="mul", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+# -- losses -----------------------------------------------------------------
+
+def cross_entropy(input: Variable, label: Variable, soft_label: bool = False,
+                  ignore_index: int = -100) -> Variable:
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cross_entropy",
+                     inputs={"X": [input.name], "Label": [label.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits: Variable, label: Variable,
+                               soft_label: bool = False, ignore_index: int = -100,
+                               return_softmax: bool = False, axis: int = -1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    sm = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": [logits.name], "Label": [label.name]},
+                     outputs={"Loss": [loss.name], "Softmax": [sm.name]},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index,
+                            "axis": axis})
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False) -> Variable:
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x.name], "Label": [label.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"ignore_index": ignore_index, "normalize": normalize})
+    return out
+
+
+def square_error_cost(input: Variable, label: Variable) -> Variable:
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="square_error_cost",
+                     inputs={"X": [input.name], "Label": [label.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def mean(x: Variable, name=None) -> Variable:
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=())
+    helper.append_op(type="mean", inputs={"X": [x.name]}, outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+# -- misc nn ----------------------------------------------------------------
+
+def relu(x, name=None):
+    from .ops import _activation_layer
+    return _activation_layer("relu", x, {}, name)
+
+
+def topk(input: Variable, k: int, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op(type="top_k", inputs={"X": [input.name]},
+                     outputs={"Out": [values.name], "Indices": [indices.name]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="l2_normalize", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="clip", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"min": min, "max": max})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="clip_by_norm", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"max_norm": max_norm})
+    return out
+
+
+def one_hot(input: Variable, depth: int, allow_out_of_range: bool = False) -> Variable:
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="one_hot", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"depth": depth})
+    return out
+
+
+def prelu(x, mode: str = "all", param_attr=None, name=None) -> Variable:
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(param_attr, shape=alpha_shape, dtype=x.dtype,
+                                    default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="prelu", inputs={"X": [x.name], "Alpha": [alpha.name]},
+                     outputs={"Out": [out.name]}, attrs={"mode": mode})
+    return out

@@ -1,0 +1,61 @@
+"""Sequence layers over padded-dense + length representation.
+
+Reference analog: the sequence_* layers of python/paddle/fluid/layers/nn.py
+operating on LoDTensors. TPU-native contract: tensors are padded dense
+[batch, max_len, ...] and ops take an explicit integer `length` Variable
+(see paddle_tpu/ops/sequence_ops.py docstring).
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+def sequence_mask(x, maxlen: int, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(type="sequence_mask", inputs={"X": [x.name]},
+                     outputs={"Y": [out.name]},
+                     attrs={"maxlen": maxlen, "out_dtype": dtype})
+    return out
+
+
+def sequence_pool(input, pool_type: str, length=None, is_test=False):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    else:
+        raise ValueError(
+            "TPU sequence_pool needs an explicit `length` Variable (the "
+            "LoD metadata of the reference is carried as a dense tensor here)")
+    helper.append_op(type="sequence_pool", inputs=ins, outputs={"Out": [out.name]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_softmax(input, length, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_softmax",
+                     inputs={"X": [input.name], "Length": [length.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def sequence_reverse(x, length=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    helper.append_op(type="sequence_reverse", inputs=ins, outputs={"Y": [out.name]}, attrs={})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out

@@ -1,0 +1,276 @@
+"""Tensor-manipulation layers (reference python/paddle/fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype, dtype_str
+from ..core.program import Variable
+from ..layer_helper import LayerHelper
+
+
+def cast(x: Variable, dtype) -> Variable:
+    helper = LayerHelper("cast")
+    out = helper.create_variable_for_type_inference(dtype, x.shape)
+    helper.append_op(type="cast", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"out_dtype": dtype_str(convert_dtype(dtype)),
+                            "in_dtype": dtype_str(x.dtype)})
+    return out
+
+
+def concat(input: Sequence[Variable], axis: int = 0, name=None) -> Variable:
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="concat", inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def split(input: Variable, num_or_sections, dim: int = -1, name=None) -> List[Variable]:
+    helper = LayerHelper("split", name=name)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": dim}
+        sections = None
+    else:
+        n = len(num_or_sections)
+        sections = list(num_or_sections)
+        attrs = {"sections": sections, "axis": dim}
+    shapes = [None] * n
+    if input.shape is not None:
+        ax = dim if dim >= 0 else len(input.shape) + dim
+        base = list(input.shape)
+        if sections is None and base[ax] > 0:
+            sections = [base[ax] // n] * n
+        if sections is not None:
+            shapes = []
+            for s in sections:
+                sh = list(base)
+                sh[ax] = s
+                shapes.append(tuple(sh))
+    outs = [helper.create_variable_for_type_inference(input.dtype, shapes[i])
+            for i in range(n)]
+    helper.append_op(type="split", inputs={"X": [input.name]},
+                     outputs={"Out": [o.name for o in outs]}, attrs=attrs)
+    return outs
+
+
+def reshape(x: Variable, shape: Sequence[int], actual_shape=None, act=None,
+            inplace: bool = False, name=None) -> Variable:
+    helper = LayerHelper("reshape", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, tuple(shape))
+    helper.append_op(type="reshape", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape)})
+    return helper.append_activation(out, act)
+
+
+def transpose(x: Variable, perm: Sequence[int], name=None) -> Variable:
+    helper = LayerHelper("transpose", name=name)
+    shape = tuple(x.shape[p] for p in perm) if x.shape else None
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op(type="transpose", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def stack(x: Sequence[Variable], axis: int = 0) -> Variable:
+    helper = LayerHelper("stack")
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op(type="stack", inputs={"X": [v.name for v in x]},
+                     outputs={"Y": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def unstack(x: Variable, axis: int = 0, num: Optional[int] = None) -> List[Variable]:
+    helper = LayerHelper("unstack")
+    n = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(n)]
+    helper.append_op(type="unstack", inputs={"X": [x.name]},
+                     outputs={"Y": [o.name for o in outs]}, attrs={"axis": axis, "num": n})
+    return outs
+
+
+def squeeze(input: Variable, axes: Sequence[int], name=None) -> Variable:
+    helper = LayerHelper("squeeze", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="squeeze", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input: Variable, axes: Sequence[int], name=None) -> Variable:
+    helper = LayerHelper("unsqueeze", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="unsqueeze", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axes": list(axes)})
+    return out
+
+
+def flatten(x: Variable, axis: int = 1, name=None) -> Variable:
+    helper = LayerHelper("flatten", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="flatten", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def expand(x: Variable, expand_times: Sequence[int], name=None) -> Variable:
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="expand", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def slice(input: Variable, axes, starts, ends, name=None) -> Variable:
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="slice", inputs={"Input": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends)})
+    return out
+
+
+def gather(input: Variable, index: Variable, overwrite: bool = True) -> Variable:
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gather", inputs={"X": [input.name], "Index": [index.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def gather_nd(input: Variable, index: Variable, name=None) -> Variable:
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gather_nd", inputs={"X": [input.name], "Index": [index.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def scatter(input: Variable, index: Variable, updates: Variable,
+            overwrite: bool = True, name=None) -> Variable:
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="scatter",
+                     inputs={"X": [input.name], "Ids": [index.name], "Updates": [updates.name]},
+                     outputs={"Out": [out.name]}, attrs={"overwrite": overwrite})
+    return out
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None) -> Variable:
+    helper = LayerHelper("fill_constant", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype, tuple(shape))
+    helper.append_op(type="fill_constant", outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype_str(convert_dtype(dtype)),
+                            "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value, input_dim_idx=0,
+                                  output_dim_idx=0) -> Variable:
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="fill_constant_batch_size_like",
+                     inputs={"Input": [input.name]}, outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype_str(convert_dtype(dtype)),
+                            "value": float(value), "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def zeros(shape, dtype="float32", force_cpu=False) -> Variable:
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32", force_cpu=False) -> Variable:
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x: Variable, out=None) -> Variable:
+    helper = LayerHelper("fill_zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="fill_zeros_like", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def assign(input, output: Optional[Variable] = None) -> Variable:
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype, input.shape)
+        helper.append_op(type="assign_value", outputs={"Out": [output.name]},
+                         attrs={"values": input.reshape(-1).tolist(),
+                                "shape": list(input.shape), "dtype": str(input.dtype)})
+        return output
+    if output is None:
+        output = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="assign", inputs={"X": [input.name]},
+                     outputs={"Out": [output.name]}, attrs={})
+    return output
+
+
+def argmax(x: Variable, axis: int = 0) -> Variable:
+    helper = LayerHelper("arg_max")
+    out = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op(type="arg_max", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def argmin(x: Variable, axis: int = 0) -> Variable:
+    helper = LayerHelper("arg_min")
+    out = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op(type="arg_min", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def argsort(x: Variable, axis: int = -1, descending: bool = False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op(type="argsort", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Indices": [idx.name]},
+                     attrs={"axis": axis, "descending": descending})
+    return out, idx
+
+
+def where(condition: Variable) -> Variable:
+    helper = LayerHelper("where_index")
+    out = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op(type="where_index", inputs={"Condition": [condition.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def increment(x: Variable, value: float = 1.0, in_place: bool = True) -> Variable:
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="increment", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"step": value})
+    return out
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None) -> Variable:
+    from ..initializer import ConstantInitializer
+    helper = LayerHelper("global_var", name=name)
+    return helper.create_global_variable(shape, dtype, persistable=persistable,
+                                         name=name, initializer=ConstantInitializer(value))
+
+
+def create_tensor(dtype, name=None, persistable=False) -> Variable:
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable_for_type_inference(dtype)
+
+
+def cumsum(x: Variable, axis=-1, exclusive=False, reverse=False) -> Variable:
+    helper = LayerHelper("cumsum")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="cumsum", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"axis": axis, "exclusive": exclusive, "reverse": reverse})
+    return out

@@ -1,0 +1,3 @@
+"""Model zoo covering the five BASELINE workload configs (BASELINE.md):
+LeNet/MNIST, ResNet-50, BERT/ERNIE-base, Transformer NMT, DeepFM CTR."""
+from . import bert, deepfm, lenet, resnet, transformer_nmt  # noqa: F401

@@ -1,0 +1,61 @@
+"""ResNet-50 (BASELINE config 2; reference image_classification recipe —
+conv-heavy MXU workload)."""
+from __future__ import annotations
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.param_attr import ParamAttr
+
+
+def conv_bn(x, filters, ksize, stride=1, act=None, name="conv", is_test=False):
+    conv = layers.conv2d(x, filters, ksize, stride=stride,
+                         padding=(ksize - 1) // 2, bias_attr=False,
+                         param_attr=ParamAttr(name=f"{name}.w"))
+    return layers.batch_norm(conv, act=act, is_test=is_test,
+                             param_attr=ParamAttr(name=f"{name}.bn.scale"),
+                             bias_attr=ParamAttr(name=f"{name}.bn.bias"),
+                             moving_mean_name=f"{name}.bn.mean",
+                             moving_variance_name=f"{name}.bn.var")
+
+
+def bottleneck(x, filters, stride, name, is_test=False):
+    shortcut = x
+    in_c = x.shape[1]
+    out_c = filters * 4
+    y = conv_bn(x, filters, 1, act="relu", name=f"{name}.a", is_test=is_test)
+    y = conv_bn(y, filters, 3, stride=stride, act="relu", name=f"{name}.b", is_test=is_test)
+    y = conv_bn(y, out_c, 1, name=f"{name}.c", is_test=is_test)
+    if stride != 1 or in_c != out_c:
+        shortcut = conv_bn(x, out_c, 1, stride=stride, name=f"{name}.sc", is_test=is_test)
+    return layers.relu(layers.elementwise_add(y, shortcut))
+
+
+_LAYOUT = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def resnet(img, depth: int = 50, num_classes: int = 1000, is_test: bool = False):
+    blocks = _LAYOUT[depth]
+    x = conv_bn(img, 64, 7, stride=2, act="relu", name="stem", is_test=is_test)
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1)
+    filters = [64, 128, 256, 512]
+    for stage, (n, f) in enumerate(zip(blocks, filters)):
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            x = bottleneck(x, f, stride, name=f"res{stage}.{i}", is_test=is_test)
+    x = layers.pool2d(x, global_pooling=True, pool_type="avg")
+    return layers.fc(x, num_classes, param_attr=ParamAttr(name="fc.w"),
+                     bias_attr=ParamAttr(name="fc.b"))
+
+
+def build_train_program(depth=50, num_classes=1000, lr=0.1, momentum=0.9,
+                        img_shape=(3, 224, 224)):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", list(img_shape))
+        label = layers.data("label", [1], dtype="int64")
+        logits = resnet(img, depth, num_classes)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        fluid.optimizer.Momentum(lr, momentum).minimize(loss)
+    return main, startup, ["img", "label"], loss, acc

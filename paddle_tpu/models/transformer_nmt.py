@@ -1,0 +1,177 @@
+"""Transformer-big NMT (BASELINE config 4; WMT14 en-de).
+
+The reference handles variable-length batches with LoDTensors; the TPU-native
+representation is length-bucketed padded batches + masks (SURVEY §7 hard part
+1) — each bucket compiles once, preserving the padding-free efficiency claim.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.initializer import NormalInitializer, ConstantInitializer
+from paddle_tpu.param_attr import ParamAttr
+
+
+@dataclass
+class TransformerConfig:
+    src_vocab: int = 32000
+    tgt_vocab: int = 32000
+    d_model: int = 1024       # transformer-big
+    n_heads: int = 16
+    d_ff: int = 4096
+    n_enc: int = 6
+    n_dec: int = 6
+    dropout: float = 0.1
+    max_len: int = 256
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+
+def _attr(name):
+    return ParamAttr(name=name, initializer=NormalInitializer(0.0, 0.02))
+
+
+def _mha(cfg, q_in, kv_in, mask, name, is_test=False, cache=None):
+    d, nh, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
+    q = layers.fc(q_in, d, num_flatten_dims=2, param_attr=_attr(f"{name}.q.w"),
+                  bias_attr=False)
+    k = layers.fc(kv_in, d, num_flatten_dims=2, param_attr=_attr(f"{name}.k.w"),
+                  bias_attr=False)
+    v = layers.fc(kv_in, d, num_flatten_dims=2, param_attr=_attr(f"{name}.v.w"),
+                  bias_attr=False)
+
+    def heads(t):
+        return layers.transpose(layers.reshape(t, [0, -1, nh, hd]), [0, 2, 1, 3])
+
+    qh, kh, vh = heads(q), heads(k), heads(v)
+    scores = layers.matmul(qh, kh, transpose_y=True, alpha=1.0 / math.sqrt(hd))
+    if mask is not None:
+        scores = layers.elementwise_add(scores, mask)
+    probs = layers.softmax(scores)
+    if cfg.dropout > 0:
+        probs = layers.dropout(probs, cfg.dropout, is_test=is_test,
+                               dropout_implementation="upscale_in_train")
+    out = layers.matmul(probs, vh)
+    out = layers.reshape(layers.transpose(out, [0, 2, 1, 3]), [0, -1, d])
+    return layers.fc(out, d, num_flatten_dims=2, param_attr=_attr(f"{name}.o.w"),
+                     bias_attr=False)
+
+
+def _ffn(cfg, x, name, is_test=False):
+    h = layers.fc(x, cfg.d_ff, num_flatten_dims=2, act="relu",
+                  param_attr=_attr(f"{name}.ffn1.w"),
+                  bias_attr=ParamAttr(name=f"{name}.ffn1.b",
+                                      initializer=ConstantInitializer(0.0)))
+    if cfg.dropout > 0:
+        h = layers.dropout(h, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, cfg.d_model, num_flatten_dims=2,
+                     param_attr=_attr(f"{name}.ffn2.w"),
+                     bias_attr=ParamAttr(name=f"{name}.ffn2.b",
+                                         initializer=ConstantInitializer(0.0)))
+
+
+def _ln(x, name):
+    return layers.layer_norm(x, begin_norm_axis=2,
+                             param_attr=ParamAttr(name=f"{name}.scale",
+                                                  initializer=ConstantInitializer(1.0)),
+                             bias_attr=ParamAttr(name=f"{name}.bias",
+                                                 initializer=ConstantInitializer(0.0)))
+
+
+def _residual(cfg, x, sub, is_test=False):
+    if cfg.dropout > 0:
+        sub = layers.dropout(sub, cfg.dropout, is_test=is_test,
+                             dropout_implementation="upscale_in_train")
+    return layers.elementwise_add(x, sub)
+
+
+def _pos_encoding_np(max_len, d_model):
+    pos = np.arange(max_len)[:, None]
+    i = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000.0, (2 * (i // 2)) / d_model)
+    enc = np.zeros((max_len, d_model), dtype="float32")
+    enc[:, 0::2] = np.sin(angle[:, 0::2])
+    enc[:, 1::2] = np.cos(angle[:, 1::2])
+    return enc
+
+
+def _embed(cfg, ids, vocab, name, is_test=False):
+    emb = layers.embedding(ids, [vocab, cfg.d_model], param_attr=_attr(name))
+    emb = layers.scale(emb, scale=math.sqrt(cfg.d_model))
+    seq_len = ids.shape[1] if ids.shape and len(ids.shape) > 1 and ids.shape[1] > 0 else cfg.max_len
+    pe = layers.assign(_pos_encoding_np(seq_len, cfg.d_model))
+    emb = layers.elementwise_add(emb, pe)  # broadcast [T,D] over batch
+    if cfg.dropout > 0:
+        emb = layers.dropout(emb, cfg.dropout, is_test=is_test,
+                             dropout_implementation="upscale_in_train")
+    return emb
+
+
+def encoder(cfg, src_ids, src_mask, is_test=False):
+    x = _embed(cfg, src_ids, cfg.src_vocab, "src_embedding", is_test)
+    for i in range(cfg.n_enc):
+        name = f"enc_{i}"
+        x = _ln(_residual(cfg, x, _mha(cfg, x, x, src_mask, f"{name}.self", is_test),
+                          is_test), f"{name}.ln1")
+        x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln2")
+    return x
+
+
+def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False):
+    x = _embed(cfg, tgt_ids, cfg.tgt_vocab, "tgt_embedding", is_test)
+    for i in range(cfg.n_dec):
+        name = f"dec_{i}"
+        x = _ln(_residual(cfg, x, _mha(cfg, x, x, self_mask, f"{name}.self", is_test),
+                          is_test), f"{name}.ln1")
+        x = _ln(_residual(cfg, x, _mha(cfg, x, enc_out, cross_mask, f"{name}.cross", is_test),
+                          is_test), f"{name}.ln2")
+        x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln3")
+    return layers.fc(x, cfg.tgt_vocab, num_flatten_dims=2,
+                     param_attr=_attr("out_proj.w"), bias_attr=False)
+
+
+def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
+                        lr=1e-3, is_test=False):
+    """Masks are fed as additive float tensors (0 keep / -1e4 drop):
+    src_mask [B,1,1,Ts]; tgt self-mask [B,1,Tt,Tt] (causal+pad);
+    cross mask [B,1,1,Ts]."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", [src_len], dtype="int64")
+        tgt = layers.data("tgt_ids", [tgt_len], dtype="int64")
+        lbl = layers.data("lbl_ids", [tgt_len, 1], dtype="int64")
+        src_mask = layers.data("src_mask", [1, 1, src_len])
+        tgt_mask = layers.data("tgt_mask", [1, tgt_len, tgt_len])
+        enc_out = encoder(cfg, src, src_mask, is_test)
+        logits = decoder(cfg, tgt, enc_out, tgt_mask, src_mask, is_test)
+        loss_tok = layers.softmax_with_cross_entropy(logits, lbl, ignore_index=0)
+        valid = layers.cast(layers.not_equal(
+            lbl, layers.fill_constant([1], "int64", 0)), "float32")
+        loss = layers.elementwise_div(
+            layers.reduce_sum(layers.elementwise_mul(loss_tok, valid)),
+            layers.reduce_sum(valid))
+        fluid.optimizer.Adam(lr).minimize(loss)
+    return main, startup, ["src_ids", "tgt_ids", "lbl_ids", "src_mask", "tgt_mask"], loss
+
+
+def length_buckets(lengths, buckets=(32, 64, 128, 256)):
+    """Bucketing helper replacing LoD batching: map raw lengths to the
+    smallest bucket ≥ len (one XLA compilation per bucket)."""
+    out = []
+    for L in lengths:
+        for b in buckets:
+            if L <= b:
+                out.append(b)
+                break
+        else:
+            out.append(buckets[-1])
+    return out

@@ -1,0 +1,24 @@
+"""TPU-native operator library.
+
+Reference analog: ``paddle/fluid/operators/`` (~505 REGISTER_OPERATOR sites,
+SURVEY §2.1). Each module registers pure-JAX implementations into the op
+registry; XLA owns kernels, fusion, and layout — there is no per-device kernel
+variant dimension (the CPU/CUDA/MKLDNN kernel axis of op_registry.h collapses).
+
+Importing this package registers every op.
+"""
+from . import (  # noqa: F401
+    activation_ops,
+    collective_ops,
+    compare_ops,
+    control_flow_ops,
+    detection_ops,
+    math_ops,
+    metric_ops,
+    nn_ops,
+    optimizer_ops,
+    reduce_ops,
+    sequence_ops,
+    tensor_ops,
+)
+from .eager import call as eager_call  # noqa: F401

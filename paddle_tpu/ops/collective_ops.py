@@ -1,0 +1,89 @@
+"""Collective ops (`c_*` family).
+
+Reference analog: ``paddle/fluid/operators/collective/`` — c_allreduce_{sum,
+max,min,prod}, c_broadcast, c_allgather, c_reducescatter, c_comm_init,
+c_gen_nccl_id, c_sync_*_stream (each with a `ring_id` selecting an NCCL comm).
+
+TPU-native redesign: collectives are XLA ICI primitives (psum/all_gather/
+ppermute) bound to *named mesh axes* instead of NCCL rings — `ring_id` maps to
+an axis name. Inside a pjit/GSPMD program these ops only make sense under
+shard_map (per-device code); at the graph level GSPMD inserts collectives
+automatically from shardings, so these ops are mainly used by the shard_map-
+based parallel library (paddle_tpu.parallel). When no mesh axis is bound
+(single-device trace) they are identity, matching single-process reference
+behavior. c_comm_init/c_gen_nccl_id have no equivalent: `jax.distributed`
+bootstraps multi-host.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _axis(ctx, attrs):
+    """Resolve the mesh axis for a collective: explicit attr wins, else the
+    ring_id indexes ctx.mesh axis names (ring 0 → first axis)."""
+    name = attrs.get("axis_name")
+    if name:
+        return name
+    ring = attrs.get("ring_id", 0)
+    if ctx.mesh is not None and len(ctx.mesh.axis_names) > ring:
+        return ctx.mesh.axis_names[ring]
+    return None
+
+
+def _in_shard_map(axis):
+    if axis is None:
+        return False
+    try:
+        lax.axis_index(axis)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def _collective(name, fn):
+    @register_op(name, differentiable=False)
+    def _impl(ctx, inputs, attrs, _fn=fn):
+        (x,) = inputs["X"]
+        axis = _axis(ctx, attrs)
+        if axis is None or not _in_shard_map(axis):
+            return one(x)  # single-device / GSPMD context: identity
+        return one(_fn(x, axis))
+    return _impl
+
+
+_collective("c_allreduce_sum", lambda x, a: lax.psum(x, a))
+_collective("c_allreduce_max", lambda x, a: lax.pmax(x, a))
+_collective("c_allreduce_min", lambda x, a: lax.pmin(x, a))
+_collective("c_allreduce_prod", lambda x, a: jnp.exp(lax.psum(jnp.log(x), a)))
+_collective("allreduce", lambda x, a: lax.psum(x, a))
+_collective("c_allgather", lambda x, a: lax.all_gather(x, a, tiled=True))
+_collective("c_reducescatter", lambda x, a: lax.psum_scatter(x, a, tiled=True))
+
+
+@register_op("c_broadcast", differentiable=False)
+def _c_broadcast(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = _axis(ctx, attrs)
+    if axis is None or not _in_shard_map(axis):
+        return one(x)
+    root = attrs.get("root", 0)
+    idx = lax.axis_index(axis)
+    size = lax.axis_size(axis) if hasattr(lax, "axis_size") else lax.psum(1, axis)
+    src = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return one(lax.psum(src, axis))
+
+
+@register_op("c_sync_calc_stream", differentiable=False)
+def _c_sync_calc(ctx, inputs, attrs):
+    return one(inputs["X"][0])  # XLA orders ops by data deps; no streams
+
+
+@register_op("c_sync_comm_stream", differentiable=False)
+def _c_sync_comm(ctx, inputs, attrs):
+    return one(inputs["X"][0])

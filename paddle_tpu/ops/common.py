@@ -1,0 +1,22 @@
+"""Shared helpers for op implementations."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bcast_y(x, y, axis: int = -1):
+    """Paddle elementwise broadcast rule (operators/elementwise/
+    elementwise_op_function.h): `y`'s shape is aligned to `x` starting at
+    `axis`; axis==-1 means align trailing dims (numpy rule)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def one(outs):
+    return {"Out": [outs]}

@@ -1,0 +1,52 @@
+"""Comparison + logical ops (reference operators/controlflow/compare_op.cc,
+logical_op.cc, isfinite_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import bcast_y, one
+
+
+def _cmp(name, fn):
+    @register_op(name, differentiable=False)
+    def _impl(ctx, inputs, attrs, _fn=fn):
+        (x,) = inputs["X"]
+        (y,) = inputs["Y"]
+        return one(_fn(x, bcast_y(x, y, attrs.get("axis", -1))))
+    return _impl
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("logical_and", jnp.logical_and)
+_cmp("logical_or", jnp.logical_or)
+_cmp("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", differentiable=False)
+def _logical_not(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.logical_not(x))
+
+
+@register_op("isfinite", differentiable=False)
+def _isfinite(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.all(jnp.isfinite(x)))
+
+
+@register_op("isinf", differentiable=False)
+def _isinf(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.isinf(x))
+
+
+@register_op("isnan", differentiable=False)
+def _isnan(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.isnan(x))

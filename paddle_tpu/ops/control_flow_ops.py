@@ -1,0 +1,103 @@
+"""Control-flow ops.
+
+Reference analog: ``paddle/fluid/operators/controlflow/`` (while_op.cc,
+conditional_block_op.cc) and recurrent_op.cc — block-attribute ops interpreted
+by the executor.
+
+TPU-native redesign: data-dependent Python control flow cannot live inside a
+traced program, so these lower to `lax.while_loop` / `lax.cond` / `lax.scan`
+over sub-blocks lowered as pure functions. `static_rnn` (lax.scan) is the
+differentiable path (reference StaticRNN); `while` is provided for parity and
+is non-differentiable (as in most real uses: inference decoding loops).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _lower_subblock(ctx, block, env_names: List[str]):
+    """Build a pure fn: tuple(vals for env_names) -> same, by running block."""
+    from ..core.executor import _run_block, ExecContext
+
+    def fn(vals):
+        env = dict(zip(env_names, vals))
+        sub = ExecContext(None, is_test=ctx.is_test, mesh=ctx.mesh)
+        _run_block(block, env, sub)
+        return tuple(env[n] for n in env_names)
+
+    return fn
+
+
+@register_op("while", differentiable=False)
+def _while(ctx, inputs, attrs):
+    """while_op.cc parity via lax.while_loop. Carried vars are the declared
+    loop vars (attr 'loop_vars'); Condition is a scalar bool var name."""
+    block = attrs["sub_block"]
+    loop_vars: List[str] = attrs["loop_vars"]
+    cond_name: str = attrs["cond_name"]
+    xs = inputs["X"]
+    body = _lower_subblock(ctx, block, loop_vars)
+
+    cond_idx = loop_vars.index(cond_name)
+
+    def cond_fn(vals):
+        return vals[cond_idx].reshape(()).astype(bool)
+
+    out = lax.while_loop(cond_fn, lambda v: body(v), tuple(xs))
+    return {"Out": list(out)}
+
+
+@register_op("conditional_block", differentiable=False)
+def _conditional_block(ctx, inputs, attrs):
+    """conditional_block_op.cc parity via lax.cond; both branches must produce
+    the declared outputs (false branch passes through defaults)."""
+    block = attrs["sub_block"]
+    var_names: List[str] = attrs["var_names"]
+    (cond,) = inputs["Cond"]
+    xs = inputs["X"]
+    body = _lower_subblock(ctx, block, var_names)
+    out = lax.cond(cond.reshape(()).astype(bool), body, lambda v: tuple(v), tuple(xs))
+    return {"Out": list(out)}
+
+
+@register_op("static_rnn")
+def _static_rnn(ctx, inputs, attrs):
+    """StaticRNN / recurrent_op.cc parity via lax.scan — differentiable.
+
+    Sequence inputs are [B, T, ...] scanned over T; states carry across steps.
+    attrs: sub_block, state_names (pre names), state_out_names (post names),
+    seq_in_names, out_names (per-step outputs collected along T).
+    """
+    block = attrs["sub_block"]
+    state_names = attrs["state_names"]
+    state_out_names = attrs["state_out_names"]
+    seq_in_names = attrs["seq_in_names"]
+    out_names = attrs["out_names"]
+    param_names = attrs.get("param_names", [])
+
+    states = inputs["State"]
+    seqs = inputs["Seq"]
+    params = inputs.get("Param", [])
+
+    from ..core.executor import _run_block, ExecContext
+
+    def step(carry, xt):
+        env = dict(zip(state_names, carry))
+        env.update(zip(seq_in_names, xt))
+        env.update(zip(param_names, params))
+        sub = ExecContext(None, is_test=ctx.is_test, mesh=ctx.mesh)
+        _run_block(block, env, sub)
+        new_carry = tuple(env[n] for n in state_out_names)
+        ys = tuple(env[n] for n in out_names)
+        return new_carry, ys
+
+    seqs_tfirst = tuple(jnp.swapaxes(s, 0, 1) for s in seqs)
+    final_states, ys = lax.scan(step, tuple(states), seqs_tfirst)
+    outs = [jnp.swapaxes(y, 0, 1) for y in ys]
+    return {"Out": outs, "FinalState": list(final_states)}

@@ -1,0 +1,154 @@
+"""Detection ops — a TPU-friendly subset of operators/detection/ (15.3k LoC in
+the reference: yolo, ssd priors, roi_align/pool, nms, ...). Static-shape
+variants of the most-used ops; the NMS family returns fixed-size padded
+results (XLA cannot produce dynamic row counts).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("box_coder", differentiable=False)
+def _box_coder(ctx, inputs, attrs):
+    (prior_box,) = inputs["PriorBox"]
+    (target_box,) = inputs["TargetBox"]
+    code_type = attrs.get("code_type", "encode_center_size")
+    pw = prior_box[:, 2] - prior_box[:, 0]
+    ph = prior_box[:, 3] - prior_box[:, 1]
+    px = prior_box[:, 0] + pw / 2
+    py = prior_box[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0]
+        th = target_box[:, 3] - target_box[:, 1]
+        tx = target_box[:, 0] + tw / 2
+        ty = target_box[:, 1] + th / 2
+        out = jnp.stack([(tx - px) / pw, (ty - py) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+    else:
+        t = target_box
+        ox = px + pw * t[..., 0]
+        oy = py + ph * t[..., 1]
+        ow = pw * jnp.exp(t[..., 2])
+        oh = ph * jnp.exp(t[..., 3])
+        out = jnp.stack([ox - ow / 2, oy - oh / 2, ox + ow / 2, oy + oh / 2], axis=-1)
+    return {"OutputBox": [out]}
+
+
+@register_op("iou_similarity", differentiable=False)
+def _iou_similarity(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return one(inter / (area_x[:, None] + area_y[None, :] - inter + 1e-10))
+
+
+@register_op("prior_box", differentiable=False)
+def _prior_box(ctx, inputs, attrs):
+    (feat,) = inputs["Input"]
+    (image,) = inputs["Image"]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ratios = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", False)
+    step = attrs.get("step_w", 0.0)
+    offset = attrs.get("offset", 0.5)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = step or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    ars = list(ratios)
+    if flip:
+        ars += [1.0 / r for r in ratios if r != 1.0]
+    boxes = []
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * (ar ** 0.5) / 2
+            bh = ms / (ar ** 0.5) / 2
+            boxes.append(jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                                    (cxg + bw) / iw, (cyg + bh) / ih], axis=-1))
+        for mx in max_sizes:
+            s = (ms * mx) ** 0.5 / 2
+            boxes.append(jnp.stack([(cxg - s) / iw, (cyg - s) / ih,
+                                    (cxg + s) / iw, (cyg + s) / ih], axis=-1))
+    out = jnp.clip(jnp.stack(boxes, axis=2).reshape(h, w, -1, 4), 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2])), out.shape)
+    return {"Boxes": [out], "Variances": [var]}
+
+
+@register_op("roi_align", nondiff_inputs=["ROIs"])
+def _roi_align(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (rois,) = inputs["ROIs"]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n_rois = rois.shape[0]
+    c = x.shape[1]
+    # per-ROI source image: optional RoisBatch input [N] (replaces the
+    # reference's LoD offsets); absent → all ROIs from image 0
+    batch_map = inputs.get("RoisBatch", [jnp.zeros((n_rois,), dtype=jnp.int32)])[0]
+
+    def pool_one(roi, batch_idx):
+        x1, y1, x2, y2 = roi[0] * scale, roi[1] * scale, roi[2] * scale, roi[3] * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        ys = y1 + (jnp.arange(ph) + 0.5) * rh / ph
+        xs = x1 + (jnp.arange(pw) + 0.5) * rw / pw
+        yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+        y0 = jnp.clip(jnp.floor(yg).astype(jnp.int32), 0, x.shape[2] - 2)
+        x0 = jnp.clip(jnp.floor(xg).astype(jnp.int32), 0, x.shape[3] - 2)
+        wy = yg - y0
+        wx = xg - x0
+        img = jnp.take(x, batch_idx, axis=0)
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x0 + 1]
+        v10 = img[:, y0 + 1, x0]
+        v11 = img[:, y0 + 1, x0 + 1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    out = jax.vmap(pool_one)(rois, batch_map)
+    return one(out.reshape(n_rois, c, ph, pw))
+
+
+@register_op("yolo_box", differentiable=False)
+def _yolo_box(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (img_size,) = inputs["ImgSize"]
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w).reshape(1, 1, 1, w)
+    grid_y = jnp.arange(h).reshape(1, 1, h, 1)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2]).reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2]).reshape(1, na, 1, 1)
+    bw = jnp.exp(x[:, :, 2]) * aw / (downsample * w)
+    bh = jnp.exp(x[:, :, 3]) * ah / (downsample * h)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    ih = img_size[:, 0].reshape(n, 1, 1, 1).astype(x.dtype)
+    iw = img_size[:, 1].reshape(n, 1, 1, 1).astype(x.dtype)
+    boxes = jnp.stack([(bx - bw / 2) * iw, (by - bh / 2) * ih,
+                       (bx + bw / 2) * iw, (by + bh / 2) * ih], axis=-1)
+    boxes = boxes.reshape(n, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    mask = (conf.reshape(n, -1, 1) > conf_thresh).astype(x.dtype)
+    return {"Boxes": [boxes * mask], "Scores": [scores * mask]}

@@ -1,0 +1,46 @@
+"""Eager op dispatch — the dygraph analog of PreparedOp.
+
+Reference analog: ``paddle/fluid/imperative/prepared_operator.h`` — run a
+single op immediately using the same kernel library as the static graph.
+Here, `call()` executes a registered op impl eagerly on jax.Arrays; the
+dygraph Tracer wraps it with vjp-taping for autograd (imperative/tracer.cc:35).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+
+from ..core.executor import ExecContext
+
+
+_eager_ctx: Optional[ExecContext] = None
+_eager_seed = [0]
+
+
+def _ctx() -> ExecContext:
+    global _eager_ctx
+    if _eager_ctx is None:
+        _eager_ctx = ExecContext(jax.random.PRNGKey(_eager_seed[0]))
+    return _eager_ctx
+
+
+def set_eager_seed(seed: int):
+    global _eager_ctx
+    _eager_seed[0] = seed
+    _eager_ctx = ExecContext(jax.random.PRNGKey(seed))
+
+
+def call(op_type: str, inputs: Dict[str, List], attrs: Optional[Dict] = None,
+         is_test: bool = False) -> Dict[str, List]:
+    """Run one op eagerly. inputs: slot -> list of jax arrays."""
+    from ..core import registry
+
+    opdef = registry.get_op(op_type)
+    ctx = _ctx()
+    old = ctx.is_test
+    ctx.is_test = is_test
+    try:
+        return opdef.fn(ctx, inputs, attrs or {})
+    finally:
+        ctx.is_test = old

@@ -1,0 +1,185 @@
+"""Elementwise + linear-algebra ops.
+
+Reference analog: ``paddle/fluid/operators/elementwise/`` (broadcast + grad),
+``matmul_op.cc``, ``mul_op.cc``, ``scale_op.cc``, ``sum_op.cc``,
+``clip_op.cc``, ``operators/math/blas.h`` (gemm → MXU via XLA dot_general).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import bcast_y, one
+
+
+def _elementwise(name, fn):
+    @register_op(name)
+    def _impl(ctx, inputs, attrs, _fn=fn):
+        (x,) = inputs["X"]
+        (y,) = inputs["Y"]
+        return one(_fn(x, bcast_y(x, y, attrs.get("axis", -1))))
+    return _impl
+
+
+_elementwise("elementwise_add", jnp.add)
+_elementwise("elementwise_sub", jnp.subtract)
+_elementwise("elementwise_mul", jnp.multiply)
+_elementwise("elementwise_div", jnp.divide)
+_elementwise("elementwise_min", jnp.minimum)
+_elementwise("elementwise_max", jnp.maximum)
+_elementwise("elementwise_pow", jnp.power)
+_elementwise("elementwise_mod", jnp.mod)
+_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+@register_op("scale")
+def _scale(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return one(x * scale + bias)
+    return one((x + bias) * scale)
+
+
+@register_op("matmul")
+def _matmul(ctx, inputs, attrs):
+    """matmul_op.cc semantics: optional transpose flags + alpha, batched via
+    leading dims. Lowered to dot_general → MXU."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return one(out)
+
+
+@register_op("mul")
+def _mul(ctx, inputs, attrs):
+    """mul_op.cc: flatten X to 2-D at x_num_col_dims, Y at y_num_col_dims,
+    then gemm; output keeps X's leading dims."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xlead = x.shape[:xnc]
+    x2 = x.reshape((-1, np_prod(x.shape[xnc:])))
+    y2 = y.reshape((np_prod(y.shape[:ync]), -1))
+    out = jnp.matmul(x2, y2)
+    return one(out.reshape(xlead + y.shape[ync:]))
+
+
+def np_prod(t):
+    r = 1
+    for v in t:
+        r *= int(v)
+    return r
+
+
+@register_op("sum")
+def _sum(ctx, inputs, attrs):
+    xs = inputs["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return one(out)
+
+
+@register_op("clip")
+def _clip(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.clip(x, attrs.get("min"), attrs.get("max")))
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return one(jnp.where(norm > max_norm, x * (max_norm / norm), x))
+
+
+def _unary(name, fn, differentiable=True):
+    @register_op(name, differentiable=differentiable)
+    def _impl(ctx, inputs, attrs, _fn=fn):
+        (x,) = inputs["X"]
+        return one(_fn(x))
+    return _impl
+
+
+_unary("abs", jnp.abs)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("square", jnp.square)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log1p", jnp.log1p)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("sign", jnp.sign)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("erf", jax.scipy.special.erf)
+
+
+@register_op("pow")
+def _pow(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.power(x, attrs.get("factor", 1.0)))
+
+
+@register_op("p_norm")
+def _p_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis")
+    keepdim = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return one(out)
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.sum(x * x).reshape((1,)))
+
+
+@register_op("dot")
+def _dot(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    return one(jnp.sum(x * y, axis=-1, keepdims=x.ndim > 1))
+
+
+@register_op("cumsum")
+def _cumsum(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return one(out)

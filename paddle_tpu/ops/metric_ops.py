@@ -1,0 +1,49 @@
+"""Metric ops (reference operators/metrics/: accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", differentiable=False)
+def _accuracy(ctx, inputs, attrs):
+    """accuracy_op.cc: fraction of samples whose top-k indices contain label."""
+    (indices,) = inputs["Indices"]
+    (label,) = inputs["Label"]
+    lab = label[..., 0] if label.ndim == 2 and label.shape[-1] == 1 else label
+    correct = jnp.any(indices == lab[:, None], axis=1)
+    total = jnp.array(indices.shape[0], dtype=jnp.int32)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    acc = num_correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": [acc], "Correct": [num_correct], "Total": [total]}
+
+
+@register_op("auc", differentiable=False)
+def _auc(ctx, inputs, attrs):
+    """auc_op.cc: streaming AUC via threshold-bucketed confusion counters.
+    StatPos/StatNeg are persistable accumulator vars updated each step."""
+    (predict,) = inputs["Predict"]
+    (label,) = inputs["Label"]
+    (stat_pos,) = inputs["StatPos"]
+    (stat_neg,) = inputs["StatNeg"]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    pos_prob = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
+    lab = label.reshape(-1).astype(jnp.float32)
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    pos_hist = jnp.zeros(num_thresholds + 1).at[bucket].add(lab)
+    neg_hist = jnp.zeros(num_thresholds + 1).at[bucket].add(1.0 - lab)
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # integrate: walking thresholds high→low accumulates TP/FP
+    tp = jnp.cumsum(new_pos[::-1])[::-1]
+    fp = jnp.cumsum(new_neg[::-1])[::-1]
+    tot_pos = tp[0]
+    tot_neg = fp[0]
+    # trapezoid over unique thresholds
+    tp_prev = jnp.concatenate([tp[1:], jnp.zeros(1)])
+    fp_prev = jnp.concatenate([fp[1:], jnp.zeros(1)])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where((tot_pos > 0) & (tot_neg > 0), area / (tot_pos * tot_neg + 1e-12), 0.0)
+    return {"AUC": [auc], "StatPosOut": [new_pos], "StatNegOut": [new_neg]}

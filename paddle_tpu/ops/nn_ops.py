@@ -1,0 +1,463 @@
+"""Neural-net ops: conv, pool, norms, dropout, embedding, losses.
+
+Reference analog: ``paddle/fluid/operators/`` conv_op.cc (+conv_cudnn_op.cu),
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc,
+dropout_op.cc, lookup_table_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, sigmoid_cross_entropy_with_logits_op.cc.
+
+TPU notes: convs lower to lax.conv_general_dilated → MXU; data layout is kept
+NCHW at the API (Paddle convention) and XLA's layout assignment picks the
+physical HBM layout. Embedding grads become XLA scatter-adds (dense), the
+TPU-native replacement for SelectedRows sparse rows (selected_rows.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+@register_op("conv2d", nondiff_inputs=[])
+def _conv2d(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    (w,) = inputs["Filter"]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    pad_alg = attrs.get("padding_algorithm", "EXPLICIT")
+    if pad_alg == "SAME":
+        padding = "SAME"
+    elif pad_alg == "VALID":
+        padding = "VALID"
+    else:
+        padding = [(pads[0], pads[0]), (pads[1], pads[1])] if len(pads) == 2 else \
+            [(pads[0], pads[1]), (pads[2], pads[3])]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    return one(out.astype(x.dtype))
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, inputs, attrs):
+    attrs = dict(attrs)
+    (x,) = inputs["Input"]
+    attrs["groups"] = x.shape[1]
+    return _conv2d(ctx, inputs, attrs)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, inputs, attrs):
+    """conv2d_transpose_op.cc semantics: out = (i-1)*s - 2p + d*(k-1) + 1.
+    Expressed as a fractionally-strided conv (lhs_dilation) with the kernel
+    spatially flipped — the gradient-of-conv formulation XLA lowers well."""
+    (x,) = inputs["Input"]
+    (w,) = inputs["Filter"]  # paddle layout: [C_in, C_out/groups, H, W]
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    ph, pw = _pair(attrs.get("paddings", [0, 0]))
+    dh, dw = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    kh, kw = w.shape[2], w.shape[3]
+    # flip spatially; swap in/out channel dims → OIHW with O = C_out
+    wt = jnp.flip(w, axis=(2, 3))
+    if groups > 1:
+        cin, cog = w.shape[0], w.shape[1]
+        wt = wt.reshape(groups, cin // groups, cog, kh, kw)
+        wt = jnp.swapaxes(wt, 1, 2).reshape(groups * cog, cin // groups, kh, kw)
+    else:
+        wt = jnp.swapaxes(wt, 0, 1)
+    eff_kh = dh * (kh - 1) + 1
+    eff_kw = dw * (kw - 1) + 1
+    padding = [(eff_kh - 1 - ph, eff_kh - 1 - ph), (eff_kw - 1 - pw, eff_kw - 1 - pw)]
+    out = lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1), padding=padding,
+        lhs_dilation=(sh, sw), rhs_dilation=(dh, dw),
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return one(out)
+
+
+@register_op("conv3d")
+def _conv3d(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    (w,) = inputs["Filter"]
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    pads = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    groups = int(attrs.get("groups", 1))
+    padding = [(p, p) for p in pads]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return one(out)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+@register_op("pool2d")
+def _pool2d(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and _pair(attrs.get("ksize")) == (1, 1):
+        axis = (2, 3)
+        out = jnp.max(x, axis=axis, keepdims=True) if ptype == "max" else jnp.mean(x, axis=axis, keepdims=True)
+        return one(out)
+    window = (1, 1) + ksize
+    strides_full = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides_full, padding)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides_full, padding)
+        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, padding)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return one(out)
+
+
+@register_op("adaptive_pool2d")
+def _adaptive_pool2d(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    oh, ow = _pair(attrs["pooling_size"] if "pooling_size" in attrs else attrs["ksize"])
+    ptype = attrs.get("pooling_type", "avg")
+    n, c, h, w = x.shape
+    x5 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if ptype == "avg":
+        return one(jnp.mean(x5, axis=(3, 5)))
+    return one(jnp.max(x5, axis=(3, 5)))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register_op("batch_norm", nondiff_inputs=["Mean", "Variance"])
+def _batch_norm(ctx, inputs, attrs):
+    """batch_norm_op.cc parity: running-stat update in train, frozen in test.
+    When a mesh data axis is active (sync_batch_norm / sync_batch_norm_pass
+    analog), XLA computes the batch stats over the *global* batch because the
+    reduction is over the sharded batch dim — sync-BN falls out for free."""
+    (x,) = inputs["X"]
+    (scale,) = inputs["Scale"]
+    (bias,) = inputs["Bias"]
+    (mean,) = inputs["Mean"]
+    (var,) = inputs["Variance"]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        mean_out = momentum * mean + (1.0 - momentum) * use_mean
+        var_out = momentum * var + (1.0 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = use_var
+    inv = lax.rsqrt(use_var.reshape(shape) + eps)
+    y = (x - use_mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
+    return {
+        "Y": [y],
+        "MeanOut": [lax.stop_gradient(mean_out)],
+        "VarianceOut": [lax.stop_gradient(var_out)],
+        "SavedMean": [lax.stop_gradient(saved_mean)],
+        "SavedVariance": [lax.stop_gradient(saved_var)],
+    }
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    scale = inputs.get("Scale", [None])[0]
+    bias = inputs.get("Bias", [None])[0]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(bna, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    norm_shape = (1,) * bna + x.shape[bna:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return {"Y": [y], "Mean": [mean.squeeze(axes)], "Variance": [var.squeeze(axes)]}
+
+
+@register_op("group_norm")
+def _group_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    scale = inputs.get("Scale", [None])[0]
+    bias = inputs.get("Bias", [None])[0]
+    eps = attrs.get("epsilon", 1e-5)
+    groups = attrs["groups"]
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    xg = x.reshape((n, groups, c // groups) + rest)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    cshape = (1, c) + (1,) * len(rest)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return {"Y": [y], "Mean": [mean.reshape(n, groups)], "Variance": [var.reshape(n, groups)]}
+
+
+@register_op("instance_norm")
+def _instance_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    scale = inputs.get("Scale", [None])[0]
+    bias = inputs.get("Bias", [None])[0]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    cshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return {"Y": [y]}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return one(x / jnp.maximum(norm, eps))
+
+
+# ---------------------------------------------------------------------------
+# dropout / embedding
+# ---------------------------------------------------------------------------
+
+@register_op("dropout")
+def _dropout(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test or p == 0.0:
+        # reference dropout_op.cc: at inference, downgrade_in_infer scales by
+        # (1-p); upscale_in_train is identity (scaling happened in training).
+        y = x * (1.0 - p) if (impl == "downgrade_in_infer" and is_test and p > 0.0) else x
+        return {"Out": [y], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        y = x * mask / (1.0 - p)
+    else:
+        y = x * mask
+    return {"Out": [y], "Mask": [lax.stop_gradient(mask)]}
+
+
+@register_op("lookup_table", nondiff_inputs=["Ids"])
+def _lookup_table(ctx, inputs, attrs):
+    """lookup_table_op.cc: W[ids]; padding_idx rows produce zeros. Grad is an
+    XLA scatter-add (dense) — the SelectedRows sparse path is unnecessary on
+    TPU where the embedding table is HBM-resident and shardable."""
+    (w,) = inputs["W"]
+    (ids,) = inputs["Ids"]
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    idx = ids[..., 0] if squeeze_last else ids
+    out = jnp.take(w, idx, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
+    return one(out)
+
+
+@register_op("lookup_table_v2", nondiff_inputs=["Ids"])
+def _lookup_table_v2(ctx, inputs, attrs):
+    return _lookup_table_impl(ctx, inputs, attrs)
+
+
+def _lookup_table_impl(ctx, inputs, attrs):
+    (w,) = inputs["W"]
+    (ids,) = inputs["Ids"]
+    out = jnp.take(w, ids, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return one(out)
+
+
+@register_op("one_hot", differentiable=False)
+def _one_hot(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    depth = attrs["depth"]
+    idx = x[..., 0] if x.ndim >= 2 and x.shape[-1] == 1 else x
+    return one(jax.nn.one_hot(idx, depth, dtype=jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+@register_op("cross_entropy", nondiff_inputs=["Label"])
+def _cross_entropy(ctx, inputs, attrs):
+    """cross_entropy_op.cc: input is a probability distribution (post-softmax).
+    Hard labels (int) index; soft labels dot."""
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        loss = _pick_hard_label(jnp.log(x + eps), label, -1,
+                                attrs.get("ignore_index", -100))
+    return one(loss)
+
+
+def _pick_hard_label(logp, label, axis, ignore):
+    """Index log-probs by integer labels along `axis` (any position).
+    label may carry a singleton at the class axis or omit it."""
+    ax = axis % logp.ndim
+    idx = label
+    if idx.ndim == logp.ndim and idx.shape[ax] == 1:
+        idx = jnp.squeeze(idx, ax)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(idx.astype(jnp.int32), ax), axis=ax)
+    loss = -picked
+    if ignore is not None:
+        loss = jnp.where(jnp.expand_dims(idx == ignore, ax), 0.0, loss)
+    return loss
+
+
+@register_op("softmax_with_cross_entropy", nondiff_inputs=["Label"])
+def _softmax_with_cross_entropy(ctx, inputs, attrs):
+    (logits,) = inputs["Logits"]
+    (label,) = inputs["Label"]
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        loss = _pick_hard_label(logp, label, axis, attrs.get("ignore_index", -100))
+    return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", nondiff_inputs=["Label"])
+def _sigmoid_ce(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum(jnp.where(label != ignore, 1.0, 0.0)), 1.0)
+        loss = loss / n
+    return one(loss)
+
+
+@register_op("square_error_cost", nondiff_inputs=["Label"])
+def _square_error_cost(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    return one(jnp.square(x - label))
+
+
+@register_op("smooth_l1_loss", nondiff_inputs=["Y"])
+def _smooth_l1(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = jnp.abs(x - y)
+    loss = jnp.where(diff < 1.0 / s2, 0.5 * s2 * diff * diff, diff - 0.5 / s2)
+    loss = jnp.sum(loss.reshape(x.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [loss], "Diff": [x - y]}
+
+
+@register_op("huber_loss", nondiff_inputs=["Y"])
+def _huber(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    delta = attrs.get("delta", 1.0)
+    diff = y - x
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad <= delta, 0.5 * diff * diff, delta * (ad - 0.5 * delta))
+    return {"Out": [loss], "Residual": [diff]}
+
+
+@register_op("kldiv_loss", nondiff_inputs=["Target"])
+def _kldiv(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (t,) = inputs["Target"]
+    loss = jnp.where(t > 0, t * (jnp.log(t) - x), 0.0)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return one(loss)
+
+
+@register_op("log_loss", nondiff_inputs=["Labels"])
+def _log_loss(ctx, inputs, attrs):
+    (p,) = inputs["Predicted"]
+    (y,) = inputs["Labels"]
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": [-y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)]}
+
+
+@register_op("margin_rank_loss", nondiff_inputs=["Label"])
+def _margin_rank_loss(ctx, inputs, attrs):
+    (x1,) = inputs["X1"]
+    (x2,) = inputs["X2"]
+    (label,) = inputs["Label"]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [lax.stop_gradient((out > 0).astype(x1.dtype))]}
+
+
+@register_op("cos_sim", nondiff_inputs=[])
+def _cos_sim(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}

@@ -1,0 +1,257 @@
+"""Optimizer update ops.
+
+Reference analog: ``paddle/fluid/operators/optimizers/`` (sgd_op.cc,
+momentum_op.cc, adam_op.cc, adagrad_op.cc, rmsprop_op.cc, adadelta_op.cc,
+adamax_op.cc, ftrl_op.cc, lamb_op.cc, lars_momentum_op.cc,
+decayed_adagrad_op.cc, proximal_gd_op.cc, proximal_adagrad_op.cc).
+
+All are non-differentiable state-update ops: they read Param/Grad/accumulators
+and write the updated values to the same var names; the executor's functional
+state threading turns this into donated-buffer in-place updates in HBM.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _lr(inputs):
+    (lr,) = inputs["LearningRate"]
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op("sgd", differentiable=False)
+def _sgd(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    return {"ParamOut": [p - _lr(inputs) * g.astype(p.dtype)]}
+
+
+@register_op("momentum", differentiable=False)
+def _momentum(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (v,) = inputs["Velocity"]
+    mu = attrs["mu"]
+    lr = _lr(inputs)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("lars_momentum", differentiable=False)
+def _lars_momentum(ctx, inputs, attrs):
+    """lars_momentum_op.cc: layer-wise adaptive rate scaling."""
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (v,) = inputs["Velocity"]
+    mu = attrs["mu"]
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    lr = _lr(inputs)
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr)
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam", differentiable=False)
+def _adam(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (m,) = inputs["Moment1"]
+    (v,) = inputs["Moment2"]
+    (b1p,) = inputs["Beta1Pow"]
+    (b2p,) = inputs["Beta2Pow"]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(inputs)
+    g = g.astype(p.dtype)
+    m_out = b1 * m + (1 - b1) * g
+    v_out = b2 * v + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    return {
+        "ParamOut": [p_out], "Moment1Out": [m_out], "Moment2Out": [v_out],
+        "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("adamw", differentiable=False)
+def _adamw(ctx, inputs, attrs):
+    """Decoupled weight decay variant (beyond-reference; standard for BERT)."""
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (m,) = inputs["Moment1"]
+    (v,) = inputs["Moment2"]
+    (b1p,) = inputs["Beta1Pow"]
+    (b2p,) = inputs["Beta2Pow"]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    wd = attrs.get("coeff", 0.01)
+    lr = _lr(inputs)
+    g = g.astype(p.dtype)
+    m_out = b1 * m + (1 - b1) * g
+    v_out = b2 * v + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * (m_out / (jnp.sqrt(v_out) + eps)) - lr * wd * p
+    return {
+        "ParamOut": [p_out], "Moment1Out": [m_out], "Moment2Out": [v_out],
+        "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("adamax", differentiable=False)
+def _adamax(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (m,) = inputs["Moment"]
+    (inf_norm,) = inputs["InfNorm"]
+    (b1p,) = inputs["Beta1Pow"]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(inputs)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p.reshape(()))) * m_out / (inf_out + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out]}
+
+
+@register_op("adagrad", differentiable=False)
+def _adagrad(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (m,) = inputs["Moment"]
+    eps = attrs.get("epsilon", 1e-6)
+    lr = _lr(inputs)
+    m_out = m + g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_out) + eps)], "MomentOut": [m_out]}
+
+
+@register_op("decayed_adagrad", differentiable=False)
+def _decayed_adagrad(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (m,) = inputs["Moment"]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    lr = _lr(inputs)
+    m_out = decay * m + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_out) + eps)], "MomentOut": [m_out]}
+
+
+@register_op("adadelta", differentiable=False)
+def _adadelta(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (avg_sq_g,) = inputs["AvgSquaredGrad"]
+    (avg_sq_u,) = inputs["AvgSquaredUpdate"]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g_out = rho * avg_sq_g + (1 - rho) * g * g
+    update = -jnp.sqrt((avg_sq_u + eps) / (g_out + eps)) * g
+    u_out = rho * avg_sq_u + (1 - rho) * update * update
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [g_out], "AvgSquaredUpdateOut": [u_out]}
+
+
+@register_op("rmsprop", differentiable=False)
+def _rmsprop(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (ms,) = inputs["MeanSquare"]
+    (mg,) = inputs["MeanGrad"]
+    (mom,) = inputs["Moment"]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    lr = _lr(inputs)
+    ms_out = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - mg_out * mg_out + eps
+    else:
+        mg_out = mg
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * g / jnp.sqrt(denom)
+    return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+            "MeanGradOut": [mg_out], "MomentOut": [mom_out]}
+
+
+@register_op("ftrl", differentiable=False)
+def _ftrl(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (sq,) = inputs["SquaredAccumulator"]
+    (lin,) = inputs["LinearAccumulator"]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = _lr(inputs)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    if lr_power == -0.5:
+        x = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        x = l2 + new_sq ** (-lr_power) / lr
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre / x, jnp.zeros_like(p))
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq], "LinearAccumOut": [lin_out]}
+
+
+@register_op("lamb", differentiable=False)
+def _lamb(ctx, inputs, attrs):
+    """lamb_op.cc: layer-wise adaptation for large batches (BERT-scale)."""
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (m,) = inputs["Moment1"]
+    (v,) = inputs["Moment2"]
+    (b1p,) = inputs["Beta1Pow"]
+    (b2p,) = inputs["Beta2Pow"]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    lr = _lr(inputs)
+    g = g.astype(p.dtype)
+    m_out = b1 * m + (1 - b1) * g
+    v_out = b2 * v + (1 - b2) * g * g
+    m_hat = m_out / (1 - b1p.reshape(()))
+    v_hat = v_out / (1 - b2p.reshape(()))
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {
+        "ParamOut": [p - lr * trust * r], "Moment1Out": [m_out], "Moment2Out": [v_out],
+        "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("proximal_gd", differentiable=False)
+def _proximal_gd(ctx, inputs, attrs):
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(inputs)
+    prox = p - lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": [p_out]}

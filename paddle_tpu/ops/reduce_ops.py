@@ -1,0 +1,91 @@
+"""Reduction + arg ops (reference operators/reduce_ops/, mean_op.cc,
+argsort/arg_max/arg_min, top_k_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _reduce(name, fn, differentiable=True):
+    @register_op(name, differentiable=differentiable)
+    def _impl(ctx, inputs, attrs, _fn=fn):
+        (x,) = inputs["X"]
+        if attrs.get("reduce_all", False):
+            dim = None
+        else:
+            dim = attrs.get("dim", [0])
+            dim = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        keep = attrs.get("keep_dim", False)
+        return one(_fn(x, axis=dim, keepdims=keep))
+    return _impl
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_any", jnp.any, differentiable=False)
+_reduce("reduce_all", jnp.all, differentiable=False)
+
+
+@register_op("mean")
+def _mean(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.mean(x))
+
+
+@register_op("logsumexp")
+def _logsumexp(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("dim")
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return one(jax.scipy.special.logsumexp(x, axis=axis, keepdims=attrs.get("keep_dim", False)))
+
+
+@register_op("arg_max", differentiable=False)
+def _arg_max(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.argmax(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("arg_min", differentiable=False)
+def _arg_min(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.argmin(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("argsort", differentiable=False)
+def _argsort(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", -1)
+    descending = attrs.get("descending", False)
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k")
+def _top_k(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    k = attrs["k"]
+    vals, idx = lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("max", differentiable=True)
+def _max(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.max(x))
+
+
+@register_op("frobenius_norm")
+def _frobenius_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    dim = attrs.get("dim")
+    dim = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+    return one(jnp.sqrt(jnp.sum(x * x, axis=dim, keepdims=attrs.get("keep_dim", False))))

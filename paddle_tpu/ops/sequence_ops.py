@@ -1,0 +1,99 @@
+"""Sequence ops — TPU-native replacement for the LoDTensor machinery.
+
+Reference analog: ``paddle/fluid/operators/sequence_ops/`` (15+ LoD-aware ops
+over lod_tensor.h variable-length batches). XLA needs static shapes, so the
+TPU-native representation is **padded dense [batch, max_len, ...] + explicit
+length/mask vars** (SURVEY §5 long-context note). Each sequence op takes a
+Length input instead of reading LoD metadata.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _mask_from_len(length, maxlen, dtype=jnp.float32):
+    return (jnp.arange(maxlen)[None, :] < length.reshape(-1, 1)).astype(dtype)
+
+
+@register_op("sequence_mask", differentiable=False)
+def _sequence_mask(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask on TPU requires static maxlen attr")
+    from ..core.dtypes import convert_dtype
+    dtype = convert_dtype(attrs.get("out_dtype", "int64"))
+    return {"Y": [_mask_from_len(x, maxlen, dtype)]}
+
+
+@register_op("sequence_pool", nondiff_inputs=["Length"])
+def _sequence_pool(ctx, inputs, attrs):
+    """sequence_pool_op.cc over padded [B, T, ...] + Length."""
+    (x,) = inputs["X"]
+    (length,) = inputs["Length"]
+    ptype = attrs.get("pooltype", "SUM").upper()
+    t = x.shape[1]
+    mask = _mask_from_len(length, t, x.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(x * mask, axis=1)
+    elif ptype == "AVERAGE":
+        denom = jnp.maximum(length.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype), 1)
+        out = jnp.sum(x * mask, axis=1) / denom
+    elif ptype == "SQRT":
+        denom = jnp.sqrt(jnp.maximum(length.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype), 1))
+        out = jnp.sum(x * mask, axis=1) / denom
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(length - 1, 0).astype(jnp.int32).reshape(-1)
+        out = jnp.take_along_axis(x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return one(out)
+
+
+@register_op("sequence_softmax", nondiff_inputs=["Length"])
+def _sequence_softmax(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (length,) = inputs["Length"]
+    mask = _mask_from_len(length, x.shape[1], x.dtype)
+    logits = jnp.where(mask > 0, x, jnp.finfo(x.dtype).min)
+    return one(jax.nn.softmax(logits, axis=1) * mask)
+
+
+@register_op("sequence_expand", nondiff_inputs=["Length"])
+def _sequence_expand(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    return one(jnp.repeat(x, y.shape[1], axis=0).reshape(y.shape[:2] + x.shape[1:]) if x.ndim > 1 else x)
+
+
+@register_op("sequence_reverse", nondiff_inputs=["Length"])
+def _sequence_reverse(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    length = inputs.get("Length", [None])[0]
+    t = x.shape[1]
+    if length is None:
+        return {"Y": [jnp.flip(x, axis=1)]}
+    idx = jnp.arange(t)[None, :]
+    rev = jnp.where(idx < length.reshape(-1, 1), length.reshape(-1, 1) - 1 - idx, idx)
+    return {"Y": [jnp.take_along_axis(x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, inputs, attrs):
+    xs = inputs["X"]
+    return one(jnp.concatenate(xs, axis=1))
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, inputs, attrs):
+    raise NotImplementedError("im2sequence: use conv/patch extraction layers")

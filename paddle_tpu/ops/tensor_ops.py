@@ -1,0 +1,376 @@
+"""Tensor manipulation + creation ops.
+
+Reference analog: reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc,
+stack_op.cc, gather_op.cc, scatter_op.cc, pad_op.cc, cast_op.cc,
+fill_constant_op.cc, uniform_random_op.cc, gaussian_random_op.cc, assign_op.cc,
+expand_op.cc, slice_op.cc, squeeze_op.cc, unsqueeze_op.cc, shape_op.cc,
+range_op.cc, eye_op.cc (SURVEY §2.1 operator library row).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtypes import convert_dtype
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("reshape")
+def _reshape(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    shape = list(attrs["shape"])
+    # paddle rule: 0 means copy input dim at that position; -1 infers
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return one(x.reshape(shape))
+
+
+@register_op("transpose")
+def _transpose(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.transpose(x, attrs["axis"]))
+
+
+@register_op("concat")
+def _concat(ctx, inputs, attrs):
+    xs = inputs["X"]
+    return one(jnp.concatenate(xs, axis=attrs.get("axis", 0)))
+
+
+@register_op("split")
+def _split(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections")
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ctx, inputs, attrs):
+    xs = inputs["X"]
+    return {"Y": [jnp.stack(xs, axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def _unstack(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", x.shape[axis])
+    outs = [jnp.squeeze(s, axis=axis) for s in jnp.split(x, num, axis=axis)]
+    return {"Y": outs}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axes = attrs.get("axes", [])
+    if not axes:
+        return one(jnp.squeeze(x))
+    return one(jnp.squeeze(x, axis=tuple(axes)))
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return one(out)
+
+
+@register_op("flatten")
+def _flatten(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", 1)
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return one(x.reshape((lead, -1)))
+
+
+@register_op("flatten2")
+def _flatten2(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", 1)
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return {"Out": [x.reshape((lead, -1))], "XShape": [jnp.zeros((0,) + x.shape)]}
+
+
+@register_op("expand")
+def _expand(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    times = attrs["expand_times"]
+    return one(jnp.tile(x, times))
+
+
+@register_op("expand_as")
+def _expand_as(ctx, inputs, attrs, ):
+    (x,) = inputs["X"]
+    (t,) = inputs["target_tensor"]
+    times = [ts // xs for ts, xs in zip(t.shape, x.shape)]
+    return one(jnp.tile(x, times))
+
+
+@register_op("tile")
+def _tile(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.tile(x, attrs["repeat_times"]))
+
+
+@register_op("slice")
+def _slice(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    axes = attrs["axes"]
+    starts = list(attrs["starts"])
+    ends = list(attrs["ends"])
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return one(x[tuple(idx)])
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return one(x[tuple(idx)])
+
+
+@register_op("gather", nondiff_inputs=["Index"])
+def _gather(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (index,) = inputs["Index"]
+    idx = index[..., 0] if index.ndim == 2 and index.shape[-1] == 1 else index
+    return one(jnp.take(x, idx, axis=attrs.get("axis", 0)))
+
+
+@register_op("gather_nd", nondiff_inputs=["Index"])
+def _gather_nd(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (index,) = inputs["Index"]
+    return one(x[tuple(jnp.moveaxis(index, -1, 0))])
+
+
+@register_op("scatter", nondiff_inputs=["Ids"])
+def _scatter(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (ids,) = inputs["Ids"]
+    (updates,) = inputs["Updates"]
+    idx = ids[..., 0] if ids.ndim == 2 and ids.shape[-1] == 1 else ids
+    if attrs.get("overwrite", True):
+        return one(x.at[idx].set(updates))
+    return one(x.at[idx].add(updates))
+
+
+@register_op("scatter_nd_add", nondiff_inputs=["Index"])
+def _scatter_nd_add(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (index,) = inputs["Index"]
+    (updates,) = inputs["Updates"]
+    return one(x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates))
+
+
+@register_op("pad")
+def _pad(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return one(jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("pad2d")
+def _pad2d(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return one(jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0)))
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return one(jnp.pad(x, pairs, mode=jmode))
+
+
+@register_op("cast")
+def _cast(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(x.astype(convert_dtype(attrs["out_dtype"])))
+
+
+@register_op("assign")
+def _assign(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(x)
+
+
+@register_op("shape", differentiable=False)
+def _shape(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    return one(jnp.array(x.shape, dtype=jnp.int32))
+
+
+@register_op("fill_constant", differentiable=False)
+def _fill_constant(ctx, inputs, attrs):
+    shape = attrs.get("shape", [1])
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return one(jnp.full(shape, attrs.get("value", 0.0), dtype=dtype))
+
+
+@register_op("fill_constant_batch_size_like", differentiable=False)
+def _fill_constant_bsl(ctx, inputs, attrs):
+    (ref,) = inputs["Input"]
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return one(jnp.full(shape, attrs.get("value", 0.0), dtype=dtype))
+
+
+@register_op("fill_zeros_like", differentiable=False)
+def _fill_zeros_like(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.zeros_like(x))
+
+
+@register_op("assign_value", differentiable=False)
+def _assign_value(ctx, inputs, attrs):
+    values = attrs["values"]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return one(jnp.asarray(values, dtype=dtype).reshape(attrs["shape"]))
+
+
+@register_op("uniform_random", differentiable=False)
+def _uniform_random(ctx, inputs, attrs):
+    shape = attrs["shape"]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    return one(jax.random.uniform(ctx.rng(), shape, dtype=jnp.float32, minval=lo, maxval=hi).astype(dtype))
+
+
+@register_op("gaussian_random", differentiable=False)
+def _gaussian_random(ctx, inputs, attrs):
+    shape = attrs["shape"]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    return one((mean + std * jax.random.normal(ctx.rng(), shape, dtype=jnp.float32)).astype(dtype))
+
+
+@register_op("truncated_gaussian_random", differentiable=False)
+def _truncated_gaussian_random(ctx, inputs, attrs):
+    shape = attrs["shape"]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    r = jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, dtype=jnp.float32)
+    return one((mean + std * r).astype(dtype))
+
+
+@register_op("randint", differentiable=False)
+def _randint(ctx, inputs, attrs):
+    shape = attrs["shape"]
+    return one(jax.random.randint(ctx.rng(), shape, attrs.get("low", 0), attrs.get("high"),
+                                  dtype=convert_dtype(attrs.get("dtype", "int64"))))
+
+
+@register_op("range", differentiable=False)
+def _range(ctx, inputs, attrs):
+    (start,) = inputs["Start"]
+    (end,) = inputs["End"]
+    (step,) = inputs["Step"]
+    # static-shape requirement: bounds must be concrete (trace-time) constants
+    import numpy as np
+    return one(jnp.arange(np.asarray(start).item(), np.asarray(end).item(),
+                          np.asarray(step).item(), dtype=start.dtype))
+
+
+@register_op("linspace", differentiable=False)
+def _linspace(ctx, inputs, attrs):
+    import numpy as np
+    (start,) = inputs["Start"]
+    (stop,) = inputs["Stop"]
+    (num,) = inputs["Num"]
+    return one(jnp.linspace(np.asarray(start).item(), np.asarray(stop).item(),
+                            int(np.asarray(num).item())))
+
+
+@register_op("eye", differentiable=False)
+def _eye(ctx, inputs, attrs):
+    return one(jnp.eye(attrs["num_rows"], attrs.get("num_columns"),
+                       dtype=convert_dtype(attrs.get("dtype", "float32"))))
+
+
+@register_op("diag", differentiable=False)
+def _diag(ctx, inputs, attrs):
+    (d,) = inputs["Diagonal"]
+    return one(jnp.diag(d))
+
+
+@register_op("shard_index", differentiable=False)
+def _shard_index(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return one(jnp.where(in_shard, x % shard_size, ignore_value))
+
+
+@register_op("where", nondiff_inputs=["Condition"])
+def _where(ctx, inputs, attrs):
+    (cond,) = inputs["Condition"]
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    return one(jnp.where(cond, x, y))
+
+
+@register_op("where_index", differentiable=False)
+def _where_index(ctx, inputs, attrs):
+    (cond,) = inputs["Condition"]
+    # dynamic-shape op: XLA needs static sizes; return padded indices with a
+    # count (TPU-native contract documented in layers.where)
+    idx = jnp.stack(jnp.nonzero(cond, size=cond.size, fill_value=-1), axis=-1)
+    return one(idx)
+
+
+@register_op("increment", differentiable=False)
+def _increment(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(x + attrs.get("step", 1.0))
+
+
+@register_op("py_func", differentiable=False)
+def _py_func(ctx, inputs, attrs):
+    """py_func_op.cc analog — escape hatch to host Python via pure_callback."""
+    fn = attrs["func"]
+    out_shapes = attrs["out_shapes"]
+    out_dtypes = [convert_dtype(d) for d in attrs["out_dtypes"]]
+    xs = inputs.get("X", [])
+    result_shape = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in zip(out_shapes, out_dtypes)]
+    outs = jax.pure_callback(fn, result_shape, *xs)
+    return {"Out": list(outs)}
+
+
+@register_op("print", differentiable=False)
+def _print(ctx, inputs, attrs):
+    (x,) = inputs["In"]
+    jax.debug.print(attrs.get("message", "") + "{x}", x=x)
+    return one(x)

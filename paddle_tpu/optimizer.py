@@ -1,0 +1,500 @@
+"""Optimizer zoo for static-graph training.
+
+Reference analog: ``python/paddle/fluid/optimizer.py`` (Optimizer base :50 —
+minimize → append_backward + _create_optimization_pass; 13 optimizers;
+SURVEY §2.3). Accumulators are persistable vars initialized in the startup
+program; each param gets one update op consuming ``param@GRAD``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .core.backward import append_backward
+from .core.dtypes import dtype_str
+from .core.program import (Parameter, Program, Variable, default_main_program,
+                           default_startup_program, grad_var_name)
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+
+class Optimizer:
+    """Base optimizer (optimizer.py:50)."""
+
+    def __init__(self, learning_rate, regularization=None, name: Optional[str] = None,
+                 grad_clip=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or type(self).__name__
+        self._accumulators = {}
+        self._lr_var = None
+        self.helper = None
+        self.type = "optimizer"
+
+    # -- learning rate -----------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        helper = LayerHelper("learning_rate")
+        self._lr_var = helper.create_global_variable(
+            shape=[1], dtype="float32",
+            name=f"learning_rate_{self._name}",
+            initializer=ConstantInitializer(float(self._learning_rate)))
+
+    def _global_learning_rate(self) -> Variable:
+        return self._lr_var
+
+    @property
+    def current_lr(self):
+        from .core.scope import global_scope
+        v = global_scope().find_var(self._lr_var.name) if self._lr_var is not None else None
+        return None if v is None else np.asarray(v)
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Variable, fill_value: float = 0.0,
+                         shape=None, dtype=None) -> Variable:
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        helper = LayerHelper(f"{self._name}_{name}")
+        acc = helper.create_global_variable(
+            shape=shape if shape is not None else list(param.shape),
+            dtype=dtype or dtype_str(param.dtype),
+            name=f"{param.name}_{self._name}_{name}",
+            initializer=ConstantInitializer(fill_value))
+        self._accumulators[key] = acc
+        return acc
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[(name, param.name)]
+
+    # -- api ----------------------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads) -> List:
+        prog = default_main_program()
+        block = prog.global_block()
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        params_grads = append_regularization_ops(params_grads, self.regularization)
+        self._create_global_learning_rate()
+        self._create_accumulators(block, [p for p, g in params_grads])
+        ops = []
+        for pg in params_grads:
+            ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss: Variable, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None) -> Tuple[List, List]:
+        params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name]}, attrs={})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="momentum",
+            inputs={"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """optimizer.py:1058 LarsMomentumOptimizer."""
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None,
+                 grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+class _AdamLike(Optimizer):
+    op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 regularization=None, name=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = self.op_type
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._extra_attrs = kw
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        attrs = {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs)
+        return block.append_op(
+            type=self.op_type,
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment1": [m1.name],
+                    "Moment2": [m2.name], "Beta1Pow": [b1p.name], "Beta2Pow": [b2p.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "Moment1Out": [m1.name], "Moment2Out": [m2.name],
+                     "Beta1PowOut": [b1p.name], "Beta2PowOut": [b2p.name]},
+            attrs=attrs)
+
+
+class AdamOptimizer(_AdamLike):
+    op_type = "adam"
+
+
+class AdamWOptimizer(_AdamLike):
+    op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 weight_decay=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, coeff=weight_decay, **kw)
+
+
+class LambOptimizer(_AdamLike):
+    """optimizer.py:2103 LambOptimizer."""
+    op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon,
+                         weight_decay=lamb_weight_decay, **kw)
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None, name=None,
+                 initial_accumulator_value=0.0, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"epsilon": self._epsilon})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, regularization=None,
+                 name=None, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "decayed_adagrad"
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, regularization=None,
+                 name=None, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "adadelta"
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        g1 = self._get_accumulator("avg_squared_grad", p)
+        g2 = self._get_accumulator("avg_squared_update", p)
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [p.name], "Grad": [g.name], "AvgSquaredGrad": [g1.name],
+                    "AvgSquaredUpdate": [g2.name], "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "AvgSquaredGradOut": [g1.name],
+                     "AvgSquaredUpdateOut": [g2.name]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "rmsprop"
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+            self._add_accumulator("momentum", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ms = self._get_accumulator("mean_square", p)
+        mg = self._get_accumulator("mean_grad", p)
+        mom = self._get_accumulator("momentum", p)
+        return block.append_op(
+            type="rmsprop",
+            inputs={"Param": [p.name], "Grad": [g.name], "MeanSquare": [ms.name],
+                    "MeanGrad": [mg.name], "Moment": [mom.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MeanSquareOut": [ms.name],
+                     "MeanGradOut": [mg.name], "MomentOut": [mom.name]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 regularization=None, name=None, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        inf = self._get_accumulator("inf_norm", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        op = block.append_op(
+            type="adamax",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "InfNorm": [inf.name], "Beta1Pow": [b1p.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name], "InfNormOut": [inf.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon})
+        # beta1_pow update (reference appends a scale op per param)
+        block.append_op(type="scale", inputs={"X": [b1p.name]},
+                        outputs={"Out": [b1p.name]}, attrs={"scale": self._beta1})
+        return op
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None, grad_clip=None):
+        super().__init__(learning_rate, regularization, name, grad_clip)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        return block.append_op(
+            type="ftrl",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "SquaredAccumulator": [sq.name], "LinearAccumulator": [lin.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "SquaredAccumOut": [sq.name],
+                     "LinearAccumOut": [lin.name]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """API-parity stub: deep gradient compression (optimizer.py:799) is a
+    bandwidth optimization for commodity interconnects; on TPU ICI the
+    all-reduce is already near-roofline, so this behaves as Momentum.
+    Documented non-goal: SURVEY §2.2 gradient compression row."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0, **kw):
+        kw.pop("rampup_step", None)
+        kw.pop("sparsity", None)
+        super().__init__(learning_rate, momentum, **kw)
+
+
+class ModelAverage(Optimizer):
+    """optimizer.py:2257 — maintain sliding-window parameter averages."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self.type = "model_average"
+        self._window = max_average_window
+
+    def minimize(self, loss, **kw):
+        raise TypeError("ModelAverage wraps apply(); call after another optimizer")
+
+    def apply(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _noop():
+            yield
+        return _noop()
+
+    def restore(self, executor=None):
+        pass
+
+
+class ExponentialMovingAverage:
+    """optimizer.py:2447 EMA of parameters, applied at eval time."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._ema_vars = {}
+
+    def update(self):
+        prog = default_main_program()
+        block = prog.global_block()
+        helper = LayerHelper(self._name)
+        for p in prog.all_parameters():
+            if not p.trainable:
+                continue
+            ema = helper.create_global_variable(
+                list(p.shape), dtype_str(p.dtype), name=f"{p.name}.{self._name}",
+                initializer=ConstantInitializer(0.0))
+            self._ema_vars[p.name] = ema
+            # ema = decay*ema + (1-decay)*p  expressed with scale+sum ops
+            tmp1 = helper.create_variable_for_type_inference(p.dtype)
+            tmp2 = helper.create_variable_for_type_inference(p.dtype)
+            block.append_op(type="scale", inputs={"X": [ema.name]},
+                            outputs={"Out": [tmp1.name]}, attrs={"scale": self._decay})
+            block.append_op(type="scale", inputs={"X": [p.name]},
+                            outputs={"Out": [tmp2.name]}, attrs={"scale": 1.0 - self._decay})
+            block.append_op(type="sum", inputs={"X": [tmp1.name, tmp2.name]},
+                            outputs={"Out": [ema.name]}, attrs={})
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _swap():
+            from .core.scope import global_scope
+            import jax.numpy as jnp
+            scope = global_scope()
+            saved = {}
+            for pname, ema in self._ema_vars.items():
+                saved[pname] = scope.find_var(pname)
+                ev = scope.find_var(ema.name)
+                if ev is not None:
+                    scope.set_var(pname, ev)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for pname, v in saved.items():
+                        scope.set_var(pname, v)
+        return _swap()
+
+    def restore(self, executor=None):
+        pass
+
+
+# paddle-style lowercase aliases (fluid.optimizer.SGD etc.)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+AdadeltaOpt = AdadeltaOptimizer
+Adadelta = AdadeltaOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

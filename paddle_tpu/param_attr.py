@@ -1,0 +1,37 @@
+"""ParamAttr (reference python/paddle/fluid/param_attr.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = False,
+                 need_clip: bool = True, shard_spec=None):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+        # TPU-native extension: PartitionSpec-style sharding for pjit lowering
+        self.shard_spec = shard_spec
+
+    @staticmethod
+    def _to_attr(arg) -> Optional["ParamAttr"]:
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if arg is False:
+            return None
+        if isinstance(arg, (list, tuple)):
+            return [ParamAttr._to_attr(a) for a in arg]
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+
+WeightNormParamAttr = ParamAttr  # weight-norm reparam: not yet specialized

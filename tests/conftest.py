@@ -1,0 +1,38 @@
+"""Test config: force an 8-device virtual CPU mesh (SURVEY §4 TPU note —
+the test_dist_base.py localhost-cluster trick, XLA edition)."""
+import os
+
+# Force a virtual 8-device CPU mesh: the session env pins JAX to the real TPU
+# tunnel (axon plugin overrides JAX_PLATFORMS env), so use jax.config instead.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# numeric tests compare against float64 numpy references; use exact f32 dots
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test fresh default programs + scope + unique names."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import program as prog_mod
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.core import unique_name
+
+    old_main, old_startup = prog_mod._main_program, prog_mod._startup_program
+    old_scope = scope_mod._global_scope
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    scope_mod._global_scope = scope_mod.Scope()
+    scope_mod._current_scope = scope_mod._global_scope
+    with unique_name.guard():
+        yield
+    prog_mod._main_program, prog_mod._startup_program = old_main, old_startup
+    scope_mod._global_scope = old_scope
+    scope_mod._current_scope = old_scope

@@ -1,0 +1,126 @@
+"""OpTest harness — the per-op contract from the reference
+(python/paddle/fluid/tests/unittests/op_test.py:135): run a single op through
+a real program+executor, compare outputs to numpy, and compare analytic
+gradients (via the autodiff machinery) against finite differences
+(op_test.py:46 get_numeric_gradient).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+class OpTest:
+    """Subclass sets: op_type, inputs (slot->np array or list), attrs,
+    and a numpy reference via expected_outputs()."""
+
+    op_type: str = ""
+    atol = 1e-5
+    rtol = 1e-5
+
+    def run_op(self, inputs, attrs=None, output_slots=("Out",), multi_output_counts=None):
+        """Build a one-op program, execute, return dict slot -> np arrays."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_map = {}
+            feed = {}
+            for slot, arrs in inputs.items():
+                arrs = arrs if isinstance(arrs, (list, tuple)) else [arrs]
+                names = []
+                for i, a in enumerate(arrs):
+                    name = f"{slot.lower()}_{i}"
+                    block.create_var(name=name, shape=a.shape, dtype=str(a.dtype),
+                                     is_data=True, stop_gradient=False)
+                    feed[name] = a
+                    names.append(name)
+                in_map[slot] = names
+            out_map = {}
+            counts = multi_output_counts or {}
+            for slot in output_slots:
+                n = counts.get(slot, 1)
+                out_map[slot] = [f"out_{slot.lower()}_{i}" for i in range(n)]
+                for nm in out_map[slot]:
+                    block.create_var(name=nm, dtype="float32")
+            block.append_op(self.op_type, in_map, out_map, attrs or {})
+            exe = fluid.Executor(fluid.CPUPlace())
+            fetch = [nm for slot in output_slots for nm in out_map[slot]]
+            res = exe.run(main, feed=feed, fetch_list=fetch)
+        out = {}
+        i = 0
+        for slot in output_slots:
+            vals = []
+            for _ in out_map[slot]:
+                vals.append(res[i])
+                i += 1
+            out[slot] = vals if len(vals) > 1 else vals[0]
+        return out
+
+    def check_output(self, inputs, attrs, expected, output_slots=("Out",), atol=None):
+        got = self.run_op(inputs, attrs, output_slots)
+        for slot, exp in expected.items():
+            np.testing.assert_allclose(
+                np.asarray(got[slot]), exp, atol=atol or self.atol, rtol=self.rtol,
+                err_msg=f"op {self.op_type} output {slot} mismatch")
+
+    def check_grad(self, inputs, attrs, grad_input_slot="X", output_slot="Out",
+                   delta=5e-3, max_relative_error=5e-3):
+        """Analytic-vs-numeric gradient of sum(output) wrt one input."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_map = {}
+            feed = {}
+            for slot, arrs in inputs.items():
+                arrs = arrs if isinstance(arrs, (list, tuple)) else [arrs]
+                names = []
+                for i, a in enumerate(arrs):
+                    name = f"{slot.lower()}_{i}"
+                    block.create_var(name=name, shape=a.shape, dtype=str(a.dtype),
+                                     is_data=True, stop_gradient=False)
+                    feed[name] = a
+                    names.append(name)
+                in_map[slot] = names
+            out_name = "out_0"
+            block.create_var(name=out_name, dtype="float32")
+            block.append_op(self.op_type, in_map, {output_slot: [out_name]}, attrs or {})
+            out_var = block.var(out_name)
+            # loss = sum(out)
+            loss = fluid.layers.reduce_sum(out_var)
+            target = block.var(in_map[grad_input_slot][0])
+            (gvar,) = fluid.gradients([loss], [target])
+            exe = fluid.Executor(fluid.CPUPlace())
+            (analytic,) = exe.run(main, feed=feed, fetch_list=[gvar])
+
+        # numeric: central differences on the same op via eager dispatch
+        x0 = np.array(feed[in_map[grad_input_slot][0]], dtype=np.float64)
+        numeric = np.zeros_like(x0)
+
+        def eval_sum(xv):
+            f2 = dict(feed)
+            f2[in_map[grad_input_slot][0]] = xv.astype(feed[in_map[grad_input_slot][0]].dtype)
+            import paddle_tpu.ops as ops
+            vals = {s: [np.asarray(f2[n]) for n in ns] for s, ns in in_map.items()}
+            import jax.numpy as jnp
+            jvals = {s: [jnp.asarray(v) for v in vs] for s, vs in vals.items()}
+            out = ops.eager_call(self.op_type, jvals, attrs or {})
+            return float(np.sum(np.asarray(out[output_slot][0], dtype=np.float64)))
+
+        flat = x0.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            fp = eval_sum(x0)
+            flat[i] = orig - delta
+            fm = eval_sum(x0)
+            flat[i] = orig
+            numeric.reshape(-1)[i] = (fp - fm) / (2 * delta)
+
+        abs_err = np.abs(analytic - numeric)
+        denom = np.maximum(np.abs(numeric), 1e-3)
+        assert (abs_err / denom).max() < max_relative_error, (
+            f"op {self.op_type} grad mismatch: max rel err "
+            f"{(abs_err / denom).max()}\nanalytic={analytic}\nnumeric={numeric}")

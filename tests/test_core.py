@@ -1,0 +1,147 @@
+"""Core IR + executor tests (reference analogs: test_program.py,
+test_executor_and_mul.py, test_backward.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_program_build():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.fc(x, 3)
+        assert y.name in main.global_block().vars
+        assert len(main.all_parameters()) == 2  # w, b
+        ops = [op.type for op in main.global_block().ops]
+        assert "mul" in ops and "elementwise_add" in ops
+
+
+def test_executor_feed_fetch():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.random.rand(3, 4).astype("float32")
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_mul_fc_forward():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.fc(x, 3, bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w_name = main.all_parameters()[0].name
+        xv = np.random.rand(5, 4).astype("float32")
+        out, wv = exe.run(main, feed={"x": xv}, fetch_list=[y, w_name])
+    np.testing.assert_allclose(out, xv @ wv, rtol=1e-5)
+
+
+def test_append_backward_grads():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(y)
+        params_grads = fluid.append_backward(loss)
+        assert len(params_grads) == 1
+        p, g = params_grads[0]
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.ones((8, 4), dtype="float32")
+        (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[g])
+    # d(mean(xw))/dw = mean over batch of x = ones/1 → each w grad = 1
+    np.testing.assert_allclose(gv, np.ones((4, 1)), rtol=1e-5)
+
+
+def test_gradients_api():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [3])
+        y = fluid.layers.square(x)
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = fluid.gradients([loss], [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+        (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 2 * xv, rtol=1e-6)
+
+
+def test_stop_gradient_blocks_flow():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [3])
+        x.stop_gradient = False
+        frozen = fluid.layers.scale(x, scale=3.0)
+        frozen.stop_gradient = True
+        y = fluid.layers.elementwise_add(fluid.layers.square(x), frozen)
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = fluid.gradients([loss], [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+        (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    # grad flows only through square branch: 2x (scale branch cut)
+    np.testing.assert_allclose(gv, 2 * xv, rtol=1e-6)
+
+
+def test_sgd_step_updates_param():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(y)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        p = main.all_parameters()[0]
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.array(fluid.global_scope().find_var(p.name))
+        xv = np.ones((2, 4), dtype="float32")
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w1 = np.array(fluid.global_scope().find_var(p.name))
+    np.testing.assert_allclose(w1, w0 - 0.1 * np.ones((4, 1)), rtol=1e-5)
+
+
+def test_program_clone_for_test_freezes_dropout():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [10])
+        y = fluid.layers.dropout(x, dropout_prob=0.5,
+                                 dropout_implementation="upscale_in_train")
+        test_prog = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.ones((4, 10), dtype="float32")
+        (out_test,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out_test, xv)
+
+
+def test_rng_reproducible_across_programs():
+    def run_once():
+        main = fluid.Program()
+        startup = fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+            w = fluid.layers.create_global_var([4, 4], 0.0, "float32", persistable=True,
+                                               name="w")
+            startup.global_block().create_var(name="seeded", shape=[4, 4],
+                                              dtype="float32", persistable=True)
+            startup.global_block().append_op(
+                "gaussian_random", outputs={"Out": ["seeded"]},
+                attrs={"shape": [4, 4], "dtype": "float32", "mean": 0.0, "std": 1.0})
+            main.global_block().create_var(name="seeded", shape=[4, 4],
+                                           dtype="float32", persistable=True)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return np.array(scope.find_var("seeded"))
+
+    a = run_once()
+    b = run_once()
+    np.testing.assert_allclose(a, b)
+    assert np.abs(a).sum() > 0

@@ -1,0 +1,92 @@
+"""MNIST LeNet convergence smoke — reference parity:
+python/paddle/fluid/tests/book/test_recognize_digits.py (BASELINE config 1).
+
+Uses synthetic separable data (no dataset download in CI); checks the full
+spine: layers → IR → executor → XLA, loss decreasing, accuracy rising.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _make_data(n, seed=0):
+    """Synthetic 'digits': class k = template k + noise."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(10, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, size=n).astype("int64")
+    imgs = templates[labels] + 0.1 * rng.randn(n, 1, 28, 28).astype("float32")
+    return imgs, labels.reshape(-1, 1)
+
+
+def lenet(img, label):
+    conv1 = fluid.layers.conv2d(img, num_filters=6, filter_size=5, padding=2, act="relu")
+    pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = fluid.layers.conv2d(pool1, num_filters=16, filter_size=5, act="relu")
+    pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = fluid.layers.fc(pool2, size=120, act="relu")
+    fc2 = fluid.layers.fc(fc1, size=84, act="relu")
+    logits = fluid.layers.fc(fc2, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    probs = fluid.layers.softmax(logits)
+    acc = fluid.layers.accuracy(probs, label)
+    return avg_loss, acc
+
+
+def test_mnist_lenet_converges():
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 42
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        avg_loss, acc = lenet(img, label)
+        opt = fluid.optimizer.Adam(learning_rate=5e-3)
+        opt.minimize(avg_loss)
+
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+
+        imgs, labels = _make_data(256)
+        bs = 64
+        losses, accs = [], []
+        for epoch in range(12):
+            for i in range(0, len(imgs), bs):
+                lv, av = exe.run(
+                    main,
+                    feed={"img": imgs[i:i + bs], "label": labels[i:i + bs]},
+                    fetch_list=[avg_loss, acc])
+                losses.append(float(lv))
+                accs.append(float(av))
+
+    first = np.mean(losses[:4])
+    last = np.mean(losses[-4:])
+    assert last < first * 0.5, f"loss did not converge: {first} -> {last}"
+    assert np.mean(accs[-4:]) > 0.9, f"accuracy too low: {np.mean(accs[-4:])}"
+
+
+def test_mnist_mlp_infer_matches_train_graph():
+    """clone(for_test) path: inference program shares trained params."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [784])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        h = fluid.layers.fc(img, 64, act="relu")
+        h = fluid.layers.dropout(h, 0.3, dropout_implementation="upscale_in_train")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        test_prog = main.clone(for_test=True)
+        opt = fluid.optimizer.SGD(0.1)
+        opt.minimize(loss)
+
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        x = np.random.rand(8, 784).astype("float32")
+        y = np.random.randint(0, 10, (8, 1)).astype("int64")
+        exe.run(main, feed={"img": x, "label": y}, fetch_list=[loss])
+        # inference is deterministic (dropout off)
+        (a,) = exe.run(test_prog, feed={"img": x, "label": y}, fetch_list=[logits])
+        (b,) = exe.run(test_prog, feed={"img": x, "label": y}, fetch_list=[logits])
+        np.testing.assert_allclose(a, b)

@@ -1,0 +1,279 @@
+"""Per-op numeric checks vs numpy (reference op_test.py check_output pattern)."""
+import numpy as np
+import pytest
+
+from op_test_base import OpTest
+
+
+class TestElementwise(OpTest):
+    def test_add_bcast_axis(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3).astype("float32")
+        self.check_output({"X": x, "Y": y}, {"axis": 1},
+                          {"Out": x + y.reshape(1, 3, 1)})
+
+    def test_mul(self):
+        self.op_type = "elementwise_mul"
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(4, 5).astype("float32")
+        self.check_output({"X": x, "Y": y}, {}, {"Out": x * y})
+
+    def test_div_grad(self):
+        self.op_type = "elementwise_div"
+        x = np.random.rand(3, 4).astype("float32") + 0.5
+        y = np.random.rand(3, 4).astype("float32") + 0.5
+        self.check_grad({"X": x, "Y": y}, {}, grad_input_slot="X")
+        self.check_grad({"X": x, "Y": y}, {}, grad_input_slot="Y")
+
+
+class TestMatmul(OpTest):
+    def test_matmul_transpose(self):
+        self.op_type = "matmul"
+        x = np.random.rand(4, 3).astype("float32")
+        y = np.random.rand(5, 3).astype("float32")
+        self.check_output({"X": x, "Y": y}, {"transpose_Y": True},
+                          {"Out": x @ y.T}, atol=1e-4)
+
+    def test_batched(self):
+        self.op_type = "matmul"
+        x = np.random.rand(2, 4, 3).astype("float32")
+        y = np.random.rand(2, 3, 5).astype("float32")
+        self.check_output({"X": x, "Y": y}, {}, {"Out": x @ y}, atol=1e-4)
+
+    def test_matmul_grad(self):
+        self.op_type = "matmul"
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(4, 2).astype("float32")
+        self.check_grad({"X": x, "Y": y}, {}, grad_input_slot="X")
+
+
+class TestActivations(OpTest):
+    def _run(self, op, ref, x=None, attrs=None):
+        self.op_type = op
+        x = x if x is not None else np.random.rand(3, 4).astype("float32") * 2 - 1
+        self.check_output({"X": x}, attrs or {}, {"Out": ref(x)}, atol=1e-5)
+
+    def test_relu(self):
+        self._run("relu", lambda x: np.maximum(x, 0))
+
+    def test_sigmoid(self):
+        self._run("sigmoid", lambda x: 1 / (1 + np.exp(-x)))
+
+    def test_tanh(self):
+        self._run("tanh", np.tanh)
+
+    def test_gelu(self):
+        from scipy.stats import norm  # pragma: no cover
+        self._run("gelu", lambda x: x * norm.cdf(x))
+
+    def test_leaky_relu(self):
+        self._run("leaky_relu", lambda x: np.where(x > 0, x, 0.1 * x), attrs={"alpha": 0.1})
+
+    def test_relu_grad(self):
+        self.op_type = "tanh"
+        x = np.random.rand(3, 4).astype("float32")
+        self.check_grad({"X": x}, {})
+
+
+class TestSoftmaxCE(OpTest):
+    def test_softmax(self):
+        self.op_type = "softmax"
+        x = np.random.rand(3, 5).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.check_output({"X": x}, {}, {"Out": e / e.sum(-1, keepdims=True)})
+
+    def test_softmax_with_ce(self):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = np.random.rand(4, 6).astype("float32")
+        label = np.random.randint(0, 6, (4, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), label[:, 0]]).reshape(4, 1)
+        got = self.run_op({"Logits": logits, "Label": label}, {},
+                          output_slots=("Loss", "Softmax"))
+        np.testing.assert_allclose(got["Loss"], loss, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(got["Softmax"], sm, atol=1e-5, rtol=1e-4)
+
+
+class TestConvPool(OpTest):
+    def test_conv2d_valid(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")
+        # naive reference conv
+        out = np.zeros((2, 4, 6, 6), dtype="float32")
+        for n in range(2):
+            for f in range(4):
+                for i in range(6):
+                    for j in range(6):
+                        out[n, f, i, j] = np.sum(x[n, :, i:i + 3, j:j + 3] * w[f])
+        got = self.run_op({"Input": x, "Filter": w}, {"strides": [1, 1], "paddings": [0, 0]})
+        np.testing.assert_allclose(got["Out"], out, atol=1e-3, rtol=1e-3)
+
+    def test_pool2d_max(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(1, 2, 4, 4).astype("float32")
+        out = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        got = self.run_op({"X": x}, {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2]})
+        np.testing.assert_allclose(got["Out"], out, rtol=1e-6)
+
+    def test_pool2d_avg(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(1, 2, 4, 4).astype("float32")
+        out = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        got = self.run_op({"X": x}, {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]})
+        np.testing.assert_allclose(got["Out"], out, rtol=1e-5)
+
+    def test_conv2d_grad(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(1, 2, 5, 5).astype("float32")
+        w = np.random.rand(3, 2, 3, 3).astype("float32")
+        self.check_grad({"Input": x, "Filter": w}, {"strides": [1, 1], "paddings": [0, 0]},
+                        grad_input_slot="Filter")
+
+
+class TestNorms(OpTest):
+    def test_layer_norm(self):
+        self.op_type = "layer_norm"
+        x = np.random.rand(4, 10).astype("float32")
+        s = np.random.rand(10).astype("float32")
+        b = np.random.rand(10).astype("float32")
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mean) / np.sqrt(var + 1e-5) * s + b
+        got = self.run_op({"X": x, "Scale": s, "Bias": b},
+                          {"begin_norm_axis": 1, "epsilon": 1e-5},
+                          output_slots=("Y", "Mean", "Variance"))
+        np.testing.assert_allclose(got["Y"], ref, atol=1e-5, rtol=1e-4)
+
+    def test_batch_norm_train_stats(self):
+        self.op_type = "batch_norm"
+        x = np.random.rand(8, 3, 4, 4).astype("float32")
+        scale = np.ones(3, dtype="float32")
+        bias = np.zeros(3, dtype="float32")
+        mean = np.zeros(3, dtype="float32")
+        var = np.ones(3, dtype="float32")
+        got = self.run_op(
+            {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+            {"momentum": 0.9, "epsilon": 1e-5},
+            output_slots=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        ref = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5)
+        np.testing.assert_allclose(got["Y"], ref, atol=1e-4, rtol=1e-3)
+        np.testing.assert_allclose(got["MeanOut"], 0.9 * mean + 0.1 * bm, rtol=1e-4)
+
+
+class TestLookupTable(OpTest):
+    def test_lookup(self):
+        self.op_type = "lookup_table"
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.array([[1], [3], [7]]).astype("int64")
+        self.check_output({"W": w, "Ids": ids}, {}, {"Out": w[[1, 3, 7]]})
+
+    def test_lookup_grad_is_scatter_add(self):
+        import paddle_tpu as fluid
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            block = main.global_block()
+            w = np.random.rand(5, 3).astype("float32")
+            ids = np.array([[1], [1], [2]]).astype("int64")
+            block.create_var(name="w", shape=w.shape, dtype="float32", is_data=True)
+            block.create_var(name="ids", shape=ids.shape, dtype="int64", is_data=True)
+            block.create_var(name="emb", dtype="float32")
+            block.append_op("lookup_table", {"W": ["w"], "Ids": ["ids"]}, {"Out": ["emb"]}, {})
+            emb = block.var("emb")
+            loss = fluid.layers.reduce_sum(emb)
+            (gw,) = fluid.gradients([loss], [block.var("w")])
+            exe = fluid.Executor(fluid.CPUPlace())
+            (gv,) = exe.run(main, feed={"w": w, "ids": ids}, fetch_list=[gw])
+        expected = np.zeros_like(w)
+        expected[1] = 2.0  # two rows point at index 1
+        expected[2] = 1.0
+        np.testing.assert_allclose(gv, expected)
+
+
+class TestReductions(OpTest):
+    def test_reduce_sum_dims(self):
+        self.op_type = "reduce_sum"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.check_output({"X": x}, {"dim": [1]}, {"Out": x.sum(1)})
+
+    def test_reduce_mean_all(self):
+        self.op_type = "reduce_mean"
+        x = np.random.rand(2, 3).astype("float32")
+        self.check_output({"X": x}, {"reduce_all": True}, {"Out": x.mean()})
+
+    def test_topk(self):
+        self.op_type = "top_k"
+        x = np.random.rand(3, 10).astype("float32")
+        got = self.run_op({"X": x}, {"k": 3}, output_slots=("Out", "Indices"))
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(got["Out"], ref, rtol=1e-6)
+
+
+class TestTensorOps(OpTest):
+    def test_reshape_zero_copy_dims(self):
+        self.op_type = "reshape"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.check_output({"X": x}, {"shape": [0, 12]}, {"Out": x.reshape(2, 12)})
+
+    def test_concat(self):
+        self.op_type = "concat"
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(2, 5).astype("float32")
+        self.check_output({"X": [a, b]}, {"axis": 1}, {"Out": np.concatenate([a, b], 1)})
+
+    def test_transpose(self):
+        self.op_type = "transpose"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.check_output({"X": x}, {"axis": [2, 0, 1]}, {"Out": x.transpose(2, 0, 1)})
+
+    def test_pad(self):
+        self.op_type = "pad"
+        x = np.random.rand(2, 3).astype("float32")
+        self.check_output({"X": x}, {"paddings": [1, 0, 0, 2], "pad_value": 1.0},
+                          {"Out": np.pad(x, [(1, 0), (0, 2)], constant_values=1.0)})
+
+    def test_gather(self):
+        self.op_type = "gather"
+        x = np.random.rand(5, 3).astype("float32")
+        idx = np.array([0, 4, 2]).astype("int64")
+        self.check_output({"X": x, "Index": idx}, {}, {"Out": x[[0, 4, 2]]})
+
+    def test_split_sections(self):
+        self.op_type = "split"
+        x = np.random.rand(2, 9).astype("float32")
+        got = self.run_op({"X": x}, {"sections": [2, 3, 4], "axis": 1},
+                          output_slots=("Out",), multi_output_counts={"Out": 3})
+        np.testing.assert_allclose(got["Out"][0], x[:, :2])
+        np.testing.assert_allclose(got["Out"][1], x[:, 2:5])
+        np.testing.assert_allclose(got["Out"][2], x[:, 5:])
+
+
+class TestOptimizerOps(OpTest):
+    def test_adam_math(self):
+        import paddle_tpu as fluid
+        rng = np.random.RandomState(7)
+        p = rng.rand(4).astype("float32")
+        g = rng.rand(4).astype("float32") + 0.1
+        m = np.zeros(4, dtype="float32")
+        v = np.zeros(4, dtype="float32")
+        b1p = np.array([0.9], dtype="float32")
+        b2p = np.array([0.999], dtype="float32")
+        lr = np.array([0.01], dtype="float32")
+        got = self.run_op_raw = None
+        import paddle_tpu.ops as ops
+        import jax.numpy as jnp
+        out = ops.eager_call("adam", {
+            "Param": [jnp.asarray(p)], "Grad": [jnp.asarray(g)],
+            "Moment1": [jnp.asarray(m)], "Moment2": [jnp.asarray(v)],
+            "Beta1Pow": [jnp.asarray(b1p)], "Beta2Pow": [jnp.asarray(b2p)],
+            "LearningRate": [jnp.asarray(lr)]}, {})
+        m_ref = 0.1 * g
+        v_ref = 0.001 * g * g
+        lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        p_ref = p - lr_t * m_ref / (np.sqrt(v_ref) + 1e-8)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), p_ref, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["Moment1Out"][0]), m_ref, rtol=1e-4, atol=1e-7)
