@@ -22,6 +22,7 @@ from . import layers  # noqa: F401
 from . import clip  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import dygraph  # noqa: F401
 from .core import (  # noqa: F401
     Block,
     BuildStrategy,

@@ -1,0 +1,163 @@
+"""Dataset — file-list-sharded bulk training input.
+
+Reference analog: ``python/paddle/fluid/dataset.py`` (DatasetFactory,
+InMemoryDataset:269 with load_into_memory/local_shuffle/global_shuffle,
+QueueDataset:613 streaming) over the C++ MultiSlotDataFeed/Dataset
+(framework/data_set.cc, data_feed.cc).
+
+TPU-native: the native C++ loader (paddle_tpu/native) does threaded file
+parsing into a blocking queue; global shuffle across hosts becomes
+shard-by-hash on sample index (jax.process_index()) instead of fleet RPC
+record routing.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .native import NativeDataLoader
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._slots: List[str] = []
+        self._slot_types: str = ""
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var_names: List[str] = []
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = thread_num
+
+    def set_use_var(self, var_list):
+        self._use_var_names = [v.name for v in var_list]
+        types = []
+        for v in var_list:
+            import jax.numpy as jnp
+            types.append("i" if jnp.issubdtype(v.dtype, jnp.integer) else "f")
+        self._slot_types = "".join(types)
+
+    def set_pipe_command(self, cmd: str):
+        # pipe_command preprocessing (data_feed pipe) — files are expected
+        # pre-processed in the TPU build; kept for API compat
+        self._pipe_command = cmd
+
+    def _make_loader(self) -> NativeDataLoader:
+        return NativeDataLoader(self._filelist, self._slot_types,
+                                num_threads=self._thread_num)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (dataset.py:613): iterate batches straight from the
+    native loader queue."""
+
+    def batches(self):
+        loader = self._make_loader()
+        batch: List = []
+        for sample in loader:
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch:
+            yield self._collate(batch)
+        loader.close()
+
+    def _collate(self, samples) -> Dict[str, np.ndarray]:
+        out = {}
+        for i, name in enumerate(self._use_var_names):
+            cols = [s[i] for s in samples]
+            maxlen = max(len(c) for c in cols)
+            if all(len(c) == maxlen for c in cols):
+                out[name] = np.stack(cols)
+            else:
+                arr = np.zeros((len(cols), maxlen), dtype=cols[0].dtype)
+                lens = np.zeros(len(cols), dtype="int64")
+                for j, c in enumerate(cols):
+                    arr[j, :len(c)] = c
+                    lens[j] = len(c)
+                out[name] = arr
+                out[name + "_len"] = lens
+        return out
+
+
+class InMemoryDataset(QueueDataset):
+    """dataset.py:269 parity: load once, shuffle in memory, iterate."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: Optional[List] = None
+
+    def load_into_memory(self):
+        loader = self._make_loader()
+        self._memory = list(loader)
+        loader.close()
+
+    def local_shuffle(self):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        """Reference routes records between trainers via fleet RPC
+        (data_set.cc GlobalShuffle). TPU-native: each host keeps the hash-mod
+        shard of a deterministic permutation — no network hop, same
+        statistical effect. Sharding happens once; subsequent calls reshuffle
+        the local shard with an epoch-varied seed."""
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        import jax
+        try:
+            nranks = jax.process_count()
+            rank = jax.process_index()
+        except Exception:
+            nranks, rank = 1, 0
+        self._shuffle_epoch = getattr(self, "_shuffle_epoch", 0) + 1
+        rng = random.Random(12345 + self._shuffle_epoch)
+        if not getattr(self, "_sharded", False):
+            order = list(range(len(self._memory)))
+            rng.shuffle(order)
+            self._memory = [self._memory[i] for i in order if i % nranks == rank]
+            self._sharded = True
+        else:
+            rng.shuffle(self._memory)
+
+    def release_memory(self):
+        self._memory = None
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._memory or [])
+
+    def batches(self):
+        if self._memory is None:
+            yield from super().batches()
+            return
+        for i in range(0, len(self._memory), self._batch_size):
+            yield self._collate(self._memory[i:i + self._batch_size])
+
+
+class FileInstantDataset(QueueDataset):
+    pass
+
+
+class DatasetFactory:
+    """dataset.py DatasetFactory parity."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        classes = {
+            "QueueDataset": QueueDataset,
+            "InMemoryDataset": InMemoryDataset,
+            "FileInstantDataset": FileInstantDataset,
+        }
+        if datafeed_class not in classes:
+            raise ValueError(f"unknown dataset class {datafeed_class}")
+        return classes[datafeed_class]()

@@ -1,0 +1,30 @@
+"""Dygraph — eager imperative mode.
+
+Reference analog: ``paddle/fluid/imperative/`` (Tracer tracer.cc:35, VarBase
+layer.h:55, BasicEngine engine.cc:42) + ``python/paddle/fluid/dygraph/``.
+
+TPU-native: ops execute eagerly on jax.Arrays through the same registered op
+implementations as the static graph (one kernel library, two frontends —
+mirroring PreparedOp sharing the static kernel registry). Autograd is an
+eager jax.vjp tape; `loss.backward()` walks it in reverse. For production
+speed, `dygraph.jit` compiles a Layer's forward into one XLA computation
+(the analog of the reference's missing-but-planned dygraph-to-static).
+"""
+from .base import enabled, guard, no_grad, to_variable  # noqa: F401
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .jit import jit  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Embedding,
+    FC,
+    GRUUnit,
+    LayerNorm,
+    Linear,
+    Pool2D,
+    PRelu,
+)
+from .parallel import DataParallel, prepare_context  # noqa: F401
+from .tracer import Tracer  # noqa: F401
+from .varbase import VarBase  # noqa: F401

@@ -1,0 +1,60 @@
+"""dygraph.guard / to_variable / no_grad (reference dygraph/base.py)."""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from ..core import program as prog_mod
+from .tracer import Tracer, _active_tracer, _set_tracer
+from .varbase import VarBase
+from . import math_ops_patch  # noqa: F401  (attaches dunders to VarBase)
+
+
+def enabled() -> bool:
+    return _active_tracer() is not None
+
+
+@contextlib.contextmanager
+def guard(place=None, seed: int = 0):
+    tracer = Tracer(seed=seed)
+    old = _active_tracer()
+    _set_tracer(tracer)
+    prog_mod._set_dygraph_tracer(tracer)
+    try:
+        yield
+    finally:
+        _set_tracer(old)
+        prog_mod._set_dygraph_tracer(old)
+
+
+def to_variable(value, name=None, zero_copy=None) -> VarBase:
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    # feed data is a leaf the user may query grads on
+    return VarBase(arr, name=name, stop_gradient=True)
+
+
+class no_grad:
+    """Context manager AND decorator disabling autograd taping."""
+
+    def __enter__(self):
+        tr = _active_tracer()
+        if tr is not None:
+            tr._no_grad_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        tr = _active_tracer()
+        if tr is not None:
+            tr._no_grad_depth -= 1
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+        return wrapper

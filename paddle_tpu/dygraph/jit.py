@@ -1,0 +1,70 @@
+"""dygraph.jit — compile an eager Layer's forward into one XLA computation.
+
+The reference's per-op dygraph dispatch (PreparedOp) pays per-kernel launch
+cost; here the escape hatch is whole-function jit: parameters are lifted to a
+pytree, the forward re-traced functionally, XLA fuses end-to-end. This is the
+capability the reference lacked (dygraph-to-static landed later upstream) and
+the TPU-native answer to SURVEY §7 hard-part 4.
+
+Usage::
+
+    model = MyLayer()
+    fast = dygraph.jit(model)
+    out = fast(x_varbase_or_array)      # same params, compiled path
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .varbase import VarBase
+
+
+def jit(layer: Layer, static_argnums=()):
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+
+    def _functional(param_vals: Dict[str, jax.Array],
+                    buffer_vals: Dict[str, jax.Array], *args):
+        # temporarily swap values into the live VarBases and trace eagerly;
+        # under jax.jit the "eager" ops become traced ops in one graph
+        old_p = {k: p.value for k, p in params.items()}
+        old_b = {k: b.value for k, b in buffers.items()}
+        try:
+            for k, p in params.items():
+                p.value = param_vals[k]
+            for k, b in buffers.items():
+                b.value = buffer_vals[k]
+            vargs = [a if isinstance(a, VarBase) else VarBase(a, stop_gradient=True)
+                     for a in args]
+            from .base import no_grad
+            with no_grad():  # inference path: no tape inside the jit trace
+                out = layer(*vargs)
+            out_val = jax.tree_util.tree_map(
+                lambda o: o.value if isinstance(o, VarBase) else o, out,
+                is_leaf=lambda o: isinstance(o, VarBase))
+            new_b = {k: b.value for k, b in buffers.items()}
+            return out_val, new_b
+        finally:
+            for k, p in params.items():
+                p.value = old_p[k]
+            for k, b in buffers.items():
+                b.value = old_b[k]
+
+    compiled = jax.jit(_functional, static_argnums=tuple(2 + i for i in static_argnums))
+
+    def wrapper(*args):
+        arg_vals = [a.value if isinstance(a, VarBase) else jnp.asarray(a) for a in args]
+        out_val, new_b = compiled({k: p.value for k, p in params.items()},
+                                  {k: b.value for k, b in buffers.items()},
+                                  *arg_vals)
+        for k, b in buffers.items():
+            b.value = new_b[k]
+        return jax.tree_util.tree_map(
+            lambda v: VarBase(v, stop_gradient=True), out_val)
+
+    wrapper._compiled = compiled
+    return wrapper

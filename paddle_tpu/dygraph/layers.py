@@ -1,0 +1,167 @@
+"""dygraph.Layer — module base class (reference dygraph/layers.py)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import unique_name
+from ..core.dtypes import convert_dtype
+from ..initializer import ConstantInitializer, XavierInitializer
+from ..param_attr import ParamAttr
+from .tracer import _active_tracer
+from .varbase import VarBase
+
+
+def _run_initializer(init, shape, dtype, seed_key):
+    """Run a static-graph Initializer eagerly: build a one-op block and
+    execute it (same init op impls as the startup program)."""
+    from ..core.executor import ExecContext, _run_block
+    from ..core.program import Program
+
+    prog = Program()
+    blk = prog.global_block()
+    v = blk.create_var(name="out", shape=list(shape), dtype=convert_dtype(dtype))
+    init(v, blk)
+    env: Dict[str, object] = {}
+    ctx = ExecContext(seed_key)
+    _run_block(blk, env, ctx)
+    return env["out"]
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            (name_scope or type(self).__name__.lower()))
+        self._dtype = dtype
+        self._parameters: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+        self.training = True
+        self._init_key = jax.random.PRNGKey(abs(hash(self._full_name)) % (2 ** 31))
+
+    def full_name(self) -> str:
+        return self._full_name
+
+    # -- parameter management ---------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype="float32", is_bias=False,
+                         default_initializer=None) -> VarBase:
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:
+            return None
+        if default_initializer is None:
+            default_initializer = (ConstantInitializer(0.0) if is_bias
+                                   else XavierInitializer())
+        init = attr.initializer or default_initializer
+        self._init_key, sub = jax.random.split(self._init_key)
+        value = _run_initializer(init, shape, dtype, sub)
+        name = attr.name or unique_name.generate(
+            self._full_name + (".b" if is_bias else ".w"))
+        p = VarBase(value, name=name, stop_gradient=False, persistable=True)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def register_buffer(self, name: str, value) -> VarBase:
+        vb = value if isinstance(value, VarBase) else VarBase(
+            value, stop_gradient=True, persistable=True)
+        self._buffers[name] = vb
+        return vb
+
+    def add_parameter(self, name: str, param: VarBase) -> VarBase:
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name: str, layer: "Layer") -> "Layer":
+        self._sub_layers[name] = layer
+        return layer
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and getattr(value, "persistable", False):
+            params = self.__dict__.get("_parameters")
+            if params is not None and not value.stop_gradient:
+                params[name] = value
+            bufs = self.__dict__.get("_buffers")
+            if bufs is not None and value.stop_gradient:
+                bufs[name] = value
+        elif isinstance(value, Layer):
+            subs = self.__dict__.get("_sub_layers")
+            if subs is not None:
+                subs[name] = value
+        object.__setattr__(self, name, value)
+
+    def parameters(self, include_sublayers: bool = True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, VarBase]]:
+        for k, v in self._parameters.items():
+            yield (f"{prefix}.{k}" if prefix else k), v
+        for lk, l in self._sub_layers.items():
+            yield from l.named_parameters(f"{prefix}.{lk}" if prefix else lk)
+
+    def named_buffers(self, prefix="") -> Iterator[Tuple[str, VarBase]]:
+        for k, v in self._buffers.items():
+            yield (f"{prefix}.{k}" if prefix else k), v
+        for lk, l in self._sub_layers.items():
+            yield from l.named_buffers(f"{prefix}.{lk}" if prefix else lk)
+
+    def sublayers(self, include_self: bool = False):
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            out.extend(l.sublayers(include_self=True))
+        return out
+
+    # -- modes -------------------------------------------------------------
+    def train(self):
+        self.training = True
+        tr = _active_tracer()
+        if tr is not None:
+            tr.train_mode()
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        tr = _active_tracer()
+        if tr is not None:
+            tr.eval_mode()
+        for l in self._sub_layers.values():
+            l.eval()
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, p in self.named_parameters():
+            out[name] = p.numpy()
+        for name, b in self.named_buffers():
+            out[name] = b.numpy()
+        return out
+
+    def set_dict(self, state: Dict[str, np.ndarray]):
+        for name, p in self.named_parameters():
+            if name in state:
+                p.value = jnp.asarray(state[name])
+        for name, b in self.named_buffers():
+            if name in state:
+                b.value = jnp.asarray(state[name])
+
+    load_dict = set_dict
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError

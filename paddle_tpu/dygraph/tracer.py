@@ -1,0 +1,171 @@
+"""Eager tracer + autograd engine.
+
+Reference analog: Tracer::TraceOp (imperative/tracer.cc:35 — run kernel, then
+TraceBackward records grad ops) and BasicEngine (engine.cc:42,112,157 —
+topo-sorted grad execution with GradientAccumulator).
+
+Here TraceOp = run the registered JAX impl under jax.vjp and push a tape
+entry; run_backward = reverse tape walk accumulating cotangents into
+VarBase.grad_value. Ops execute on device eagerly (async dispatch — JAX
+queues XLA executions without host sync, the dygraph analog of CUDA-stream
+async kernels).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import registry
+from ..core.executor import ExecContext, _zero_cotangent
+from .varbase import VarBase
+
+
+def _zero_aval_cotangent(aval):
+    shape, dtype = aval
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+import weakref
+
+
+class _TapeEntry:
+    """Outputs are weakly referenced: once no downstream op or user variable
+    holds an output, the entry is prunable — the refcount-based graph freeing
+    of the reference's autograd (VarBase grad-op chains) without cycles."""
+    __slots__ = ("in_vars", "out_refs", "out_avals", "vjp_fn")
+
+    def __init__(self, in_vars, out_vars, vjp_fn):
+        self.in_vars = in_vars  # list of (VarBase, nondiff: bool)
+        self.out_refs = [weakref.ref(v) for v in out_vars]
+        self.out_avals = [(v.value.shape, v.value.dtype) for v in out_vars]
+        self.vjp_fn = vjp_fn
+
+    def dead(self) -> bool:
+        return all(r() is None for r in self.out_refs)
+
+
+class Tracer:
+    def __init__(self, train_mode: bool = True, seed: int = 0):
+        self.tape: List[_TapeEntry] = []
+        self._train_mode = train_mode
+        self._no_grad_depth = 0
+        self._ctx = ExecContext(jax.random.PRNGKey(seed))
+
+    @property
+    def grad_enabled(self) -> bool:
+        return self._train_mode and self._no_grad_depth == 0
+
+    def train_mode(self):
+        self._train_mode = True
+
+    def eval_mode(self):
+        self._train_mode = False
+
+    def reset(self):
+        self.tape = []
+
+    # -- op dispatch -------------------------------------------------------
+    def trace_op(self, op_type: str, inputs: Dict[str, List[VarBase]],
+                 attrs: Optional[Dict] = None) -> Dict[str, List[VarBase]]:
+        attrs = attrs or {}
+        opdef = registry.get_op(op_type)
+        self._ctx.is_test = not self._train_mode
+
+        need_grad = (self.grad_enabled and opdef.differentiable
+                     and any(not v.stop_gradient for vs in inputs.values() for v in vs))
+        if not need_grad:
+            in_vals = {s: [v.value for v in vs] for s, vs in inputs.items()}
+            out = opdef.fn(self._ctx, in_vals, attrs)
+            return {s: [VarBase(v, stop_gradient=True) for v in vs]
+                    for s, vs in out.items()}
+
+        in_slots = sorted(inputs)
+        in_counts = [len(inputs[s]) for s in in_slots]
+        flat_in_vars = [v for s in in_slots for v in inputs[s]]
+        out_struct: List[Tuple[str, int]] = []  # (slot, count) recorded in fn
+
+        def fn(*flat):
+            pos = 0
+            ins = {}
+            for s, c in zip(in_slots, in_counts):
+                ins[s] = list(flat[pos:pos + c])
+                pos += c
+            out = opdef.fn(self._ctx, ins, attrs)
+            out_struct.clear()
+            out_struct.extend((s, len(out[s])) for s in sorted(out))
+            return tuple(v for s, _ in out_struct for v in out[s])
+
+        flat_out, vjp_fn = jax.vjp(fn, *[v.value for v in flat_in_vars])
+
+        outs: Dict[str, List[VarBase]] = {}
+        out_vars: List[VarBase] = []
+        i = 0
+        for slot, n in out_struct:
+            outs[slot] = []
+            for v in flat_out[i:i + n]:
+                vb = VarBase(v, stop_gradient=False)
+                outs[slot].append(vb)
+                out_vars.append(vb)
+            i += n
+
+        nondiff_ids = set()
+        for slot in opdef.nondiff_inputs:
+            nondiff_ids.update(id(v) for v in inputs.get(slot, []))
+        self.tape.append(_TapeEntry(
+            [(v, id(v) in nondiff_ids) for v in flat_in_vars], out_vars, vjp_fn))
+        # amortized GC: forward-only loops (eval without no_grad) must not pin
+        # every activation forever
+        if len(self.tape) % 512 == 0:
+            self.tape = [e for e in self.tape if not e.dead()]
+        return outs
+
+    # -- backward (BasicEngine parity) --------------------------------------
+    def run_backward(self, loss: VarBase, retain_graph: bool = False):
+        vcots: Dict[int, object] = {id(loss): jnp.ones_like(loss.value)}
+        for entry in reversed(self.tape):
+            out_vars = [r() for r in entry.out_refs]
+            if not any(v is not None and id(v) in vcots for v in out_vars):
+                continue
+            out_cots = tuple(
+                vcots[id(v)] if v is not None and id(v) in vcots
+                else _zero_aval_cotangent(aval)
+                for v, aval in zip(out_vars, entry.out_avals))
+            in_cots = entry.vjp_fn(out_cots)
+            for (var, nondiff), g in zip(entry.in_vars, in_cots):
+                if g is None or nondiff or var.stop_gradient:
+                    continue
+                if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                    continue
+                prev = vcots.get(id(var))
+                vcots[id(var)] = g if prev is None else prev + g
+                # GradientAccumulator parity: sum into .grad on every var
+                # that requires grad (params AND user inputs)
+                var.grad_value = (g if var.grad_value is None
+                                  else var.grad_value + g)
+        if not retain_graph:
+            self.tape = []
+
+
+_tracer: Optional[Tracer] = None
+
+
+def _active_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def _set_tracer(t: Optional[Tracer]):
+    global _tracer
+    _tracer = t
+
+
+def trace_op(op_type, inputs, attrs=None):
+    tr = _active_tracer()
+    if tr is None:
+        raise RuntimeError(
+            f"op {op_type} called in dygraph style outside dygraph.guard()")
+    return tr.trace_op(op_type, inputs, attrs)

@@ -1,0 +1,61 @@
+"""VarBase — eager tensor with autograd linkage (imperative/layer.h:55)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import unique_name
+
+
+class VarBase:
+    def __init__(self, value, name: Optional[str] = None, stop_gradient: bool = False,
+                 persistable: bool = False):
+        self.value = value if isinstance(value, jax.Array) else jnp.asarray(value)
+        self.name = name or unique_name.generate("dy_var")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad_value = None  # accumulated cotangent (jax array)
+        self.trainable = not stop_gradient
+
+    # -- paddle api --------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    @property
+    def gradient(self):
+        return None if self.grad_value is None else np.asarray(self.grad_value)
+
+    def clear_gradient(self):
+        self.grad_value = None
+
+    def detach(self) -> "VarBase":
+        return VarBase(self.value, stop_gradient=True)
+
+    def backward(self, backward_strategy=None):
+        from .tracer import _active_tracer
+        tr = _active_tracer()
+        if tr is None:
+            raise RuntimeError("backward() outside dygraph guard")
+        tr.run_backward(self)
+
+    def astype(self, dtype):
+        from . import math_ops_patch  # noqa: F401
+        from ..ops import eager
+        from .tracer import trace_op
+        return trace_op("cast", {"X": [self]}, {"out_dtype": str(np.dtype(dtype))})["Out"][0]
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape}, stop_gradient={self.stop_gradient})\n{self.numpy()}"
+
+    # math dunders are attached by math_ops_patch (imported in base.guard)

@@ -1,0 +1,138 @@
+"""Checkpointing + inference-model serialization.
+
+Reference analog: ``python/paddle/fluid/io.py`` — save_vars:128,
+save_persistables:487, load_vars:537, load_persistables:726,
+save_inference_model:933, load_inference_model:1113 (backed by save_op.cc /
+load_op.cc streaming each var to disk).
+
+TPU-native: vars are pulled from the Scope as host arrays and written as one
+pickle bundle (save_combine_op.cc analog) or per-var files; the inference
+program serializes via Program.to_dict (the protobuf ProgramDesc analog).
+Sharded/async checkpointing for the multi-host case lives in
+parallel/checkpoint.py (orbax-style; reference had none — SURVEY §5).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.program import Program, Variable, default_main_program
+from .core.scope import Scope, _scope
+
+
+def _persistable_vars(program: Program):
+    return [v for v in program.list_vars()
+            if v.persistable and not v.name.startswith("@")]
+
+
+def save_vars(executor, dirname: str, main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None, predicate=None,
+              filename: Optional[str] = None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if (predicate or (lambda v: v.persistable))(v)]
+    scope = _scope()
+    os.makedirs(dirname, exist_ok=True)
+    bundle = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        bundle[v.name] = np.asarray(val)
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(bundle, f, protocol=4)
+    else:
+        for name, arr in bundle.items():
+            with open(os.path.join(dirname, name.replace("/", "_")), "wb") as f:
+                pickle.dump({name: arr}, f, protocol=4)
+
+
+def save_persistables(executor, dirname: str, main_program: Optional[Program] = None,
+                      filename: Optional[str] = None):
+    """io.py:487 parity."""
+    main_program = main_program or default_main_program()
+    save_vars(executor, dirname, main_program,
+              vars=_persistable_vars(main_program), filename=filename)
+
+
+save_params = save_persistables
+
+
+def load_vars(executor, dirname: str, main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None, predicate=None,
+              filename: Optional[str] = None):
+    import jax.numpy as jnp
+
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if (predicate or (lambda v: v.persistable))(v)]
+    scope = _scope()
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            bundle = pickle.load(f)
+    else:
+        bundle = {}
+        for v in vars:
+            p = os.path.join(dirname, v.name.replace("/", "_"))
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    bundle.update(pickle.load(f))
+    missing = []
+    for v in vars:
+        if v.name in bundle:
+            scope.set_var(v.name, jnp.asarray(bundle[v.name]))
+        else:
+            missing.append(v.name)
+    return missing
+
+
+def load_persistables(executor, dirname: str, main_program: Optional[Program] = None,
+                      filename: Optional[str] = None):
+    """io.py:726 parity."""
+    main_program = main_program or default_main_program()
+    return load_vars(executor, dirname, main_program,
+                     vars=_persistable_vars(main_program), filename=filename)
+
+
+load_params = load_persistables
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable], executor,
+                         main_program: Optional[Program] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None,
+                         export_for_deployment: bool = True):
+    """io.py:933 parity: prune to feed→fetch, save program + params."""
+    main_program = main_program or default_main_program()
+    fetch_names = [t.name for t in target_vars]
+    pruned = main_program._prune_for_inference(feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "program": pruned.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"), "w") as f:
+        json.dump(model, f)
+    save_vars(executor, dirname, pruned, vars=_persistable_vars(pruned),
+              filename=params_filename or "__params__")
+    return fetch_names
+
+
+def load_inference_model(dirname: str, executor,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
+    """io.py:1113 parity: returns (program, feed_names, fetch_vars)."""
+    with open(os.path.join(dirname, model_filename or "__model__")) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    load_vars(executor, dirname, program, vars=_persistable_vars(program),
+              filename=params_filename or "__params__")
+    fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
+    return program, model["feed_names"], fetch_vars

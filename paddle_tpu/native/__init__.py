@@ -1,0 +1,158 @@
+"""ctypes bindings for the native runtime (lazy-built via make).
+
+Reference analog: the pybind `core` module surface for DataFeed/
+LoDTensorBlockingQueue (pybind/reader_py.cc, data_set_py.cc). Falls back to
+pure-Python implementations when no C++ toolchain is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libpaddle_tpu_native.so")
+_SRC = os.path.join(_DIR, "src", "dataloader.cc")
+
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _ensure_built():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(["make", "-C", _DIR], check=True,
+                           capture_output=True, text=True)
+        lib = ctypes.CDLL(_SO)
+        lib.ptdl_create.restype = ctypes.c_void_p
+        lib.ptdl_create.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                                    ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.ptdl_next.restype = ctypes.c_longlong
+        lib.ptdl_next.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong]
+        lib.ptdl_queue_size.restype = ctypes.c_longlong
+        lib.ptdl_queue_size.argtypes = [ctypes.c_void_p]
+        lib.ptdl_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptq_create.restype = ctypes.c_void_p
+        lib.ptq_create.argtypes = [ctypes.c_int]
+        lib.ptq_push.restype = ctypes.c_int
+        lib.ptq_push.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                                 ctypes.c_longlong]
+        lib.ptq_pop.restype = ctypes.c_longlong
+        lib.ptq_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.c_longlong]
+        lib.ptq_close.argtypes = [ctypes.c_void_p]
+        lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception as e:  # no toolchain / build failure → python fallback
+        _build_error = str(e)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _ensure_built() is not None
+
+
+def build_error() -> Optional[str]:
+    _ensure_built()
+    return _build_error
+
+
+def _decode_sample(buf: np.ndarray) -> List[np.ndarray]:
+    """Decode the wire format (see dataloader.cc) into per-slot arrays."""
+    out = []
+    mv = memoryview(buf)
+    num_slots = int(np.frombuffer(mv[:4], dtype="<u4")[0])
+    off = 4
+    for _ in range(num_slots):
+        dtype = mv[off]
+        off += 1
+        n = int(np.frombuffer(mv[off:off + 4], dtype="<u4")[0])
+        off += 4
+        if dtype == 0:
+            arr = np.frombuffer(mv[off:off + 4 * n], dtype="<f4").copy()
+            off += 4 * n
+        else:
+            arr = np.frombuffer(mv[off:off + 8 * n], dtype="<i8").copy()
+            off += 8 * n
+        out.append(arr)
+    return out
+
+
+class NativeDataLoader:
+    """Multi-threaded MultiSlot file loader (data_feed.cc analog)."""
+
+    MAX_SAMPLE = 1 << 22  # 4 MiB per sample
+
+    def __init__(self, files: Sequence[str], slot_types: str,
+                 num_threads: int = 4, capacity: int = 1024):
+        lib = _ensure_built()
+        self._lib = lib
+        self._files = list(files)
+        self._slot_types = slot_types
+        self._handle = None
+        if lib is not None:
+            arr = (ctypes.c_char_p * len(self._files))(
+                *[f.encode() for f in self._files])
+            self._handle = lib.ptdl_create(arr, len(self._files),
+                                           slot_types.encode(), num_threads,
+                                           capacity)
+            self._buf = np.empty(self.MAX_SAMPLE, dtype=np.uint8)
+        else:
+            self._py_iter = self._python_reader()
+
+    def _python_reader(self):
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    out, i, ok = [], 0, True
+                    for t in self._slot_types:
+                        if i >= len(parts):
+                            ok = False
+                            break
+                        n = int(parts[i])
+                        i += 1
+                        vals = parts[i:i + n]
+                        i += n
+                        if len(vals) != n:
+                            ok = False
+                            break
+                        out.append(np.asarray(vals, dtype="float32" if t == "f" else "int64"))
+                    if ok:
+                        yield out
+
+    def __iter__(self):
+        if self._handle is None:
+            yield from self._py_iter
+            return
+        lib = self._lib
+        buf_ptr = self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        while True:
+            n = lib.ptdl_next(self._handle, buf_ptr, self.MAX_SAMPLE)
+            if n == 0:
+                break
+            if n < 0:
+                continue  # oversized sample dropped
+            yield _decode_sample(self._buf[:n])
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ptdl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -105,11 +105,134 @@ class Optimizer:
     def apply_optimize(self, loss, startup_program, params_grads):
         return self.apply_gradients(params_grads)
 
-    def minimize(self, loss: Variable, startup_program=None, parameter_list=None,
+    def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None) -> Tuple[List, List]:
+        from .core.program import in_dygraph_mode
+        if in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph path --------------------------------------------------------
+    # Reuses the per-class static op emission on a scratch Program executed
+    # eagerly: the scratch program IS the optimizer step (one op per param +
+    # accumulator updates), the dygraph analog of apply_gradients. Reference
+    # parity: dygraph optimizers share op kernels with static mode
+    # (imperative/prepared_operator.h).
+    def _dygraph_setup(self, params):
+        from .core.executor import ExecContext, _run_block
+        from .core.program import Program, grad_var_name, program_guard
+        import jax
+
+        self._dy_prog = Program()
+        dy_startup = Program()
+        with program_guard(self._dy_prog, dy_startup):
+            block = self._dy_prog.global_block()
+            pvars = []
+            for p in params:
+                pv = block.create_parameter(name=p.name, shape=list(p.shape),
+                                            dtype=p.dtype, trainable=True)
+                pv.regularizer = getattr(p, "regularizer", None)
+                pv.need_clip = getattr(p, "need_clip", True)
+                block.create_var(name=grad_var_name(p.name), shape=list(p.shape),
+                                 dtype=p.dtype)
+                pvars.append(pv)
+            # same pipeline as static apply_gradients: clip → regularize → update
+            params_grads = [(pv, block.var(grad_var_name(pv.name))) for pv in pvars]
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            params_grads = append_regularization_ops(params_grads, self.regularization)
+            self._create_global_learning_rate()
+            self._create_accumulators(block, [pg[0] for pg in params_grads])
+            for pg in params_grads:
+                self._append_optimize_op(block, pg)
+        # init accumulators/lr by running the scratch startup program eagerly
+        env = {}
+        ctx = ExecContext(jax.random.PRNGKey(0))
+        _run_block(dy_startup.global_block(), env, ctx)
+        # param-list change (e.g. unfreezing): keep accumulated state for
+        # params that persist across rebuilds
+        old_env = getattr(self, "_dy_env", None)
+        if old_env:
+            for k, v in old_env.items():
+                if k in env:
+                    env[k] = v
+        self._dy_env = env
+        self._dy_param_names = tuple(sorted(p.name for p in params))
+
+    def set_lr(self, value: float):
+        """Update the learning rate (works in both modes)."""
+        import jax.numpy as jnp
+        from .core.scope import global_scope
+        if getattr(self, "_dy_env", None) is not None and self._lr_var is not None:
+            self._dy_env[self._lr_var.name] = jnp.asarray([float(value)], dtype=jnp.float32)
+        elif self._lr_var is not None:
+            global_scope().set_var(self._lr_var.name,
+                                   jnp.asarray([float(value)], dtype=jnp.float32))
+        else:
+            self._learning_rate = float(value)
+
+    def state_dict(self):
+        """Optimizer state for checkpointing (dygraph: the scratch env;
+        static: accumulator vars from the scope)."""
+        import numpy as np
+        if getattr(self, "_dy_env", None) is not None:
+            d = {k: np.asarray(v) for k, v in self._dy_env.items()}
+        else:
+            from .core.scope import global_scope
+            scope = global_scope()
+            d = {}
+            for (name, pname), acc in self._accumulators.items():
+                v = scope.find_var(acc.name)
+                if v is not None:
+                    d[acc.name] = np.asarray(v)
+        d["@optimizer_state@"] = np.asarray(1)
+        return d
+
+    def set_state_dict(self, state):
+        import jax.numpy as jnp
+        state = {k: v for k, v in state.items() if k != "@optimizer_state@"}
+        if getattr(self, "_dy_env", None) is not None:
+            for k, v in state.items():
+                self._dy_env[k] = jnp.asarray(v)
+        else:
+            from .core.scope import global_scope
+            scope = global_scope()
+            for k, v in state.items():
+                scope.set_var(k, jnp.asarray(v))
+
+    load_state_dict = set_state_dict
+
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        from .core.executor import ExecContext, _run_block
+        from .core.program import grad_var_name
+        from .dygraph.tracer import _active_tracer
+        import jax
+
+        params = list(parameter_list if parameter_list is not None
+                      else getattr(self, "_parameter_list", None) or [])
+        if not params:
+            raise ValueError(
+                "dygraph minimize needs parameter_list (pass model.parameters())")
+        tr = _active_tracer()
+        if tr is not None and tr.tape:
+            tr.run_backward(loss)
+        names = tuple(sorted(p.name for p in params))
+        if (getattr(self, "_dy_prog", None) is None
+                or getattr(self, "_dy_param_names", None) != names):
+            self._dygraph_setup(params)
+        import jax.numpy as jnp
+        env = self._dy_env
+        for p in params:
+            env[p.name] = p.value
+            env[grad_var_name(p.name)] = (p.grad_value if p.grad_value is not None
+                                          else jnp.zeros_like(p.value))
+        ctx = ExecContext(jax.random.PRNGKey(0))
+        _run_block(self._dy_prog.global_block(), env, ctx)
+        for p in params:
+            p.value = env[p.name]
+        return [], [(p, p.grad_value) for p in params]
 
 
 class SGDOptimizer(Optimizer):

