@@ -23,6 +23,11 @@ from . import clip  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import dygraph  # noqa: F401
+from . import io  # noqa: F401
+from . import parallel  # noqa: F401
+from . import reader as py_reader_module  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .reader import PyReader  # noqa: F401
 from .core import (  # noqa: F401
     Block,
     BuildStrategy,

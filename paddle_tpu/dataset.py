@@ -73,19 +73,12 @@ class QueueDataset(DatasetBase):
         loader.close()
 
     def _collate(self, samples) -> Dict[str, np.ndarray]:
+        from .data_feeder import pad_batch_column
         out = {}
         for i, name in enumerate(self._use_var_names):
-            cols = [s[i] for s in samples]
-            maxlen = max(len(c) for c in cols)
-            if all(len(c) == maxlen for c in cols):
-                out[name] = np.stack(cols)
-            else:
-                arr = np.zeros((len(cols), maxlen), dtype=cols[0].dtype)
-                lens = np.zeros(len(cols), dtype="int64")
-                for j, c in enumerate(cols):
-                    arr[j, :len(c)] = c
-                    lens[j] = len(c)
-                out[name] = arr
+            arr, lens = pad_batch_column([s[i] for s in samples])
+            out[name] = arr
+            if lens is not None:
                 out[name + "_len"] = lens
         return out
 

@@ -1,0 +1,3 @@
+"""paddle_tpu.distributed — launcher + env helpers (reference
+python/paddle/distributed/)."""
+from ..parallel.env import get_rank, get_world_size, init_parallel_env  # noqa: F401

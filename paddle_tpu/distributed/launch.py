@@ -1,0 +1,81 @@
+"""Multi-process launcher.
+
+Reference analog: ``python/paddle/distributed/launch.py`` (:132 start_procs —
+one proc per device, PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS env wiring).
+
+TPU-native: one process per HOST (jax owns all local chips); env vars keep
+the reference names and map onto jax.distributed.initialize via
+parallel.env.init_parallel_env.
+
+    python -m paddle_tpu.distributed.launch --nproc 2 train.py --args...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def start_procs(nproc: int, training_script: str, script_args,
+                started_port: int = 6170, log_dir: str | None = None):
+    endpoints = ",".join(f"127.0.0.1:{started_port + i}" for i in range(nproc))
+    procs = []
+    log_fds = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
+        })
+        cmd = [sys.executable, "-u", training_script] + list(script_args)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            fd = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+            log_fds.append(fd)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=fd, stderr=fd))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+    return procs, log_fds
+
+
+def wait_procs(procs, log_fds):
+    try:
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        time.sleep(1)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        return 1
+    finally:
+        for fd in log_fds:
+            fd.close()
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINERS_NUM", 1)))
+    parser.add_argument("--started_port", type=int, default=6170)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    procs, fds = start_procs(args.nproc, args.training_script, args.script_args,
+                             args.started_port, args.log_dir)
+    sys.exit(wait_procs(procs, fds))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,10 +18,13 @@ def enabled() -> bool:
 
 @contextlib.contextmanager
 def guard(place=None, seed: int = 0):
+    from . import layers as _layers
+
     tracer = Tracer(seed=seed)
     old = _active_tracer()
     _set_tracer(tracer)
     prog_mod._set_dygraph_tracer(tracer)
+    _layers.seed(seed)  # deterministic layer init per guard
     try:
         yield
     finally:

@@ -32,6 +32,19 @@ def _run_initializer(init, shape, dtype, seed_key):
     return env["out"]
 
 
+# deterministic layer-init seeding: a process-wide counter folded into the
+# base seed (settable via dygraph.guard(seed=...) / seed()) — reproducible
+# across interpreter runs, unlike salted str hashes
+_INIT_SEED = [0]
+_INIT_COUNTER = [0]
+
+
+def seed(value: int):
+    """Set the base seed for subsequent Layer parameter initialization."""
+    _INIT_SEED[0] = int(value)
+    _INIT_COUNTER[0] = 0
+
+
 class Layer:
     def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
         self._full_name = unique_name.generate(
@@ -41,7 +54,9 @@ class Layer:
         self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
         self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
         self.training = True
-        self._init_key = jax.random.PRNGKey(abs(hash(self._full_name)) % (2 ** 31))
+        _INIT_COUNTER[0] += 1
+        self._init_key = jax.random.fold_in(
+            jax.random.PRNGKey(_INIT_SEED[0]), _INIT_COUNTER[0])
 
     def full_name(self) -> str:
         return self._full_name
@@ -60,7 +75,9 @@ class Layer:
         value = _run_initializer(init, shape, dtype, sub)
         name = attr.name or unique_name.generate(
             self._full_name + (".b" if is_bias else ".w"))
-        p = VarBase(value, name=name, stop_gradient=False, persistable=True)
+        p = VarBase(value, name=name, stop_gradient=not attr.trainable,
+                    persistable=True)
+        p.is_parameter = True
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer
         p.need_clip = attr.need_clip
@@ -82,11 +99,12 @@ class Layer:
 
     def __setattr__(self, name, value):
         if isinstance(value, VarBase) and getattr(value, "persistable", False):
+            is_param = getattr(value, "is_parameter", False)
             params = self.__dict__.get("_parameters")
-            if params is not None and not value.stop_gradient:
+            if params is not None and is_param:
                 params[name] = value
             bufs = self.__dict__.get("_buffers")
-            if bufs is not None and value.stop_gradient:
+            if bufs is not None and not is_param:
                 bufs[name] = value
         elif isinstance(value, Layer):
             subs = self.__dict__.get("_sub_layers")

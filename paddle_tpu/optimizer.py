@@ -125,6 +125,11 @@ class Optimizer:
         from .core.program import Program, grad_var_name, program_guard
         import jax
 
+        # rebuild from scratch: cached lr/accumulator vars belong to the
+        # previous scratch program; names are deterministic, so accumulated
+        # values transfer via the old-env merge below
+        self._lr_var = None
+        self._accumulators = {}
         self._dy_prog = Program()
         dy_startup = Program()
         with program_guard(self._dy_prog, dy_startup):
@@ -160,6 +165,11 @@ class Optimizer:
                     env[k] = v
         self._dy_env = env
         self._dy_param_names = tuple(sorted(p.name for p in params))
+        # optimizer update ops are never differentiated: is_test skips the
+        # per-step vjp taping in _run_op (hot-path cost)
+        from .core.executor import ExecContext
+        import jax as _jax
+        self._dy_ctx = ExecContext(_jax.random.PRNGKey(0), is_test=True)
 
     def set_lr(self, value: float):
         """Update the learning rate (works in both modes)."""
@@ -228,8 +238,7 @@ class Optimizer:
             env[p.name] = p.value
             env[grad_var_name(p.name)] = (p.grad_value if p.grad_value is not None
                                           else jnp.zeros_like(p.value))
-        ctx = ExecContext(jax.random.PRNGKey(0))
-        _run_block(self._dy_prog.global_block(), env, ctx)
+        _run_block(self._dy_prog.global_block(), env, self._dy_ctx)
         for p in params:
             p.value = env[p.name]
         return [], [(p, p.grad_value) for p in params]

@@ -1,0 +1,55 @@
+"""Multi-host bootstrap.
+
+Reference analog: `gen_nccl_id` socket exchange (distributed_ops/
+gen_nccl_id_op.cc), transpiler nccl2 mode env wiring (PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS — distribute_transpiler.py:259), and launch.py.
+
+TPU-native: `jax.distributed.initialize` replaces the id exchange; env vars
+keep the reference names for drop-in launcher compatibility.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> bool:
+    """Initialize multi-host JAX from args or PADDLE_*-style env vars.
+    Returns True if distributed mode is active."""
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    trainer_id = os.environ.get("PADDLE_TRAINER_ID", "")
+    if coordinator_address is None and endpoints:
+        coordinator_address = endpoints.split(",")[0]
+        num_processes = num_processes or len(endpoints.split(","))
+        process_id = process_id if process_id is not None else int(trainer_id or 0)
+    if coordinator_address is None:
+        return False  # single process
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def get_world_size() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0

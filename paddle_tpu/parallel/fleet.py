@@ -1,0 +1,192 @@
+"""Fleet — the high-level distributed-training API.
+
+Reference analog: ``python/paddle/fluid/incubate/fleet/base/fleet_base.py:37``
+(Fleet abstract: init/is_worker/run_server/…), role_maker.py:30 (RoleMakerBase,
+PaddleCloudRoleMaker env-based, UserDefinedRoleMaker), and the collective
+implementation (incubate/fleet/collective/__init__.py:41 CollectiveOptimizer).
+
+TPU-native: only the collective mode exists (pserver mode is a documented
+non-goal — SURVEY §2.2 Pslib row); workers are jax processes, the optimizer
+wraps the program in a data-parallel CompiledProgram over the fleet mesh.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+from ..core.compiler import BuildStrategy, CompiledProgram
+from ..core.program import default_main_program
+from .env import init_parallel_env
+from .mesh import DistributedStrategy, auto_mesh
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def generate_role(self):
+        pass
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return False  # no pservers on TPU
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+    def worker_num(self) -> int:
+        return 1
+
+    def worker_index(self) -> int:
+        return 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var role maker (role_maker.py PaddleCloudRoleMaker parity):
+    reads PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS."""
+
+    def __init__(self, is_collective: bool = True):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        init_parallel_env()
+
+    def worker_num(self) -> int:
+        try:
+            return jax.process_count()
+        except Exception:
+            return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def worker_index(self) -> int:
+        try:
+            return jax.process_index()
+        except Exception:
+            return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id: int = 0, role=Role.WORKER,
+                 worker_num: int = 1, server_endpoints=None):
+        super().__init__()
+        self._cur = current_id
+        self._num = worker_num
+        self._role = role
+
+    def worker_num(self) -> int:
+        return self._num
+
+    def worker_index(self) -> int:
+        return self._cur
+
+
+class Fleet:
+    """fleet_base.py:37 surface, collective-only."""
+
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self.main_program = None
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             is_collective: bool = True):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker.generate_role()
+        return self
+
+    def is_worker(self) -> bool:
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def is_server(self) -> bool:
+        return False
+
+    def is_first_worker(self) -> bool:
+        return self._role_maker is None or self._role_maker.is_first_worker()
+
+    def worker_num(self) -> int:
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def worker_index(self) -> int:
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_endpoints(self) -> List[str]:
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    # collective mode has no servers; these are no-ops for API compat
+    def init_worker(self):
+        pass
+
+    def init_server(self, *a, **kw):
+        pass
+
+    def run_server(self):
+        raise RuntimeError("parameter servers are a non-goal on TPU "
+                           "(use sharded embeddings — SURVEY §2.2)")
+
+    def stop_worker(self):
+        pass
+
+    def barrier_worker(self):
+        try:
+            if jax.process_count() > 1:
+                from .collective import barrier
+                from jax.sharding import Mesh
+                import numpy as np
+                barrier(Mesh(np.array(jax.devices()), ("dp",)))
+        except Exception:
+            pass
+
+    def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
+        self._strategy = strategy or DistributedStrategy()
+        return DistributedOptimizer(self, optimizer, self._strategy)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .. import io
+        if self.is_first_worker():
+            io.save_persistables(executor, dirname, main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from .. import io
+        if self.is_first_worker():
+            io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                    executor, main_program)
+
+
+class DistributedOptimizer:
+    """CollectiveOptimizer parity (fleet/collective/__init__.py:139): wraps a
+    regular optimizer; minimize() additionally builds the data-parallel
+    CompiledProgram over the strategy mesh."""
+
+    def __init__(self, fleet: Fleet, optimizer, strategy: DistributedStrategy):
+        self._fleet = fleet
+        self._inner = optimizer
+        self._strategy = strategy
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pg = self._inner.minimize(loss, startup_program, parameter_list,
+                                       no_grad_set)
+        program = loss.block.program
+        if self._strategy.tensor_parallel_degree > 1:
+            from .tensor_parallel import annotate_tp
+            annotate_tp(program)
+        mesh = self._strategy.build_mesh()
+        self._fleet.main_program = CompiledProgram(program).with_mesh(
+            mesh, data_axis="dp")
+        return ops, pg
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+fleet = Fleet()

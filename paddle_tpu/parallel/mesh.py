@@ -1,0 +1,67 @@
+"""Mesh construction + strategy knobs.
+
+Reference analog: NCCLContextMap/NCCLCommunicator ring construction
+(nccl_helper.h:90,179 — flat + hierarchical + multi-ring) and fleet
+DistributedStrategy (incubate/fleet/collective/__init__.py:93).
+
+TPU-native: one `jax.sharding.Mesh` with named axes (dp/tp/pp/sp/ep) over the
+physical device grid replaces every ring; XLA routes collectives over ICI
+within an axis and DCN across slices — the hierarchical-allreduce topology of
+the reference is implicit in device order.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_sizes: Dict[str, int], devices=None) -> Mesh:
+    """make_mesh({'dp': 2, 'tp': 4}) over the first prod(sizes) devices."""
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[n] for n in names)
+    n = int(np.prod(sizes))
+    devs = np.array(devices if devices is not None else jax.devices()[:n])
+    if devs.size < n:
+        raise ValueError(f"need {n} devices for mesh {axis_sizes}, have {devs.size}")
+    return Mesh(devs[:n].reshape(sizes), names)
+
+
+def auto_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1, sp: int = 1,
+              devices=None) -> Mesh:
+    """Fill the dp axis with whatever devices remain after tp/pp/sp."""
+    devs = list(devices if devices is not None else jax.devices())
+    denom = tp * pp * sp
+    if dp is None:
+        dp = len(devs) // denom
+    axes = {}
+    for name, size in (("dp", dp), ("pp", pp), ("tp", tp), ("sp", sp)):
+        if size > 1 or name == "dp":
+            axes[name] = size
+    return make_mesh(axes, devs)
+
+
+class DistributedStrategy:
+    """fleet DistributedStrategy parity — knobs map to mesh/sharding choices
+    rather than NCCL ring counts."""
+
+    def __init__(self):
+        self.tensor_parallel_degree = 1
+        self.pipeline_parallel_degree = 1
+        self.sequence_parallel_degree = 1
+        self.sharding_degree = 1          # ZeRO-style optimizer sharding
+        self.amp = False
+        self.recompute = False            # jax.checkpoint on blocks
+        self.gradient_merge_steps = 1     # microbatch accumulation
+        # reference-compat knobs (no-ops on TPU; XLA owns these)
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.fuse_all_reduce_ops = True
+
+    def build_mesh(self, devices=None) -> Mesh:
+        return auto_mesh(tp=self.tensor_parallel_degree,
+                         pp=self.pipeline_parallel_degree,
+                         sp=self.sequence_parallel_degree,
+                         devices=devices)

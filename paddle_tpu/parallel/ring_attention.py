@@ -1,0 +1,99 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+No reference analog: the reference's "sequence" machinery is LoDTensor
+batching, not parallelism (SURVEY §5). This is the new first-class axis the
+TPU build adds: Q/K/V sharded along the sequence dim over the `sp` mesh axis;
+K/V blocks rotate around the ring via `lax.ppermute` while each device
+accumulates flash-style (running max / denominator) partial attention —
+compute overlaps the permute, max context scales linearly with ring size.
+
+Also provides Ulysses-style all-to-all head-parallel attention as the
+alternative decomposition.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collective import shard_map
+
+_NEG = -1e9
+
+
+def _ring_attn_local(q, k, v, axis: str, causal: bool):
+    """Per-device body under shard_map. q,k,v: [B, H, Tl, D] local shards."""
+    n = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    tl = q.shape[2]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    q_pos = idx * tl + jnp.arange(tl)
+
+    def step(carry, t):
+        m, l, o, k_cur, v_cur = carry
+        src = (idx - t) % n  # whose K/V block we hold this step
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+        if causal:
+            k_pos = src * tl + jnp.arange(tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return (m_new, l_new, o_new, k_nxt, v_nxt), None
+
+    b, h, _, d = q.shape
+    init = (jnp.full((b, h, tl, 1), _NEG, q.dtype),
+            jnp.zeros((b, h, tl, 1), q.dtype),
+            jnp.zeros((b, h, tl, d), q.dtype), k, v)
+    (m, l, o, _, _), _ = lax.scan(step, init, jnp.arange(n))
+    return o / jnp.maximum(l, 1e-20)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                        causal: bool = False):
+    """Array-level entry: q/k/v [B, H, T, D] with T sharded on `axis`."""
+    spec = P(None, None, axis, None)
+    fn = shard_map(partial(_ring_attn_local, axis=axis, causal=causal),
+                   mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+ring_attention = ring_self_attention
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      causal: bool = False):
+    """Ulysses decomposition: all-to-all converts seq-sharding into
+    head-sharding, full attention runs locally, then back. Needs
+    num_heads % axis_size == 0."""
+    spec = P(None, None, axis, None)
+
+    def local(qs, ks, vs):
+        # [B, H, Tl, D] → exchange: heads scatter, seq gather → [B, H/n, T, D]
+        def a2a(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+        qg, kg, vg = a2a(qs), a2a(ks), a2a(vs)
+        scale = 1.0 / math.sqrt(qg.shape[-1])
+        s = jnp.einsum("bhqd,bhkd->bhqk", qg, kg) * scale
+        if causal:
+            t = s.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            s = jnp.where(mask[None, None], s, _NEG)
+        p = jax.nn.softmax(s, axis=-1)
+        og = jnp.einsum("bhqk,bhkd->bhqd", p, vg)
+        return lax.all_to_all(og, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    fn = shard_map(local, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,52 @@
+"""Tensor (model) parallelism — Megatron-style param sharding rules.
+
+Reference status: TP was absent (SURVEY §2.2 row "Tensor/model parallel —
+partial": only pserver-sharded embeddings via parameter_prefetch.cc). This is
+a first-class capability here: parameters get PartitionSpec annotations and
+GSPMD inserts the all-reduces a hand-written Megatron implementation would.
+
+Rules map param-name regexes → PartitionSpec tuples. Column-parallel weights
+shard the output dim, row-parallel shard the input dim; GSPMD then emits one
+psum per transformer block (after attn-out and ffn2), exactly the Megatron
+communication pattern, riding ICI.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+from ..core.program import Parameter, Program
+
+# rule: regex on param name → spec template with 'tp' marking the sharded dim
+MEGATRON_RULES: Sequence[Tuple[str, Tuple]] = (
+    (r".*\.qkv\.w$", (None, "tp")),      # column parallel
+    (r".*\.qkv\.b$", ("tp",)),
+    (r".*\.attn_out\.w$", ("tp", None)),  # row parallel
+    (r".*\.ffn1\.w$", (None, "tp")),
+    (r".*\.ffn1\.b$", ("tp",)),
+    (r".*\.ffn2\.w$", ("tp", None)),
+    (r"word_embedding$", ("tp", None)),   # vocab-sharded embedding
+    (r"mlm_out\.w$", (None, "tp")),
+    (r"mlm_out\.b$", ("tp",)),
+)
+
+
+def annotate_tp(program: Program, rules: Sequence[Tuple[str, Tuple]] = MEGATRON_RULES,
+                axis: str = "tp") -> int:
+    """Attach shard_spec to matching parameters. Returns #annotated.
+    CompiledProgram.with_mesh then places them (compiler.py _state_sharding)."""
+    count = 0
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    for p in program.all_parameters():
+        for pat, spec in compiled:
+            if pat.match(p.name):
+                p.shard_spec = tuple(axis if s == "tp" else s for s in spec)
+                count += 1
+                break
+    return count
+
+
+def embedding_shard_spec(axis: str = "tp"):
+    """Row(vocab)-sharded embedding table spec — the TPU replacement for the
+    reference's distributed_lookup_table pserver path (SURVEY §2.2)."""
+    return (axis, None)

@@ -52,16 +52,20 @@ def shuffle(reader: Callable, buf_size: int):
 
 
 def buffered(reader: Callable, size: int):
-    """Prefetch into a bounded queue on a background thread."""
+    """Prefetch into a bounded queue on a background thread. Reader errors
+    re-raise in the consumer (no silent dataset truncation)."""
     end = object()
 
     def buffered_reader():
         q: Queue = Queue(maxsize=size)
+        error: List[BaseException] = []
 
         def worker():
             try:
                 for item in reader():
                     q.put(item)
+            except BaseException as e:  # propagate to consumer
+                error.append(e)
             finally:
                 q.put(end)
 
@@ -70,6 +74,8 @@ def buffered(reader: Callable, size: int):
         while True:
             item = q.get()
             if item is end:
+                if error:
+                    raise error[0]
                 break
             yield item
     return buffered_reader
