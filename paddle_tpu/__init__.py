@@ -22,11 +22,18 @@ from . import layers  # noqa: F401
 from . import clip  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import contrib  # noqa: F401
+from . import debugger  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import io  # noqa: F401
+from . import metrics  # noqa: F401
 from . import parallel  # noqa: F401
+from . import profiler  # noqa: F401
 from . import reader as py_reader_module  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .layers import learning_rate_scheduler  # noqa: F401
 from .reader import PyReader  # noqa: F401
 from .core import (  # noqa: F401
     Block,

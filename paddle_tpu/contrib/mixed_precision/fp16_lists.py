@@ -1,0 +1,22 @@
+"""Op lists for mixed precision (reference contrib/mixed_precision/
+fp16_lists.py). On TPU the low-precision dtype is bfloat16 by default."""
+from __future__ import annotations
+
+# ops whose inputs are cast to the compute dtype (MXU-bound)
+WHITE_LIST = {"conv2d", "conv3d", "depthwise_conv2d", "conv2d_transpose",
+              "matmul", "mul"}
+# ops kept in float32 (numerically sensitive)
+BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "mean",
+              "reduce_mean", "layer_norm", "batch_norm", "softmax", "sum",
+              "exp", "log", "rsqrt", "sqrt"}
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+        self.white_list -= self.black_list

@@ -118,11 +118,12 @@ class CompiledProgram:
     def _build(self, feed_names, fetch_names, state_names, out_state_names):
         block = self._program.global_block()
         mesh = self._mesh
+        amp = getattr(self._program, "_amp", None)
 
         def step(state, feed, key):
             env = dict(state)
             env.update(feed)
-            ctx = ExecContext(key, mesh=mesh)
+            ctx = ExecContext(key, mesh=mesh, amp=amp)
             _run_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in out_state_names if n in env}

@@ -73,10 +73,11 @@ class TapeEntry:
 class ExecContext:
     """Per-trace context handed to op implementations."""
 
-    def __init__(self, key, is_test: bool = False, mesh=None):
+    def __init__(self, key, is_test: bool = False, mesh=None, amp=None):
         self._key = key
         self.is_test = is_test
         self.mesh = mesh
+        self.amp = amp  # {'dtype', 'white_list', 'black_list'} or None
         self.tape: List[TapeEntry] = []
 
     def rng(self):
@@ -109,6 +110,26 @@ def _flatten_io(d: Dict[str, List]) -> Tuple[List[str], List]:
     return keys, vals
 
 
+def _amp_cast(vals_by_slot, op_type, amp):
+    """AMP cast insertion at lowering (the reference's cast-op graph pass —
+    contrib/mixed_precision/fp16_utils.py — collapsed into trace time)."""
+    if amp is None:
+        return vals_by_slot
+    lo = jnp.bfloat16 if amp["dtype"] == "bfloat16" else jnp.float16
+
+    def cast_to(v, dt):
+        a = jnp.asarray(v)
+        if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != dt:
+            return a.astype(dt)
+        return v
+
+    if op_type in amp["white_list"]:
+        return {s: [cast_to(v, lo) for v in vs] for s, vs in vals_by_slot.items()}
+    if op_type in amp["black_list"]:
+        return {s: [cast_to(v, jnp.float32) for v in vs] for s, vs in vals_by_slot.items()}
+    return vals_by_slot
+
+
 def _run_op(op, env: Dict[str, object], ctx: ExecContext):
     opdef = registry.get_op(op.type)
     in_vals = {slot: [env[n] for n in names] for slot, names in op.inputs.items()}
@@ -126,6 +147,9 @@ def _run_op(op, env: Dict[str, object], ctx: ExecContext):
             for s, c in zip(in_slots, in_counts):
                 ins[s] = list(flat_vals[pos:pos + c])
                 pos += c
+            # AMP casts live INSIDE the differentiated fn so vjp converts
+            # cotangent dtypes through the cast automatically
+            ins = _amp_cast(ins, op.type, ctx.amp)
             out = opdef.fn(ctx, ins, op.attrs)
             flat_out = []
             for slot in sorted(op.outputs):
@@ -152,7 +176,7 @@ def _run_op(op, env: Dict[str, object], ctx: ExecContext):
         ctx.tape.append(TapeEntry(flat_in_names, out_names, vjp_fn,
                                   list(flat_out_vals), nondiff_in))
     else:
-        out = opdef.fn(ctx, in_vals, op.attrs)
+        out = opdef.fn(ctx, _amp_cast(in_vals, op.type, ctx.amp), op.attrs)
         for slot in sorted(op.outputs):
             vals = out.get(slot, [])
             names = op.outputs[slot]
@@ -241,11 +265,12 @@ class Executor:
     def _build(self, program: Program, feed_names, fetch_names, state_names,
                out_state_names):
         block = program.global_block()
+        amp = getattr(program, "_amp", None)
 
         def step(state, feed, key):
             env = dict(state)
             env.update(feed)
-            ctx = ExecContext(key)
+            ctx = ExecContext(key, amp=amp)
             _run_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in out_state_names if n in env}
@@ -303,9 +328,55 @@ class Executor:
             scope.set_var(n, v)
         scope.set_var(_RNG_STATE, new_key)
 
+        from ..flags import flag
+        if flag("check_nan_inf"):
+            # FLAGS_check_nan_inf parity (operator.cc:949): validate every
+            # fetched value and updated state var, naming the offender
+            for n, v in list(zip(fetch_names, fetches)) + list(new_state.items()):
+                a = np.asarray(v)
+                if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+                    raise FloatingPointError(
+                        f"NaN/Inf detected in variable {n!r} "
+                        f"(FLAGS_check_nan_inf is on)")
+
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None, print_period: int = 100):
+        """Dataset-driven training loop (reference executor.py:894 →
+        Executor::RunFromDataset → MultiTrainer N-thread hot loop,
+        hogwild_worker.cc:163). TPU-native: the native C++ loader threads do
+        IO/parsing; the device runs one jitted step per batch — XLA's async
+        dispatch overlaps H2D with compute (buffered_reader.cc role)."""
+        program = program or default_main_program()
+        fetch_list = list(fetch_list or [])
+        if dataset is None:
+            raise ValueError("dataset is required")
+        if thread:
+            dataset.set_thread(thread)
+        step = 0
+        last = None
+        for batch in dataset.batches():
+            feed = {k: v for k, v in batch.items()
+                    if program.global_block()._find_var_recursive(k) is not None}
+            last = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
+            if debug and fetch_list and step % print_period == 0:
+                names = fetch_info or [getattr(f, "name", str(f)) for f in fetch_list]
+                print(f"step {step}: " + ", ".join(
+                    f"{n}={np.asarray(v).mean():.6f}" for n, v in zip(names, last)))
+            step += 1
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None, print_period: int = 100):
+        """executor.py:817 parity — same loop on a for_test program."""
+        program = (program or default_main_program()).clone(for_test=True)
+        return self.train_from_dataset(program, dataset, scope, thread, debug,
+                                       fetch_list, fetch_info, print_period)
 
     def close(self):
         self._cache.clear()

@@ -1,0 +1,160 @@
+"""Learning-rate schedules — emitted as ops over a global step counter.
+
+Reference analog: ``python/paddle/fluid/layers/learning_rate_scheduler.py``
+(noam/exponential/natural_exp/inverse_time/polynomial/piecewise/cosine/
+linear-warmup — each builds ops updating an lr Variable every step).
+
+TPU-native: one `lr_schedule` op computes lr(step) functionally from a
+persistable step var; schedules compose (warmup wraps a base schedule).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+
+_STEP_VAR = "@LR_DECAY_COUNTER@"
+
+
+@register_op("lr_schedule", differentiable=False)
+def _lr_schedule(ctx, inputs, attrs):
+    (step,) = inputs["Step"]
+    s = step.reshape(()).astype(jnp.float32)
+    kind = attrs["kind"]
+    lr = attrs.get("learning_rate", 1.0)
+    if kind == "noam":
+        d = attrs["d_model"]
+        w = attrs["warmup_steps"]
+        val = lr * (d ** -0.5) * jnp.minimum((s + 1) ** -0.5, (s + 1) * (w ** -1.5))
+    elif kind == "exponential":
+        decay = attrs["decay_rate"]
+        steps = attrs["decay_steps"]
+        exp = s / steps
+        if attrs.get("staircase", False):
+            exp = jnp.floor(exp)
+        val = lr * (decay ** exp)
+    elif kind == "natural_exp":
+        decay = attrs["decay_rate"]
+        steps = attrs["decay_steps"]
+        exp = s / steps
+        if attrs.get("staircase", False):
+            exp = jnp.floor(exp)
+        val = lr * jnp.exp(-decay * exp)
+    elif kind == "inverse_time":
+        decay = attrs["decay_rate"]
+        steps = attrs["decay_steps"]
+        div = s / steps
+        if attrs.get("staircase", False):
+            div = jnp.floor(div)
+        val = lr / (1.0 + decay * div)
+    elif kind == "polynomial":
+        end = attrs["end_learning_rate"]
+        power = attrs["power"]
+        steps = attrs["decay_steps"]
+        if attrs.get("cycle", False):
+            div = jnp.ceil(jnp.maximum(s, 1.0) / steps)
+            steps_t = steps * jnp.maximum(div, 1.0)
+        else:
+            steps_t = steps
+        frac = jnp.minimum(s, steps_t) / steps_t
+        val = (lr - end) * ((1.0 - frac) ** power) + end
+    elif kind == "piecewise":
+        bounds = jnp.asarray(attrs["boundaries"], jnp.float32)
+        values = jnp.asarray(attrs["values"], jnp.float32)
+        idx = jnp.sum((s >= bounds).astype(jnp.int32))
+        val = values[idx]
+    elif kind == "cosine":
+        steps = attrs["step_each_epoch"]
+        epochs = attrs["epochs"]
+        cur_epoch = jnp.floor(s / steps)
+        val = lr * 0.5 * (jnp.cos(cur_epoch * math.pi / epochs) + 1.0)
+    elif kind == "warmup":
+        w = attrs["warmup_steps"]
+        start = attrs["start_lr"]
+        end_lr = attrs["end_lr"]
+        after = inputs.get("Base", [jnp.asarray(attrs.get("after_lr", end_lr))])[0]
+        after = jnp.asarray(after).reshape(())
+        warm = start + (end_lr - start) * (s / w)
+        val = jnp.where(s < w, warm, after)
+    else:
+        raise ValueError(f"unknown schedule {kind}")
+    return {"Out": [val.reshape((1,))], "StepOut": [step + 1]}
+
+
+def _global_step(helper: LayerHelper):
+    # one counter per schedule op: composed schedules (warmup over a base
+    # decay) each advance their own counter exactly once per executed step
+    return helper.create_global_variable(
+        [1], "int64", name=f"{_STEP_VAR}{helper.name}",
+        initializer=ConstantInitializer(0.0))
+
+
+def _schedule(kind: str, base_lr_var=None, **attrs):
+    helper = LayerHelper(f"lr_{kind}")
+    step = _global_step(helper)
+    lr = helper.create_global_variable([1], "float32",
+                                       name=f"lr_{kind}_{helper.name}",
+                                       initializer=ConstantInitializer(
+                                           attrs.get("learning_rate", 0.0)))
+    ins = {"Step": [step.name]}
+    if base_lr_var is not None:
+        ins["Base"] = [base_lr_var.name]
+    helper.append_op(type="lr_schedule", inputs=ins,
+                     outputs={"Out": [lr.name], "StepOut": [step.name]},
+                     attrs=dict(attrs, kind=kind))
+    return lr
+
+
+def noam_decay(d_model, warmup_steps, learning_rate: float = 1.0):
+    return _schedule("noam", d_model=d_model, warmup_steps=warmup_steps,
+                     learning_rate=learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    return _schedule("exponential", learning_rate=learning_rate,
+                     decay_steps=decay_steps, decay_rate=decay_rate,
+                     staircase=staircase)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    return _schedule("natural_exp", learning_rate=learning_rate,
+                     decay_steps=decay_steps, decay_rate=decay_rate,
+                     staircase=staircase)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    return _schedule("inverse_time", learning_rate=learning_rate,
+                     decay_steps=decay_steps, decay_rate=decay_rate,
+                     staircase=staircase)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    return _schedule("polynomial", learning_rate=learning_rate,
+                     decay_steps=decay_steps, end_learning_rate=end_learning_rate,
+                     power=power, cycle=cycle)
+
+
+def piecewise_decay(boundaries: List[int], values: List[float]):
+    return _schedule("piecewise", boundaries=list(boundaries), values=list(values),
+                     learning_rate=values[0])
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return _schedule("cosine", learning_rate=learning_rate,
+                     step_each_epoch=step_each_epoch, epochs=epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Ramp start_lr→end_lr over warmup_steps, then use `learning_rate`
+    (float or schedule var) — reference linear_lr_warmup semantics."""
+    base = learning_rate if hasattr(learning_rate, "name") else None
+    attrs = dict(warmup_steps=warmup_steps, start_lr=start_lr, end_lr=end_lr)
+    if base is None:
+        attrs["after_lr"] = float(learning_rate)
+    return _schedule("warmup", base_lr_var=base, **attrs)

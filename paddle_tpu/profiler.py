@@ -1,0 +1,101 @@
+"""Profiler surface.
+
+Reference analog: ``python/paddle/fluid/profiler.py`` (profiler()
+contextmanager, start/stop_profiler) over the C++ RecordEvent/DeviceTracer
+CUPTI stack (platform/profiler.h:166, device_tracer.cc), exported to
+chrome://tracing by tools/timeline.py.
+
+TPU-native: jax.profiler captures an XPlane trace viewable in
+TensorBoard/Perfetto (the chrome-trace analog); RecordEvent becomes
+TraceAnnotation (named scopes visible in the trace and in HLO metadata).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+
+def start_profiler(state: str = "All", tracer_option=None,
+                   log_dir: str = "/tmp/paddle_tpu_profile"):
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _active["dir"] = log_dir
+
+
+def stop_profiler(sorted_key: Optional[str] = None, profile_path: Optional[str] = None):
+    jax.profiler.stop_trace()
+    return _active.get("dir")
+
+
+_active = {}
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: str = "/tmp/paddle_tpu_profile"):
+    """fluid.profiler.profiler parity: wraps a training region; writes an
+    XPlane trace under profile_path (open with TensorBoard)."""
+    start_profiler(state, log_dir=profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def record_event(name: str):
+    """RecordEvent RAII parity (platform/profiler.h:81): annotates the trace
+    AND the compiled HLO (shows up per-fusion in XLA tooling)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class _OpTimer:
+    """Host-side per-op wall-time table for eager mode — the analog of the
+    reference's EnableProfiler sorted per-op summary."""
+
+    def __init__(self):
+        self.times = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    def summary(self, sorted_key: str = "total"):
+        rows = [(k, self.counts[k], self.times[k] * 1e3,
+                 self.times[k] / max(self.counts[k], 1) * 1e3)
+                for k in self.times]
+        rows.sort(key=lambda r: -r[2])
+        lines = [f"{'op':<32}{'calls':>8}{'total_ms':>12}{'avg_ms':>10}"]
+        for name, c, tot, avg in rows:
+            lines.append(f"{name:<32}{c:>8}{tot:>12.3f}{avg:>10.4f}")
+        return "\n".join(lines)
+
+
+_op_timer: Optional[_OpTimer] = None
+
+
+@contextlib.contextmanager
+def op_profiler():
+    """Eager per-op timing: patches the dygraph tracer dispatch."""
+    global _op_timer
+    from .dygraph import tracer as tr_mod
+
+    _op_timer = _OpTimer()
+    orig = tr_mod.Tracer.trace_op
+
+    def timed(self, op_type, inputs, attrs=None):
+        t0 = time.perf_counter()
+        out = orig(self, op_type, inputs, attrs)
+        jax.block_until_ready(
+            [v.value for vs in out.values() for v in vs])
+        _op_timer.times[op_type] += time.perf_counter() - t0
+        _op_timer.counts[op_type] += 1
+        return out
+
+    tr_mod.Tracer.trace_op = timed
+    try:
+        yield _op_timer
+    finally:
+        tr_mod.Tracer.trace_op = orig
+        _op_timer = None
