@@ -1,6 +1,37 @@
 """Layers DSL (reference python/paddle/fluid/layers/)."""
+from . import control_flow  # noqa: F401
 from . import detection  # noqa: F401
+from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401
+from .sequence import (  # noqa: F401
+    sequence_concat,
+    sequence_mask,
+    sequence_pool,
+    sequence_reverse,
+    sequence_softmax,
+)
+from .control_flow import (  # noqa: F401
+    DynamicRNN,
+    IfElse,
+    StaticRNN,
+    Switch,
+    While,
+    array_length,
+    array_read,
+    array_write,
+    cond,
+    create_array,
+    max_sequence_len,
+)
+from .rnn import (  # noqa: F401
+    beam_search,
+    beam_search_decode,
+    dynamic_gru,
+    dynamic_lstm,
+    gru_unit,
+    lstm,
+    lstm_unit,
+)
 from . import learning_rate_scheduler  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,

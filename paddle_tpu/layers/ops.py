@@ -100,9 +100,12 @@ for _op in ["elementwise_add", "elementwise_sub", "elementwise_mul",
     setattr(_mod, _op, _make_ew(_op))
 
 
-def _compare_layer(op_type, x, y, name=None):
+def _compare_layer(op_type, x, y, cond=None, name=None):
     helper = LayerHelper(op_type, name=name)
-    out = helper.create_variable_for_type_inference("bool", x.shape, stop_gradient=True)
+    # cond= writes into an existing bool var (the While-loop condition idiom:
+    # layers.less_than(i, limit, cond=cond) re-binds cond each iteration).
+    out = cond if cond is not None else helper.create_variable_for_type_inference(
+        "bool", x.shape, stop_gradient=True)
     helper.append_op(type=op_type, inputs={"X": [x.name], "Y": [y.name]},
                      outputs={"Out": [out.name]}, attrs={})
     return out
@@ -112,7 +115,7 @@ for _op in ["equal", "not_equal", "less_than", "less_equal", "greater_than",
             "greater_equal", "logical_and", "logical_or", "logical_xor"]:
     def _make_cmp(op_type):
         def layer(x, y, cond=None, name=None):
-            return _compare_layer(op_type, x, y, name)
+            return _compare_layer(op_type, x, y, cond, name)
         layer.__name__ = op_type
         return layer
     setattr(_mod, _op, _make_cmp(_op))

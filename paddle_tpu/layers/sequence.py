@@ -12,7 +12,10 @@ from ..layer_helper import LayerHelper
 
 def sequence_mask(x, maxlen: int, dtype="int64", name=None):
     helper = LayerHelper("sequence_mask", name=name)
-    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    shape = None
+    if x.shape is not None:
+        shape = tuple(x.shape) + (maxlen,) if len(x.shape) == 1 else None
+    out = helper.create_variable_for_type_inference(dtype, shape, stop_gradient=True)
     helper.append_op(type="sequence_mask", inputs={"X": [x.name]},
                      outputs={"Y": [out.name]},
                      attrs={"maxlen": maxlen, "out_dtype": dtype})
@@ -21,7 +24,10 @@ def sequence_mask(x, maxlen: int, dtype="int64", name=None):
 
 def sequence_pool(input, pool_type: str, length=None, is_test=False):
     helper = LayerHelper("sequence_pool")
-    out = helper.create_variable_for_type_inference(input.dtype)
+    shape = None
+    if input.shape is not None and len(input.shape) >= 2:
+        shape = (input.shape[0],) + tuple(input.shape[2:])  # [B,T,...] -> [B,...]
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
     ins = {"X": [input.name]}
     if length is not None:
         ins["Length"] = [length.name]
@@ -36,7 +42,7 @@ def sequence_pool(input, pool_type: str, length=None, is_test=False):
 
 def sequence_softmax(input, length, name=None):
     helper = LayerHelper("sequence_softmax", name=name)
-    out = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
     helper.append_op(type="sequence_softmax",
                      inputs={"X": [input.name], "Length": [length.name]},
                      outputs={"Out": [out.name]}, attrs={})
@@ -45,7 +51,7 @@ def sequence_softmax(input, length, name=None):
 
 def sequence_reverse(x, length=None, name=None):
     helper = LayerHelper("sequence_reverse", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
     ins = {"X": [x.name]}
     if length is not None:
         ins["Length"] = [length.name]

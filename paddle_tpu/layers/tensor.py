@@ -21,7 +21,15 @@ def cast(x: Variable, dtype) -> Variable:
 
 def concat(input: Sequence[Variable], axis: int = 0, name=None) -> Variable:
     helper = LayerHelper("concat", name=name)
-    out = helper.create_variable_for_type_inference(input[0].dtype)
+    shape = None
+    if all(v.shape is not None for v in input):
+        shape = list(input[0].shape)
+        ax = axis if axis >= 0 else len(shape) + axis
+        if all(v.shape[ax] is not None and v.shape[ax] >= 0 for v in input):
+            shape[ax] = sum(v.shape[ax] for v in input)
+        else:
+            shape[ax] = -1
+    out = helper.create_variable_for_type_inference(input[0].dtype, shape)
     helper.append_op(type="concat", inputs={"X": [v.name for v in input]},
                      outputs={"Out": [out.name]}, attrs={"axis": axis})
     return out

@@ -9,15 +9,18 @@ Importing this package registers every op.
 """
 from . import (  # noqa: F401
     activation_ops,
+    beam_ops,
     collective_ops,
     compare_ops,
     control_flow_ops,
+    crf_ops,
     detection_ops,
     math_ops,
     metric_ops,
     nn_ops,
     optimizer_ops,
     reduce_ops,
+    rnn_ops,
     sequence_ops,
     tensor_ops,
 )

@@ -1,0 +1,88 @@
+"""Beam-search ops — per-step expansion and final backtrack decode.
+
+Reference analog: ``paddle/fluid/operators/beam_search_op.cc`` (one step:
+expand candidates, prune to beam width, LoD bookkeeping for parent links) and
+``beam_search_decode_op.cc`` (walk sentence trees backwards to emit token
+sequences). The reference threads beams through LoD levels; the TPU-native
+redesign keeps dense ``[batch, beam, ...]`` tensors with parent indices
+stored per step — static shapes, gather/top_k on device, usable inside
+`lax.while_loop` decoding loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import opt_input
+
+NEG = -1e9
+
+
+@register_op("beam_search", differentiable=False)
+def _beam_search(ctx, inputs, attrs):
+    """One expansion step.
+
+    inputs: Scores [batch, beam, vocab] log-probs of this step,
+            PreScores [batch, beam] accumulated log-probs,
+            PreFinished [batch, beam] (0/1) — finished beams only propagate
+            their end-token continuation (reference prunes them via LoD).
+    attrs: beam_size, end_id.
+    outputs: SelectedIds [batch, beam] int64, SelectedScores [batch, beam]
+             accumulated, ParentIdx [batch, beam] int64 (which previous beam
+             each selected candidate extends), Finished [batch, beam].
+    """
+    (scores,) = inputs["Scores"]
+    (pre_scores,) = inputs["PreScores"]
+    pre_fin = opt_input(inputs, "PreFinished")
+    beam = attrs["beam_size"]
+    end_id = attrs["end_id"]
+
+    batch, cur_beam, vocab = scores.shape
+    if pre_fin is None:
+        pre_fin = jnp.zeros((batch, cur_beam), bool)
+    else:
+        pre_fin = pre_fin.astype(bool)
+
+    # Finished beams: force the only continuation to be end_id with score 0
+    # (so the accumulated score is carried unchanged).
+    fin_row = jnp.full((vocab,), NEG, scores.dtype).at[end_id].set(0.0)
+    step = jnp.where(pre_fin[..., None], fin_row[None, None, :], scores)
+    total = pre_scores[..., None] + step                      # [b, cur, V]
+
+    flat = total.reshape(batch, cur_beam * vocab)
+    top_scores, top_idx = lax.top_k(flat, beam)               # [b, beam]
+    parent = (top_idx // vocab).astype(jnp.int64)
+    ids = (top_idx % vocab).astype(jnp.int64)
+    finished = jnp.take_along_axis(pre_fin, parent, axis=1) | (ids == end_id)
+    return {"SelectedIds": [ids], "SelectedScores": [top_scores],
+            "ParentIdx": [parent], "Finished": [finished]}
+
+
+@register_op("beam_search_decode", differentiable=False)
+def _beam_search_decode(ctx, inputs, attrs):
+    """Backtrack stored steps into token sequences.
+
+    inputs: Ids [T, batch, beam] int64 selected ids per step,
+            ParentIdx [T, batch, beam] int64,
+            Scores [batch, beam] final accumulated scores.
+    outputs: SentenceIds [batch, beam, T] (tokens after each beam's path is
+             followed back; positions past end_id keep end_id),
+             SentenceScores [batch, beam].
+    """
+    (ids,) = inputs["Ids"]
+    (parents,) = inputs["ParentIdx"]
+    (scores,) = inputs["Scores"]
+    T, batch, beam = ids.shape
+
+    def back(cursor, step):
+        step_ids, step_parents = step                        # [b, beam]
+        tok = jnp.take_along_axis(step_ids, cursor, axis=1)
+        prev = jnp.take_along_axis(step_parents, cursor, axis=1)
+        return prev, tok
+
+    init = jnp.tile(jnp.arange(beam, dtype=jnp.int64)[None, :], (batch, 1))
+    _, toks = lax.scan(back, init, (ids, parents), reverse=True)
+    sentences = jnp.transpose(toks, (1, 2, 0))               # [b, beam, T]
+    return {"SentenceIds": [sentences], "SentenceScores": [scores]}

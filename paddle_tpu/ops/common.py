@@ -20,3 +20,18 @@ def bcast_y(x, y, axis: int = -1):
 
 def one(outs):
     return {"Out": [outs]}
+
+
+def opt_input(inputs, slot):
+    """Optional input slot: missing key or empty list -> None."""
+    vs = inputs.get(slot) or [None]
+    return vs[0]
+
+
+def length_mask(length, B, T, dtype):
+    """Padded-sequence validity mask [B, T]: 1.0 where t < length[b].
+    length=None means all positions valid (the padded+mask stand-in for the
+    reference's LoD metadata)."""
+    if length is None:
+        return jnp.ones((B, T), dtype)
+    return (jnp.arange(T)[None, :] < length.reshape(-1, 1)).astype(dtype)

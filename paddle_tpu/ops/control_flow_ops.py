@@ -101,3 +101,110 @@ def _static_rnn(ctx, inputs, attrs):
     final_states, ys = lax.scan(step, tuple(states), seqs_tfirst)
     outs = [jnp.swapaxes(y, 0, 1) for y in ys]
     return {"Out": outs, "FinalState": list(final_states)}
+
+
+@register_op("cond", differentiable=False)
+def _cond(ctx, inputs, attrs):
+    """Two-branch functional cond (paddle 2.x layers.cond capability;
+    reference expresses it as paired conditional_block ops). Each branch is a
+    sub-block lowered to a pure fn over its own captured environment; both
+    must produce the same number/shape of outputs (lax.cond contract)."""
+    (pred,) = inputs["Pred"]
+    t_in = inputs.get("TrueIn", [])
+    f_in = inputs.get("FalseIn", [])
+    tb, fb = attrs["true_block"], attrs["false_block"]
+    t_env, f_env = attrs["true_env_names"], attrs["false_env_names"]
+    t_out, f_out = attrs["true_out_names"], attrs["false_out_names"]
+
+    from ..core.executor import _run_block, ExecContext
+
+    def mk(block, env_names, out_names, vals):
+        def fn(_):
+            env = dict(zip(env_names, vals))
+            sub = ExecContext(None, is_test=ctx.is_test, mesh=ctx.mesh)
+            _run_block(block, env, sub)
+            return tuple(env[n] for n in out_names)
+        return fn
+
+    out = lax.cond(pred.reshape(()).astype(bool),
+                   mk(tb, t_env, t_out, t_in), mk(fb, f_env, f_out, f_in),
+                   operand=None)
+    return {"Out": list(out)}
+
+
+@register_op("switch", differentiable=False)
+def _switch(ctx, inputs, attrs):
+    """First-matching-case switch (layers/control_flow.py Switch parity —
+    the lr-schedule workhorse). Cases + optional default are sub-blocks that
+    write a shared carried var set; lowered to lax.switch on the index of the
+    first true condition."""
+    conds = inputs["Conds"]
+    xs = inputs["X"]
+    case_blocks = attrs["case_blocks"]
+    default_block = attrs.get("default_block")
+    var_names = attrs["var_names"]
+
+    from ..core.executor import _run_block, ExecContext
+
+    def mk(block):
+        def fn(vals):
+            if block is None:
+                return tuple(vals)
+            env = dict(zip(var_names, vals))
+            sub = ExecContext(None, is_test=ctx.is_test, mesh=ctx.mesh)
+            _run_block(block, env, sub)
+            return tuple(env[n] for n in var_names)
+        return fn
+
+    branches = [mk(b) for b in case_blocks] + [mk(default_block)]
+    flags = jnp.stack([c.reshape(()).astype(bool) for c in conds])
+    first = jnp.argmax(flags)                       # first True (or 0)
+    idx = jnp.where(flags.any(), first, len(case_blocks))
+    out = lax.switch(idx, branches, tuple(xs))
+    return {"Out": list(out)}
+
+
+@register_op("select")
+def _select(ctx, inputs, attrs):
+    """Rowwise/elementwise select (IfElse merge): Out = where(Cond, X, Y).
+    Cond broadcasts from [B,1] over trailing dims."""
+    (cond,) = inputs["Cond"]
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    c = cond.astype(bool)
+    while c.ndim < x.ndim:
+        c = c[..., None]
+    # collapse trailing singleton mismatch ([B,1] vs [B,D])
+    c = jnp.broadcast_to(c, x.shape)
+    return {"Out": [jnp.where(c, x, y)]}
+
+
+# ---- tensor-array ops (LoDTensorArray capability, dense redesign) --------
+# Reference: lod_tensor_array ops (array_write/read, lod_array_length,
+# controlflow/while users). XLA needs static shapes, so an "array" is a
+# preallocated [max_len, ...] buffer var plus an int64 length scalar,
+# updated via dynamic_update_slice — usable inside while loops.
+
+@register_op("array_write", differentiable=False)
+def _array_write(ctx, inputs, attrs):
+    (arr,) = inputs["Array"]
+    (i,) = inputs["I"]
+    (x,) = inputs["X"]
+    (n,) = inputs["Length"]
+    idx = i.reshape(()).astype(jnp.int32)
+    new = lax.dynamic_update_index_in_dim(arr, x.astype(arr.dtype), idx, 0)
+    return {"Out": [new], "LengthOut": [jnp.maximum(n, (idx + 1).astype(n.dtype))]}
+
+
+@register_op("array_read", differentiable=False)
+def _array_read(ctx, inputs, attrs):
+    (arr,) = inputs["Array"]
+    (i,) = inputs["I"]
+    idx = i.reshape(()).astype(jnp.int32)
+    return {"Out": [lax.dynamic_index_in_dim(arr, idx, 0, keepdims=False)]}
+
+
+@register_op("array_length", differentiable=False)
+def _array_length(ctx, inputs, attrs):
+    (n,) = inputs["Length"]
+    return {"Out": [n.reshape((1,)).astype(jnp.int64)]}

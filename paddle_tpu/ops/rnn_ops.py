@@ -1,0 +1,255 @@
+"""Recurrent-network ops — LSTM / GRU over padded batches via lax.scan.
+
+Reference analog: ``paddle/fluid/operators/lstm_op.cc`` (dynamic_lstm),
+``gru_op.cc`` (dynamic_gru), ``gru_unit_op.cc``, ``cudnn_lstm_op.cu.cc``
+(multi-layer cudnn lstm). The reference consumes LoDTensors (packed
+variable-length rows, math/lstm compute batched by sorted length); the
+TPU-native redesign consumes padded ``[B, T, ...]`` tensors plus an integer
+``length [B]`` and masks the carry so padded steps are identity — static
+shapes for XLA, with `lax.scan` giving a single fused-loop HLO whose per-step
+matmuls land on the MXU.
+
+Gate layouts follow the reference weight packing so checkpoints translate:
+  LSTM projected input / recurrent weight columns: [i, f, c(candidate), o]
+  (math/detail/lstm_kernel.h activation order; lstm_op.cc W shape [H, 4H]).
+  GRU weight: [H, 3H] with first 2H = update/reset gates, last H = candidate
+  (gru_op.cc weight layout).
+
+All ops here are differentiable through the scan (vjp tape — the functional
+equivalent of lstm_grad/gru_grad kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import length_mask, opt_input
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _mask_carry(new, old, mask_t):
+    """Keep `new` where the step is inside the sequence, else carry `old`."""
+    m = mask_t.reshape(-1, 1).astype(new.dtype)
+    return new * m + old * (1.0 - m)
+
+
+@register_op("lstm", nondiff_inputs=["Length"])
+def _lstm(ctx, inputs, attrs):
+    """dynamic_lstm: Input [B,T,4H] (already x@Wx projected, as in the
+    reference where fc is applied before lstm_op), Weight [H,4H] recurrent,
+    Bias [4H] (or [7H] with peepholes), optional H0/C0 [B,H], Length [B].
+
+    Outputs: Hidden [B,T,H], Cell [B,T,H], LastH/LastC [B,H].
+    """
+    (x,) = inputs["Input"]
+    (w,) = inputs["Weight"]
+    bias = opt_input(inputs, "Bias")
+    length = opt_input(inputs, "Length")
+    h0 = opt_input(inputs, "H0")
+    c0 = opt_input(inputs, "C0")
+
+    B, T, four_h = x.shape
+    H = four_h // 4
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
+    use_peepholes = attrs.get("use_peepholes", False) and bias is not None and bias.shape[-1] == 7 * H
+    is_reverse = attrs.get("is_reverse", False)
+
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), x.dtype)
+    mask = length_mask(length, B, T, x.dtype)
+
+    b_gates = None
+    if bias is not None:
+        b_gates = bias.reshape(-1)[: 4 * H]
+    if use_peepholes:
+        pk = bias.reshape(-1)
+        w_ic, w_fc, w_oc = pk[4 * H:5 * H], pk[5 * H:6 * H], pk[6 * H:7 * H]
+
+    xs = jnp.swapaxes(x, 0, 1)          # [T,B,4H]
+    ms = jnp.swapaxes(mask, 0, 1)       # [T,B]
+    if is_reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(carry, xm):
+        h_prev, c_prev = carry
+        xt, mt = xm
+        gates = xt + h_prev @ w
+        if b_gates is not None:
+            gates = gates + b_gates
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c_prev + i * cand_act(gc)
+        if use_peepholes:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        h_new = _mask_carry(h_new, h_prev, mt)
+        c_new = _mask_carry(c_new, c_prev, mt)
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_last, c_last), (hs, cs) = lax.scan(step, (h0, c0), (xs, ms))
+    if is_reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [hidden], "Cell": [cell],
+            "LastH": [h_last], "LastC": [c_last]}
+
+
+@register_op("gru", nondiff_inputs=["Length"])
+def _gru(ctx, inputs, attrs):
+    """dynamic_gru: Input [B,T,3H] projected, Weight [H,3H]
+    (first 2H update/reset, last H candidate — gru_op.cc layout),
+    Bias [3H], optional H0, Length. Output Hidden [B,T,H], LastH [B,H]."""
+    (x,) = inputs["Input"]
+    (w,) = inputs["Weight"]
+    bias = opt_input(inputs, "Bias")
+    length = opt_input(inputs, "Length")
+    h0 = opt_input(inputs, "H0")
+
+    B, T, three_h = x.shape
+    H = three_h // 3
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACTS[attrs.get("activation", "tanh")]
+    is_reverse = attrs.get("is_reverse", False)
+    origin_mode = attrs.get("origin_mode", False)
+
+    w_gates = w[:, : 2 * H]
+    w_cand = w[:, 2 * H:]
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    mask = length_mask(length, B, T, x.dtype)
+    b = None if bias is None else bias.reshape(-1)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+    if is_reverse:
+        xs, ms = xs[::-1], ms[::-1]
+
+    def step(h_prev, xm):
+        xt, mt = xm
+        xg = xt[:, : 2 * H]
+        xc = xt[:, 2 * H:]
+        if b is not None:
+            xg = xg + b[: 2 * H]
+            xc = xc + b[2 * H:]
+        uz = gate_act(xg + h_prev @ w_gates)
+        u, r = jnp.split(uz, 2, axis=-1)
+        c = cand_act(xc + (r * h_prev) @ w_cand)
+        if origin_mode:  # h = u*h_prev + (1-u)*c  (original Cho formulation)
+            h_new = u * h_prev + (1.0 - u) * c
+        else:            # paddle default: h = (1-u)*h_prev + u*c
+            h_new = (1.0 - u) * h_prev + u * c
+        h_new = _mask_carry(h_new, h_prev, mt)
+        return h_new, h_new
+
+    h_last, hs = lax.scan(step, h0, (xs, ms))
+    if is_reverse:
+        hs = hs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": [hidden], "LastH": [h_last]}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, inputs, attrs):
+    """Single GRU step (gru_unit_op.cc): Input [B,3H] projected, HiddenPrev
+    [B,H], Weight [H,3H], Bias [3H]."""
+    (x,) = inputs["Input"]
+    (h_prev,) = inputs["HiddenPrev"]
+    (w,) = inputs["Weight"]
+    bias = opt_input(inputs, "Bias")
+    H = h_prev.shape[-1]
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACTS[attrs.get("activation", "tanh")]
+
+    xg, xc = x[:, : 2 * H], x[:, 2 * H:]
+    if bias is not None:
+        b = bias.reshape(-1)
+        xg = xg + b[: 2 * H]
+        xc = xc + b[2 * H:]
+    uz = gate_act(xg + h_prev @ w[:, : 2 * H])
+    u, r = jnp.split(uz, 2, axis=-1)
+    c = cand_act(xc + (r * h_prev) @ w[:, 2 * H:])
+    h_new = (1.0 - u) * h_prev + u * c
+    return {"Hidden": [h_new], "Gate": [jnp.concatenate([u, r], -1)],
+            "ResetHiddenPrev": [r * h_prev]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, inputs, attrs):
+    """Single LSTM step on pre-projected gates (lstm_unit_op.cc):
+    X [B,4H] = x@Wx + h@Wh (+b), C_prev [B,H]. Gate order [i,f,c,o]."""
+    (gates,) = inputs["X"]
+    (c_prev,) = inputs["C_prev"]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("cudnn_lstm", nondiff_inputs=["Length"])
+def _multilayer_lstm(ctx, inputs, attrs):
+    """Multi-layer (optionally bidirectional) LSTM — cudnn_lstm_op.cu.cc
+    capability on TPU: stacked scans, XLA-fused. Input [B,T,D] raw (not
+    projected); weights passed as flat lists.
+
+    inputs: Input, WeightX (num_dirs*layers entries [Din,4H]), WeightH
+    ([H,4H] each), Bias ([4H] each), Length.
+    attrs: num_layers, is_bidirec, hidden_size, dropout_prob.
+    """
+    (x,) = inputs["Input"]
+    wxs = inputs["WeightX"]
+    whs = inputs["WeightH"]
+    biases = inputs.get("Bias", [None] * len(wxs))
+    length = opt_input(inputs, "Length")
+    num_layers = attrs.get("num_layers", 1)
+    bidirec = attrs.get("is_bidirec", False)
+    dropout_p = attrs.get("dropout_prob", 0.0)
+    num_dirs = 2 if bidirec else 1
+
+    B, T, _ = x.shape
+    H = attrs["hidden_size"]
+
+    def run_dir(inp, wx, wh, b, reverse):
+        proj = jnp.einsum("btd,dh->bth", inp, wx)
+        out = _lstm(ctx, {"Input": [proj], "Weight": [wh],
+                          "Bias": [b] if b is not None else [],
+                          "Length": [length] if length is not None else []},
+                    {"is_reverse": reverse})
+        return out["Hidden"][0], out["LastH"][0], out["LastC"][0]
+
+    cur = x
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(num_dirs):
+            k = layer * num_dirs + d
+            hid, lh, lc = run_dir(cur, wxs[k], whs[k], biases[k], d == 1)
+            outs.append(hid)
+            last_hs.append(lh)
+            last_cs.append(lc)
+        cur = jnp.concatenate(outs, -1) if num_dirs == 2 else outs[0]
+        if dropout_p > 0.0 and not ctx.is_test and layer < num_layers - 1:
+            keep = 1.0 - dropout_p
+            cur = cur * jax.random.bernoulli(ctx.rng(), keep, cur.shape) / keep
+    return {"Out": [cur],
+            "LastH": [jnp.stack(last_hs)], "LastC": [jnp.stack(last_cs)]}
