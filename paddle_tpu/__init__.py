@@ -26,6 +26,8 @@ from . import contrib  # noqa: F401
 from . import debugger  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import io  # noqa: F401
+from . import ir  # noqa: F401
+from . import inference  # noqa: F401
 from . import metrics  # noqa: F401
 from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401

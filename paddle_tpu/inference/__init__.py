@@ -1,0 +1,269 @@
+"""Inference engine: analysis config + optimized ahead-of-time predictor.
+
+Reference analog: ``paddle/fluid/inference/`` — `AnalysisConfig`
+(api/paddle_analysis_config.h), `AnalysisPredictor`
+(api/analysis_predictor.cc: Run:216, OptimizeInferenceProgram:462,
+CreatePaddlePredictor:479 with clone-shared weights), `NaiveExecutor`
+(framework/naive_executor.cc), and the analysis pass pipeline
+(analysis/ir_pass_manager.cc).
+
+TPU-native redesign: "analysis" = the ir pass pipeline (delete-dropout →
+fc/add-act fusion → constant folding → DCE → liveness/donation), then the
+whole pruned program is traced ONCE and jit-compiled ahead of time per input
+signature — there is no per-op executor at serve time, so NaiveExecutor's
+no-GC op loop collapses into a cached XLA executable. TensorRT/Anakin/nGraph
+subgraph engines have no TPU meaning (XLA is the engine) and are absent.
+Weight sharing across clones = sharing the same device arrays (zero-copy).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.program import Program
+from ..core.scope import Scope, scope_guard
+from ..ir import PassBuilder
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "AnalysisConfig", "create_paddle_predictor"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    # API-compat alias: the reference's Half means fp16 on GPU; on TPU the
+    # low-precision serving dtype is bf16.
+    Half = "bfloat16"
+
+
+class Config:
+    """AnalysisConfig parity (paddle_analysis_config.h)."""
+
+    Precision = PrecisionType
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 model_filename: Optional[str] = None,
+                 params_filename: Optional[str] = None):
+        self._model_dir = model_dir
+        self._model_filename = model_filename
+        self._params_filename = params_filename
+        self._ir_optim = True
+        self._memory_optim = True
+        self._precision = PrecisionType.Float32
+        self._passes_deleted: List[str] = []
+        self._extra_passes: List[str] = []
+
+    # -- model location ----------------------------------------------------
+    def set_model(self, model_dir: str, params_file: Optional[str] = None):
+        self._model_dir = model_dir
+        if params_file:
+            self._params_filename = params_file
+
+    def model_dir(self) -> Optional[str]:
+        return self._model_dir
+
+    # -- switches (reference switch_* API) ---------------------------------
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def enable_tpu(self, precision: str = PrecisionType.Float32):
+        self._precision = precision
+
+    # API-compat no-ops (no CUDA/MKLDNN in this build)
+    def enable_use_gpu(self, *a, **kw):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass
+
+    def delete_pass(self, name: str):
+        self._passes_deleted.append(name)
+
+    def append_pass(self, name: str):
+        self._extra_passes.append(name)
+
+    def pass_builder(self) -> PassBuilder:
+        names = ["delete_dropout_op_pass", "fc_fuse_pass",
+                 "fuse_elewise_add_act_pass", "constant_folding_pass",
+                 "dead_code_elimination_pass"]
+        if self._memory_optim:
+            names.append("memory_optimize_pass")
+        names += self._extra_passes
+        return PassBuilder([n for n in names if n not in self._passes_deleted])
+
+
+AnalysisConfig = Config  # old-API alias (paddle_analysis_config.h)
+
+
+class Tensor:
+    """Serve-side tensor handle (reference PaddleTensor / ZeroCopyTensor:
+    copy_from_cpu / copy_to_cpu API)."""
+
+    def __init__(self, name: str, predictor: "Predictor"):
+        self.name = name
+        self._predictor = predictor
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._predictor._feed_buf[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._predictor._fetch_buf[self.name])
+
+    def reshape(self, shape: Sequence[int]):
+        buf = self._predictor._feed_buf.get(self.name)
+        if buf is not None:
+            self._predictor._feed_buf[self.name] = buf.reshape(shape)
+
+    def shape(self):
+        buf = (self._predictor._feed_buf.get(self.name)
+               if self.name in self._predictor._feed_buf
+               else self._predictor._fetch_buf.get(self.name))
+        return list(buf.shape) if buf is not None else None
+
+
+class Predictor:
+    """AnalysisPredictor parity: load → optimize → AOT-jit → run."""
+
+    def __init__(self, config: Config, _shared=None):
+        import jax
+        self._config = config
+        self._jax = jax
+        self._cache: Dict = {}
+        self._feed_buf: Dict[str, np.ndarray] = {}
+        self._fetch_buf: Dict[str, np.ndarray] = {}
+        if _shared is not None:
+            # clone path (analysis_predictor.cc:479): share program + weights
+            self._program, self._feed_names, self._fetch_names, self._state = _shared
+            return
+        self._load_and_optimize()
+
+    def _load_and_optimize(self):
+        import jax.numpy as jnp
+        from .. import io
+        from ..core.executor import Executor, TPUPlace
+
+        cfg = self._config
+        if cfg.model_dir() is None:
+            raise ValueError("Config.set_model(dir) required")
+        scope = Scope()
+        with scope_guard(scope):
+            exe = Executor(TPUPlace())
+            program, feed_names, fetch_vars = io.load_inference_model(
+                cfg.model_dir(), exe,
+                model_filename=cfg._model_filename,
+                params_filename=cfg._params_filename)
+        fetch_names = [v.name for v in fetch_vars]
+        if cfg.ir_optim():
+            builder = cfg.pass_builder()
+            program = builder.apply_all(program, keep=fetch_names,
+                                        fetch_names=fetch_names)
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch_names = fetch_names
+        dtype = cfg._precision
+        self._state = {}
+        for v in program.list_vars():
+            if v.persistable and scope.has_var(v.name):
+                val = jnp.asarray(scope.find_var(v.name))
+                if dtype == PrecisionType.Bfloat16 and val.dtype == jnp.float32:
+                    val = val.astype(jnp.bfloat16)
+                self._state[v.name] = val
+
+    # -- reference API surface ---------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self)
+
+    # old-API spellings
+    get_input_tensor = get_input_handle
+    get_output_tensor = get_output_handle
+
+    def clone(self) -> "Predictor":
+        """New predictor sharing program + device weights (zero-copy; the
+        reference's clone-weights optimization)."""
+        return Predictor(self._config,
+                         _shared=(self._program, self._feed_names,
+                                  self._fetch_names, self._state))
+
+    def run(self, feed: Optional[Dict[str, np.ndarray]] = None) -> List[np.ndarray]:
+        """Run once. Either pass `feed` directly or pre-fill input handles
+        via copy_from_cpu (zero-copy-run style) and call run()."""
+        import jax.numpy as jnp
+
+        feed = dict(feed) if feed is not None else dict(self._feed_buf)
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing feeds: {missing}")
+        blk = self._program.global_block()
+        feed_vals = {}
+        for n in self._feed_names:
+            var = blk._find_var_recursive(n)
+            # copy=True: feed buffers may be donated to jit (see _compile);
+            # never alias (and so never donate) a caller-owned jax array
+            val = jnp.array(feed[n], dtype=var.dtype if var is not None else None,
+                            copy=True)
+            if (self._config._precision == PrecisionType.Bfloat16
+                    and val.dtype == jnp.float32):
+                val = val.astype(jnp.bfloat16)
+            feed_vals[n] = val
+
+        sig = tuple((n, tuple(v.shape), str(v.dtype))
+                    for n, v in sorted(feed_vals.items()))
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._compile()
+            self._cache[sig] = fn
+        outs = fn(self._state, feed_vals)
+        outs = [np.asarray(o) for o in outs]
+        self._fetch_buf = dict(zip(self._fetch_names, outs))
+        return outs
+
+    def _compile(self):
+        from ..core.executor import ExecContext, _run_block
+
+        block = self._program.global_block()
+        fetch_names = self._fetch_names
+
+        def serve(state, feed):
+            env = dict(state)
+            env.update(feed)
+            ctx = ExecContext(None, is_test=True)
+            _run_block(block, env, ctx)
+            return [env[n] for n in fetch_names]
+
+        # Donate feed buffers only when memory_optimize_pass marked every
+        # feed donatable (weights are shared across clones — never donated);
+        # run() always hands jit freshly-copied feed arrays.
+        donatable = set(getattr(self._program, "_donatable_feeds", ()))
+        donate = tuple([1] if donatable >= set(self._feed_names) else [])
+        return self._jax.jit(serve, donate_argnums=donate)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def create_paddle_predictor(config: Config) -> Predictor:
+    """Old-API alias (CreatePaddlePredictor)."""
+    return Predictor(config)

@@ -111,6 +111,12 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
     """io.py:933 parity: prune to feed→fetch, save program + params."""
     main_program = main_program or default_main_program()
     fetch_names = [t.name for t in target_vars]
+    blk = main_program.global_block()
+    missing = [n for n in fetch_names if not blk.has_var(n)]
+    if missing:
+        raise ValueError(
+            f"target_vars {missing} are not in main_program — were they "
+            f"created under a different program (check program_guard scope)?")
     pruned = main_program._prune_for_inference(feeded_var_names, fetch_names)
     os.makedirs(dirname, exist_ok=True)
     model = {

@@ -1,0 +1,11 @@
+"""Graph IR + pass framework (reference ``paddle/fluid/framework/ir/``).
+
+See graph.py / pass_base.py / passes.py docstrings for the TPU-native design
+stance: the Block is the storage, Graph is an analysis view, passes do only
+what XLA can't (pruning, program-level fusion, folding, donation, viz).
+"""
+from .graph import Graph, sub_block_var_reads  # noqa: F401
+from .pass_base import (  # noqa: F401
+    Pass, PassBuilder, apply_pass, get_pass, register_pass, registered_passes,
+)
+from . import passes  # noqa: F401  (registers the standard passes)

@@ -1,0 +1,133 @@
+"""Graph view over a Program block.
+
+Reference analog: ``paddle/fluid/framework/ir/graph.h`` (ir::Graph — op/var
+nodes with def-use edges built from a ProgramDesc) and
+``ir/graph_helper.cc`` (topology sort, has-circle checks) and
+``ir/graph_pattern_detector.cc`` (PDPattern subgraph matching).
+
+TPU-native redesign: the graph is an *analysis view*, not a second IR. Passes
+read def-use chains off this view and mutate the underlying Block op list
+directly; there is no Graph→Program conversion step because the Block IS the
+storage (the ProgramDesc↔ir::Graph round-trip of graph.cc disappears). The
+pattern detector is reduced to linear-chain matching, which covers every fuse
+pass we implement — XLA's fusion pass owns the general case.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ..core.program import Block, Operator, Program
+
+
+class Graph:
+    """Def-use view of one Block (rebuild after structural mutation)."""
+
+    def __init__(self, block: Block):
+        self.block = block
+        self.producers: Dict[str, List[Operator]] = {}
+        self.consumers: Dict[str, List[Operator]] = {}
+        for op in block.ops:
+            for name in op.output_names():
+                self.producers.setdefault(name, []).append(op)
+            for name in op.input_names():
+                self.consumers.setdefault(name, []).append(op)
+
+    @property
+    def ops(self) -> List[Operator]:
+        return self.block.ops
+
+    def producer(self, var_name: str) -> Optional[Operator]:
+        """Last writer of `var_name` (SSA-ish: blocks rarely rewrite vars)."""
+        ops = self.producers.get(var_name)
+        return ops[-1] if ops else None
+
+    def consumers_of(self, var_name: str) -> List[Operator]:
+        return self.consumers.get(var_name, [])
+
+    def num_consumers(self, var_name: str) -> int:
+        return len(self.consumers.get(var_name, []))
+
+    def topology_sort(self) -> List[Operator]:
+        """Dependency order of ops (ir/graph_helper.cc TopologySortOperations).
+        Block order is already topological for well-formed programs; this
+        validates it and is the hook for passes that reorder."""
+        produced = set()
+        pending = list(self.block.ops)
+        out: List[Operator] = []
+        external = self._external_inputs()
+        for _ in range(len(pending) + 1):
+            rest = []
+            for op in pending:
+                deps = set(op.input_names()) - produced - external
+                if not deps:
+                    out.append(op)
+                    produced |= set(op.output_names())
+                else:
+                    rest.append(op)
+            if not rest:
+                return out
+            if len(rest) == len(pending):
+                raise ValueError(f"cycle or undefined inputs in graph: {rest[:3]}")
+            pending = rest
+        return out
+
+    def _external_inputs(self) -> set:
+        """Vars read but never written in this block: feeds, params, parent vars."""
+        written = set()
+        for op in self.block.ops:
+            written |= set(op.output_names())
+        ext = set()
+        for op in self.block.ops:
+            ext |= set(op.input_names()) - written
+        return ext
+
+    def find_chains(self, types: Sequence[str],
+                    single_consumer_mid: bool = True) -> List[List[Operator]]:
+        """Find op chains op0→op1→…, where each link is "first output slot of
+        op[i] is an input of op[i+1]" and (optionally) every intermediate var
+        has exactly one consumer. The linear-chain specialization of
+        graph_pattern_detector.cc — sufficient for the fuse passes here."""
+        chains: List[List[Operator]] = []
+        for op in self.block.ops:
+            if op.type != types[0]:
+                continue
+            chain = [op]
+            ok = True
+            for nxt_type in types[1:]:
+                outs = chain[-1].output_names()
+                if len(outs) != 1:
+                    ok = False
+                    break
+                mid = outs[0]
+                cons = self.consumers_of(mid)
+                if single_consumer_mid and len(cons) != 1:
+                    ok = False
+                    break
+                nxt = next((c for c in cons if c.type == nxt_type), None)
+                if nxt is None:
+                    ok = False
+                    break
+                chain.append(nxt)
+            if ok:
+                chains.append(chain)
+        return chains
+
+    def replace_chain(self, chain: List[Operator], new_op: Operator):
+        """Splice `new_op` where `chain` started; drop the rest of the chain."""
+        idx = self.block.ops.index(chain[0])
+        self.block.ops[idx] = new_op
+        for op in chain[1:]:
+            self.block.ops.remove(op)
+        self.block.program._bump_version()
+
+
+def sub_block_var_reads(program: Program, block: Block) -> set:
+    """Var names read by ops in OTHER blocks (sub-blocks can read parent
+    vars) — these must be treated as live roots by elimination passes."""
+    names = set()
+    for b in program.blocks:
+        if b is block:
+            continue
+        for op in b.ops:
+            names |= set(op.input_names()) | set(op.output_names())
+    return names

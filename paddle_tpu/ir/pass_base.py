@@ -1,0 +1,86 @@
+"""Pass framework: named program→program transforms + registry + pipelines.
+
+Reference analog: ``paddle/fluid/framework/ir/pass.h`` (Pass::Apply,
+PassRegistry, REGISTER_PASS) and the BuildStrategy pass pipeline assembly in
+``details/build_strategy.cc:46-235``; python-side PassBuilder exposed at
+pybind.cc:1152.
+
+TPU-native: passes run at program-build time in Python (the graph is a staging
+IR — see core/program.py); XLA owns codegen-level fusion/layout, so our passes
+do only what XLA cannot see: program pruning, op-level algebraic rewrites,
+inference cleanup, donation/liveness annotation, and debugging dumps.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..core.program import Program
+
+
+class Pass:
+    """Subclass and override apply_impl(program, **kw) -> program."""
+
+    name: str = ""
+
+    def apply(self, program: Program, **kw) -> Program:
+        out = self.apply_impl(program, **kw)
+        return out if out is not None else program
+
+    def apply_impl(self, program: Program, **kw) -> Optional[Program]:
+        raise NotImplementedError
+
+
+_PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(cls: type) -> type:
+    """REGISTER_PASS(name, class) analog (ir/pass.h:~196)."""
+    if not cls.name:
+        raise ValueError(f"pass class {cls.__name__} needs a `name`")
+    if cls.name in _PASS_REGISTRY:
+        raise ValueError(f"pass {cls.name!r} registered twice")
+    _PASS_REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_pass(name: str) -> Pass:
+    if name not in _PASS_REGISTRY:
+        raise KeyError(f"unknown pass {name!r}; have {sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name]()
+
+
+def registered_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+def apply_pass(program: Program, name: str, **kw) -> Program:
+    return get_pass(name).apply(program, **kw)
+
+
+class PassBuilder:
+    """Ordered pass pipeline (pybind PassBuilder / BuildStrategy pipeline)."""
+
+    def __init__(self, passes: Optional[List[str]] = None):
+        self._passes: List[str] = list(passes or [])
+
+    def append_pass(self, name: str) -> "PassBuilder":
+        get_pass(name)  # validate early
+        self._passes.append(name)
+        return self
+
+    def insert_pass(self, idx: int, name: str) -> "PassBuilder":
+        get_pass(name)
+        self._passes.insert(idx, name)
+        return self
+
+    def remove_pass(self, name: str) -> "PassBuilder":
+        self._passes.remove(name)
+        return self
+
+    def all_passes(self) -> List[str]:
+        return list(self._passes)
+
+    def apply_all(self, program: Program, **kw) -> Program:
+        for name in self._passes:
+            program = apply_pass(program, name, **kw)
+        return program

@@ -1,0 +1,356 @@
+"""Program-level optimization passes.
+
+Reference analogs (each class cites its own): the ~40 fuse/memory passes under
+``paddle/fluid/framework/ir/`` (SURVEY §2.1). On TPU most of that pipeline is
+XLA's job — elementwise fusion, layout, buffer reuse, scheduling all happen in
+the compiler. What remains profitable at the program level, and is implemented
+here, is:
+
+- graph *pruning* (dead code, inference cleanup) — shrinks what gets traced;
+- algebraic *op fusion* that changes the traced graph shape (fc fuse,
+  add+act fuse) — fewer ops to trace/tape, and a single fused op is the unit
+  the autodiff tape sees;
+- *constant folding* — moves build-time-known compute out of the step;
+- *liveness/donation annotation* — tells jit which buffers to donate;
+- *visualization* — graphviz dump (ir/graph_viz_pass.cc parity).
+
+Passes that exist in the reference purely to work around its op-by-op runtime
+(runtime_context_cache, sequential_execution, all_reduce_deps, sync-stream
+placement…) have no TPU equivalent and are intentionally absent.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.program import Block, Operator, Program
+from ..core.registry import get_op, has_op
+from .graph import Graph, sub_block_var_reads
+from .pass_base import Pass, register_pass
+
+# Ops whose execution has effects beyond their outputs — never eliminated,
+# never folded (reference: OpProtoMaker "skip pruning" + hard-coded lists in
+# prune.cc / constant-folding heuristics).
+SIDE_EFFECT_OPS = {
+    "feed", "fetch", "print", "py_func", "save", "load", "save_combine",
+    "load_combine", "assert", "while", "conditional_block", "switch",
+    "increment", "beam_search", "beam_search_decode",
+}
+
+RANDOM_OPS = {
+    "uniform_random", "gaussian_random", "truncated_gaussian_random",
+    "randint", "dropout", "randperm", "sampling_id",
+}
+
+
+def _has_block_attr(op: Operator) -> bool:
+    return any(isinstance(v, Block) for v in op.attrs.values())
+
+
+def _is_protected(op: Operator) -> bool:
+    return (op.type in SIDE_EFFECT_OPS or op.type.startswith("c_")
+            or _has_block_attr(op))
+
+
+def _fuse_protected_vars(program: Program, keep, fetch_names) -> set:
+    """Vars a fuse pass must not erase as chain intermediates: fetch/keep
+    targets, persistable vars, and anything read by sub-blocks."""
+    protected = set(keep or []) | set(fetch_names or [])
+    protected |= {v.name for v in program.list_vars() if v.persistable}
+    protected |= sub_block_var_reads(program, program.global_block())
+    return protected
+
+
+@register_pass
+class DeadCodeEliminationPass(Pass):
+    """Remove ops whose outputs are never read (ir/ graph pruning + the
+    Program._prune path, framework/prune.cc). Roots: caller-specified
+    fetch/keep names, persistable vars, side-effect ops, sub-block reads."""
+
+    name = "dead_code_elimination_pass"
+
+    def apply_impl(self, program: Program, keep: Optional[List[str]] = None, **kw):
+        blk = program.global_block()
+        live = set(keep or [])
+        live |= {v.name for v in program.list_vars() if v.persistable}
+        live |= sub_block_var_reads(program, blk)
+        kept: List[Operator] = []
+        for op in reversed(blk.ops):
+            outs = set(op.output_names())
+            if _is_protected(op) or outs & live:
+                kept.append(op)
+                live |= set(op.input_names())
+        removed = len(blk.ops) - len(kept)
+        blk.ops = list(reversed(kept))
+        if removed:
+            program._bump_version()
+        return program
+
+
+@register_pass
+class DeleteDropoutOpPass(Pass):
+    """Inference cleanup: dropout becomes its is_test form (dropout_op.cc) —
+    `downgrade_in_infer` scales by (1-p), `upscale_in_train` is identity — so
+    downstream passes and DCE see a trivial op instead of an rng consumer."""
+
+    name = "delete_dropout_op_pass"
+
+    def apply_impl(self, program: Program, **kw):
+        changed = False
+        for blk in program.blocks:
+            for i, op in enumerate(blk.ops):
+                if op.type == "dropout":
+                    p = op.attr("dropout_prob", 0.5)
+                    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+                    if impl == "downgrade_in_infer" and p > 0.0:
+                        blk.ops[i] = Operator(
+                            blk, "scale", {"X": op.input("X")},
+                            {"Out": op.output("Out")}, {"scale": 1.0 - p})
+                    else:
+                        blk.ops[i] = Operator(
+                            blk, "assign",
+                            {"X": op.input("X")}, {"Out": op.output("Out")})
+                    changed = True
+        if changed:
+            program._bump_version()
+        return program
+
+
+@register_pass
+class ConstantFoldingPass(Pass):
+    """Evaluate ops whose inputs are all build-time constants and bake the
+    result in as `assign_value` (inference analysis' constant_folding;
+    combined with DCE this freezes e.g. shape/fill/scale chains)."""
+
+    name = "constant_folding_pass"
+
+    FOLD_SOURCES = {"fill_constant", "assign_value", "eye", "range", "linspace"}
+
+    def apply_impl(self, program: Program, **kw):
+        from ..core.executor import ExecContext
+
+        blk = program.global_block()
+        graph = Graph(blk)
+        const_vals: Dict[str, np.ndarray] = {}
+        changed = False
+        try:
+            order = graph.topology_sort()
+        except ValueError:
+            return program
+        for op in order:
+            if _is_protected(op) or op.type in RANDOM_OPS or not has_op(op.type):
+                continue
+            if op.type in self.FOLD_SOURCES and not op.inputs:
+                pass  # source: evaluate below, keep the op itself
+            elif not op.inputs or not all(
+                    n in const_vals for n in op.input_names()):
+                continue
+            try:
+                inputs = {slot: [const_vals[n] for n in names]
+                          for slot, names in op.inputs.items()}
+                ctx = ExecContext(None, is_test=True)
+                outs = get_op(op.type).fn(ctx, inputs, op.attrs)
+            except Exception:
+                continue
+            for slot, vals in outs.items():
+                for name, val in zip(op.output(slot), vals):
+                    const_vals[name] = np.asarray(val)
+            if op.type not in self.FOLD_SOURCES:
+                idx = blk.ops.index(op)
+                new_ops = []
+                for slot, names in op.outputs.items():
+                    for name in names:
+                        arr = const_vals[name]
+                        new_ops.append(Operator(
+                            blk, "assign_value", {}, {"Out": [name]},
+                            {"values": arr, "shape": list(arr.shape),
+                             "dtype": str(arr.dtype)}))
+                blk.ops[idx:idx + 1] = new_ops
+                changed = True
+        if changed:
+            program._bump_version()
+        return program
+
+
+@register_pass
+class FuseElewiseAddActPass(Pass):
+    """elementwise_add → {relu,tanh,sigmoid,gelu} with a single-consumer
+    intermediate becomes one `fused_elemwise_activation` op
+    (ir/fuse_elewise_add_act_pass.cc)."""
+
+    name = "fuse_elewise_add_act_pass"
+
+    ACTS = ("relu", "tanh", "sigmoid", "gelu")
+
+    def apply_impl(self, program: Program, keep: Optional[List[str]] = None,
+                   fetch_names: Optional[List[str]] = None, **kw):
+        blk = program.global_block()
+        protected = _fuse_protected_vars(program, keep, fetch_names)
+        changed = False
+        for act in self.ACTS:
+            graph = Graph(blk)
+            for chain in graph.find_chains(["elementwise_add", act]):
+                add, actop = chain
+                if add.output("Out")[0] in protected:
+                    continue
+                fused = Operator(
+                    blk, "fused_elemwise_activation",
+                    {"X": add.input("X"), "Y": add.input("Y")},
+                    {"Out": actop.output("Out")},
+                    {"functor_list": ["elementwise_add", act],
+                     "axis": add.attr("axis", -1)})
+                graph.replace_chain(chain, fused)
+                changed = True
+        if changed:
+            program._bump_version()
+        return program
+
+
+@register_pass
+class FcFusePass(Pass):
+    """mul → elementwise_add (→ act) becomes one `fused_fc` op
+    (ir/fc_fuse_pass.cc): a single gemm+bias+act unit for the MXU."""
+
+    name = "fc_fuse_pass"
+
+    ACTS = ("relu", "tanh", "sigmoid", "gelu")
+
+    def apply_impl(self, program: Program, keep: Optional[List[str]] = None,
+                   fetch_names: Optional[List[str]] = None, **kw):
+        blk = program.global_block()
+        protected = _fuse_protected_vars(program, keep, fetch_names)
+        changed = False
+        # longest patterns first: mul+add+act must win over bare mul+add,
+        # else the act round never matches (its mul is already consumed)
+        for act in self.ACTS + (None,):
+            types = ["mul", "elementwise_add"] + ([act] if act else [])
+            graph = Graph(blk)
+            for chain in graph.find_chains(types):
+                mul, add = chain[0], chain[1]
+                # bias must be the Y side of the add
+                if add.input("X") != mul.output("Out"):
+                    continue
+                if any(o.output("Out")[0] in protected for o in chain[:-1]):
+                    continue
+                # bias must be a 1-D last-dim vector (fc_fuse_pass.cc checks
+                # bias dims); other axes/shapes don't match fused_fc's
+                # (1, N) broadcast and must not fuse
+                bias_var = blk._find_var_recursive(add.input("Y")[0])
+                if add.attr("axis", -1) not in (-1, 1):
+                    continue
+                if bias_var is None or bias_var.shape is None or len(
+                        [d for d in bias_var.shape if d != 1]) > 1:
+                    continue
+                fused = Operator(
+                    blk, "fused_fc",
+                    {"Input": mul.input("X"), "W": mul.input("Y"),
+                     "Bias": add.input("Y")},
+                    {"Out": chain[-1].output("Out")},
+                    {"in_num_col_dims": mul.attr("x_num_col_dims", 1),
+                     "activation_type": act or ""})
+                graph.replace_chain(chain, fused)
+                changed = True
+        if changed:
+            program._bump_version()
+        return program
+
+
+@register_pass
+class MemoryOptimizePass(Pass):
+    """Liveness analysis + buffer-reuse plan + donation annotation.
+
+    Reference: ir/memory_optimize_pass/ (reference_count_pass, eager_deletion,
+    buffer_shared_inplace, cross-op memory reuse). On TPU, XLA performs the
+    actual buffer assignment; what this pass contributes is (a) a reuse/peak
+    report for debugging (`program._memory_plan`), and (b) the set of feed
+    buffers safe to donate to jit (`program._donatable_feeds`) — consumed by
+    the inference Predictor's donate_argnums."""
+
+    name = "memory_optimize_pass"
+
+    def apply_impl(self, program: Program, fetch_names: Optional[List[str]] = None, **kw):
+        blk = program.global_block()
+        fetch = set(fetch_names or [])
+        persist = {v.name for v in program.list_vars() if v.persistable}
+        sub_reads = sub_block_var_reads(program, blk)
+        first_def: Dict[str, int] = {}
+        last_use: Dict[str, int] = {}
+        for i, op in enumerate(blk.ops):
+            for n in op.input_names():
+                # external inputs (feeds) are live from step start
+                first_def.setdefault(n, -1)
+                last_use[n] = i
+            for n in op.output_names():
+                first_def.setdefault(n, i)
+                last_use[n] = i
+
+        def nbytes(name: str) -> int:
+            v = blk._find_var_recursive(name)
+            if v is None or v.shape is None or any(d is None or d < 0 for d in v.shape):
+                return 0
+            return int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+
+        reuse: Dict[str, str] = {}
+        free_pool: List[str] = []
+        events: List = []
+        for name, i in first_def.items():
+            if name in persist or name in fetch or name in sub_reads:
+                continue
+            events.append((i, 0, name))
+        for name, i in last_use.items():
+            if name in persist or name in fetch or name in sub_reads:
+                continue
+            events.append((i, 1, name))
+        events.sort()
+        live_bytes = peak = 0
+        for _, kind, name in events:
+            if kind == 0:
+                donor = next((d for d in free_pool if nbytes(d) >= nbytes(name) > 0), None)
+                if donor is not None:
+                    free_pool.remove(donor)
+                    reuse[name] = donor
+                else:
+                    live_bytes += nbytes(name)
+                peak = max(peak, live_bytes)
+            else:
+                if name not in reuse:
+                    live_bytes -= nbytes(name)
+                free_pool.append(name)
+
+        feeds = [v.name for v in program.list_vars() if v.is_data]
+        program._memory_plan = {
+            "reuse": reuse,
+            "peak_bytes_planned": peak,
+            "n_temporaries": len(first_def),
+        }
+        program._donatable_feeds = [n for n in feeds if n not in fetch]
+        return program
+
+
+@register_pass
+class GraphVizPass(Pass):
+    """Dump the program as graphviz dot (ir/graph_viz_pass.cc,
+    debug_graphviz_path_ build_strategy.h:71)."""
+
+    name = "graph_viz_pass"
+
+    def apply_impl(self, program: Program, path: Optional[str] = None, **kw):
+        lines = ["digraph G {", "  rankdir=TB;"]
+        for b in program.blocks:
+            for i, op in enumerate(b.ops):
+                op_id = f"op_{b.idx}_{i}"
+                lines.append(f'  {op_id} [label="{op.type}", shape=box, style=filled, fillcolor=lightblue];')
+                for n in op.input_names():
+                    lines.append(f'  "var_{n}" [label="{n}", shape=ellipse];')
+                    lines.append(f'  "var_{n}" -> {op_id};')
+                for n in op.output_names():
+                    lines.append(f'  "var_{n}" [label="{n}", shape=ellipse];')
+                    lines.append(f'  {op_id} -> "var_{n}";')
+        lines.append("}")
+        dot = "\n".join(lines)
+        if path:
+            with open(path, "w") as f:
+                f.write(dot)
+        program._graphviz_dot = dot
+        return program

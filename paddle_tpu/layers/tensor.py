@@ -282,3 +282,17 @@ def cumsum(x: Variable, axis=-1, exclusive=False, reverse=False) -> Variable:
     helper.append_op(type="cumsum", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
                      attrs={"axis": axis, "exclusive": exclusive, "reverse": reverse})
     return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None) -> Variable:
+    """Reference layers/tensor.py create_parameter: a free-standing trainable
+    parameter outside any layer."""
+    from ..param_attr import ParamAttr
+    helper = LayerHelper("create_parameter")
+    if attr is None:
+        attr = ParamAttr(name=name)
+    elif name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)

@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     crf_ops,
     detection_ops,
+    fused_ops,
     math_ops,
     metric_ops,
     nn_ops,

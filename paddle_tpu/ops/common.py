@@ -35,3 +35,21 @@ def length_mask(length, B, T, dtype):
     if length is None:
         return jnp.ones((B, T), dtype)
     return (jnp.arange(T)[None, :] < length.reshape(-1, 1)).astype(dtype)
+
+
+# Shared activation-name → jax fn map (activation_op.cc functor registry).
+# Used by fused ops, rnn cells, and fuse passes; "" / "identity" = no-op.
+def _identity(x):
+    return x
+
+
+def act_map():
+    import jax
+    return {
+        "": _identity,
+        "identity": _identity,
+        "relu": jax.nn.relu,
+        "tanh": jnp.tanh,
+        "sigmoid": jax.nn.sigmoid,
+        "gelu": jax.nn.gelu,
+    }

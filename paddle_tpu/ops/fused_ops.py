@@ -1,0 +1,44 @@
+"""Fused ops produced by the ir fuse passes.
+
+Reference analog: ``paddle/fluid/operators/fused/`` (fused_elemwise_activation
+_op.cc, fc_op via fc_fuse_pass). On TPU these exist so the *traced graph* has
+one op where the pattern had two/three — XLA then fuses the arithmetic into a
+single kernel around the MXU gemm; autodiff sees one tape entry.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import act_map, bcast_y, one, opt_input
+
+_ACTS = act_map()
+
+
+@register_op("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, inputs, attrs):
+    """act(add(x, y)) in one op (fused_elemwise_activation_op.cc)."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    binary, unary = attrs["functor_list"]
+    y = bcast_y(x, y, attrs.get("axis", -1))
+    binop = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply}[binary]
+    return one(_ACTS[unary](binop(x, y)))
+
+
+@register_op("fused_fc")
+def _fused_fc(ctx, inputs, attrs):
+    """gemm + bias + activation as one MXU-shaped unit (fc_fuse_pass.cc)."""
+    (x,) = inputs["Input"]
+    (w,) = inputs["W"]
+    b = opt_input(inputs, "Bias")
+    ncol = attrs.get("in_num_col_dims", 1)
+    lead = x.shape[:ncol]
+    x2 = x.reshape((int(np.prod(lead)) if lead else 1, -1))
+    out = jnp.matmul(x2, w)
+    if b is not None:
+        out = out + b.reshape((1, -1))
+    out = _ACTS[attrs.get("activation_type", "")](out)
+    return one(out.reshape(lead + (w.shape[-1],)))

@@ -25,14 +25,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
-from .common import length_mask, opt_input
+from .common import act_map, length_mask, opt_input
 
-_ACTS = {
-    "sigmoid": jax.nn.sigmoid,
-    "tanh": jnp.tanh,
-    "relu": jax.nn.relu,
-    "identity": lambda x: x,
-}
+_ACTS = act_map()
 
 
 def _mask_carry(new, old, mask_t):

@@ -1,0 +1,272 @@
+"""ir pass framework + inference engine tests.
+
+Mirrors the reference's pass unit tests (ir/*_pass_tester.cc style: build a
+small program, apply the pass, assert on the op set AND on numeric equality)
+and the inference save/load round-trip tests (test_inference_model_io.py,
+analyzer_*_tester.cc shape)."""
+import numpy as np
+import pytest
+
+
+def _build_mlp(seed=0):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(x, 32, act="relu")
+        out = fluid.layers.fc(h, 4, act=None)
+    return main, startup, out
+
+
+def test_pass_registry_lists_standard_passes():
+    from paddle_tpu import ir
+
+    have = ir.registered_passes()
+    for name in ["dead_code_elimination_pass", "fc_fuse_pass",
+                 "fuse_elewise_add_act_pass", "constant_folding_pass",
+                 "memory_optimize_pass", "graph_viz_pass",
+                 "delete_dropout_op_pass"]:
+        assert name in have
+
+
+def test_graph_topology_and_consumers():
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, _, out = _build_mlp()
+    g = ir.Graph(main.global_block())
+    order = g.topology_sort()
+    assert [o.type for o in order] == [o.type for o in main.global_block().ops]
+    # hidden activation of first fc is consumed exactly once
+    first_relu_out = [op for op in g.ops if op.type == "relu"][0].output("Out")[0]
+    assert g.num_consumers(first_relu_out) == 1
+
+
+def test_dce_removes_unused_branch():
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        kept = fluid.layers.fc(x, 4)
+        dead = fluid.layers.fc(x, 9)  # noqa: F841 — never fetched
+    n_before = len(main.global_block().ops)
+    ir.apply_pass(main, "dead_code_elimination_pass", keep=[kept.name])
+    n_after = len(main.global_block().ops)
+    assert n_after < n_before
+    names = {n for op in main.global_block().ops for n in op.output_names()}
+    assert kept.name in names
+    assert dead.name not in names
+
+
+def _run_simple(main, startup, feed, fetch):
+    import paddle_tpu as fluid
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=[fetch])[0]
+
+
+def test_fc_fuse_pass_numerics():
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, startup, out = _build_mlp()
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+
+    ref_main = main.clone()
+    ref = _run_simple(ref_main, startup.clone(), {"x": x}, out.name)
+
+    ir.apply_pass(main, "fc_fuse_pass")
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_fc" in types
+    assert "mul" not in types
+    # the relu of the first fc must be folded INTO fused_fc (act-first match)
+    assert "relu" not in types
+    fused = [op for op in main.global_block().ops if op.type == "fused_fc"]
+    assert any(op.attr("activation_type") == "relu" for op in fused)
+    got = _run_simple(main, startup, {"x": x}, out.name)
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+
+def test_fuse_passes_keep_fetched_intermediates():
+    """A fetched intermediate var must survive fusion (review finding: fetch
+    is not an op-consumer, so single-consumer chains could erase it)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[8], dtype="float32")
+        s = fluid.layers.elementwise_add(x, y)   # fetched intermediate
+        out = fluid.layers.relu(s)
+    ir.apply_pass(main, "fuse_elewise_add_act_pass",
+                  keep=[s.name, out.name])
+    names = {n for op in main.global_block().ops for n in op.output_names()}
+    assert s.name in names and out.name in names
+    rng = np.random.RandomState(2)
+    xv, yv = rng.randn(2, 8).astype(np.float32), rng.randn(2, 8).astype(np.float32)
+    got = _run_simple(main, startup, {"x": xv, "y": yv}, s.name)
+    np.testing.assert_allclose(got, xv + yv, rtol=1e-6)
+
+
+def test_fc_fuse_rejects_nonvector_bias():
+    """elementwise_add with a per-row (axis=0) bias must NOT fc-fuse (review
+    finding: fused_fc hard-codes a last-dim bias broadcast)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[-1, 6], dtype="float32",
+                              append_batch_size=False)
+        w = fluid.layers.create_parameter([6, 3], "float32", name="w_nb")
+        b = fluid.layers.data("b", shape=[-1], dtype="float32",
+                              append_batch_size=False)  # per-row bias
+        m = fluid.layers.mul(x, w)
+        out = fluid.layers.elementwise_add(m, b, axis=0)
+    ir.apply_pass(main, "fc_fuse_pass", fetch_names=[out.name])
+    assert "fused_fc" not in [op.type for op in main.global_block().ops]
+
+
+def test_fuse_elewise_add_act_numerics():
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[8], dtype="float32")
+        s = fluid.layers.elementwise_add(x, y)
+        out = fluid.layers.relu(s)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(3, 8).astype(np.float32)
+    yv = rng.randn(3, 8).astype(np.float32)
+    ref = _run_simple(main.clone(), startup.clone(), {"x": xv, "y": yv}, out.name)
+
+    ir.apply_pass(main, "fuse_elewise_add_act_pass")
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_elemwise_activation" in types
+    assert "relu" not in types
+    got = _run_simple(main, startup, {"x": xv, "y": yv}, out.name)
+    np.testing.assert_allclose(ref, got, rtol=1e-6)
+
+
+def test_constant_folding_pass():
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        c1 = fluid.layers.fill_constant([4], "float32", 2.0)
+        c2 = fluid.layers.fill_constant([4], "float32", 3.0)
+        csum = fluid.layers.elementwise_add(c1, c2)  # foldable → 5.0
+        out = fluid.layers.elementwise_add(x, csum)
+    ir.apply_pass(main, "constant_folding_pass")
+    ir.apply_pass(main, "dead_code_elimination_pass", keep=[out.name])
+    types = [op.type for op in main.global_block().ops]
+    assert "assign_value" in types
+    # the add of two constants is gone; only the x + const add remains
+    assert types.count("elementwise_add") == 1
+    xv = np.ones((2, 4), dtype=np.float32)
+    got = _run_simple(main, startup, {"x": xv}, out.name)
+    np.testing.assert_allclose(got, np.full((2, 4), 6.0), rtol=1e-6)
+
+
+def test_delete_dropout_and_memory_plan():
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        d = fluid.layers.dropout(h, dropout_prob=0.5)
+        out = fluid.layers.fc(d, 2)
+    ir.apply_pass(main, "delete_dropout_op_pass")
+    assert "dropout" not in [op.type for op in main.global_block().ops]
+    ir.apply_pass(main, "memory_optimize_pass", fetch_names=[out.name])
+    plan = main._memory_plan
+    assert plan["n_temporaries"] > 0
+    assert "x" in main._donatable_feeds
+
+
+def test_graph_viz_pass(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import ir
+
+    main, _, out = _build_mlp()
+    path = str(tmp_path / "g.dot")
+    ir.apply_pass(main, "graph_viz_pass", path=path)
+    dot = open(path).read()
+    assert "digraph" in dot and "mul" in dot
+
+
+def test_predictor_round_trip(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import inference
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(x, 32, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        out = fluid.layers.fc(h, 4, act="softmax")
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(3).randn(5, 16).astype(np.float32)
+    ref = exe.run(main.clone(for_test=True), feed={"x": xv},
+                  fetch_list=[out.name])[0]
+
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+
+    config = inference.Config(model_dir)
+    pred = inference.create_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    assert pred.get_output_names() == [out.name]
+    # fused/optimized program must numerically match the executor
+    got = pred.run({"x": xv})[0]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+    # zero-copy handle style
+    h_in = pred.get_input_handle("x")
+    h_in.copy_from_cpu(xv)
+    pred.run()
+    got2 = pred.get_output_handle(out.name).copy_to_cpu()
+    np.testing.assert_allclose(ref, got2, rtol=1e-5, atol=1e-5)
+
+    # clone shares weights, produces same result
+    clone = pred.clone()
+    got3 = clone.run({"x": xv})[0]
+    np.testing.assert_allclose(ref, got3, rtol=1e-5, atol=1e-5)
+    assert clone._state is pred._state or all(
+        clone._state[k] is pred._state[k] for k in pred._state)
+
+
+def test_predictor_bf16_precision(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import inference
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        out = fluid.layers.fc(x, 4)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    model_dir = str(tmp_path / "m")
+    fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+
+    cfg = inference.Config(model_dir)
+    cfg.enable_tpu(precision=inference.PrecisionType.Bfloat16)
+    pred = inference.create_predictor(cfg)
+    xv = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    f32 = inference.create_predictor(inference.Config(model_dir)).run({"x": xv})[0]
+    bf16 = pred.run({"x": xv})[0]
+    np.testing.assert_allclose(f32, np.asarray(bf16, np.float32), rtol=0.05, atol=0.05)
