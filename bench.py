@@ -30,9 +30,15 @@ def main():
                           hidden_dropout=0.1, attn_dropout=0.1)
     batch, seq = (8, 512) if on_tpu else (2, 128)
 
+    # bf16 AMP (master weights stay f32; no loss scaling needed for bf16) —
+    # the production ERNIE recipe; MXU runs bf16, accumulates f32.
+    def _opt():
+        from paddle_tpu.contrib import mixed_precision as mp
+        return mp.decorate(fluid.optimizer.Adam(1e-4), dtype="bfloat16",
+                           use_dynamic_loss_scaling=False)
+
     main_prog, startup, feeds, loss = bert.build_pretrain_program(
-        cfg, batch, seq,
-        optimizer_factory=lambda: fluid.optimizer.Adam(1e-4))
+        cfg, batch, seq, optimizer_factory=_opt)
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)

@@ -4,7 +4,8 @@ from __future__ import annotations
 
 # ops whose inputs are cast to the compute dtype (MXU-bound)
 WHITE_LIST = {"conv2d", "conv3d", "depthwise_conv2d", "conv2d_transpose",
-              "matmul", "mul"}
+              "matmul", "mul", "fused_fc", "fused_elemwise_activation",
+              "flash_attention"}
 # ops kept in float32 (numerically sensitive)
 BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "mean",
               "reduce_mean", "layer_norm", "batch_norm", "softmax", "sum",

@@ -456,3 +456,25 @@ def crf_decoding(input, param_attr=None, length=None, label=None, name=None):
     helper.append_op(type="crf_decoding", inputs=inputs,
                      outputs={"ViterbiPath": [path.name]}, attrs={})
     return path
+
+
+def flash_attention(q: Variable, k: Variable, v: Variable,
+                    attn_bias: Optional[Variable] = None,
+                    causal: bool = False, dropout_prob: float = 0.0,
+                    is_test: bool = False, name=None) -> Variable:
+    """Fused memory-efficient attention over [B, H, T, D] tensors.
+
+    TPU-native replacement for the matmul→softmax→dropout→matmul attention
+    pattern (no reference analog — the reference materializes the [B,H,T,T]
+    score tensor). Pallas kernel on TPU; blockwise JAX elsewhere.
+    `attn_bias` is additive and broadcastable to [B, H, T, T]."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
+    if attn_bias is not None:
+        inputs["BiasQK"] = [attn_bias.name]
+    helper.append_op(type="flash_attention", inputs=inputs,
+                     outputs={"Out": [out.name]},
+                     attrs={"causal": causal, "dropout_prob": dropout_prob,
+                            "is_test": is_test})
+    return out

@@ -34,6 +34,9 @@ class BertConfig:
     initializer_range: float = 0.02
     # TPU-native: tensor-parallel axis name (None = no TP annotations)
     tp_axis: Optional[str] = None
+    # TPU-native: fused memory-efficient attention (Pallas kernel on TPU)
+    # instead of the materialized-scores matmul/softmax/matmul pattern
+    use_flash_attention: bool = True
 
     @property
     def head_dim(self):
@@ -72,13 +75,18 @@ def encoder_layer(cfg: BertConfig, x, attn_mask, idx: int, is_test=False):
         return layers.transpose(t, [0, 2, 1, 3])  # [B, nh, T, hd]
 
     q, k, v = heads(q, f"{pre}.q"), heads(k, f"{pre}.k"), heads(v, f"{pre}.v")
-    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(hd))
-    scores = layers.elementwise_add(scores, attn_mask)  # mask: [B,1,1,T] additive
-    probs = layers.softmax(scores)
-    if cfg.attn_dropout > 0:
-        probs = layers.dropout(probs, cfg.attn_dropout, is_test=is_test,
-                               dropout_implementation="upscale_in_train")
-    ctxv = layers.matmul(probs, v)  # [B, nh, T, hd]
+    if cfg.use_flash_attention:
+        ctxv = layers.flash_attention(q, k, v, attn_mask,
+                                      dropout_prob=cfg.attn_dropout,
+                                      is_test=is_test)  # [B, nh, T, hd]
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(hd))
+        scores = layers.elementwise_add(scores, attn_mask)  # mask: [B,1,1,T] additive
+        probs = layers.softmax(scores)
+        if cfg.attn_dropout > 0:
+            probs = layers.dropout(probs, cfg.attn_dropout, is_test=is_test,
+                                   dropout_implementation="upscale_in_train")
+        ctxv = layers.matmul(probs, v)  # [B, nh, T, hd]
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = layers.reshape(ctxv, [0, -1, nh * hd])
     # output proj: input dim sharded under TP (row-parallel)

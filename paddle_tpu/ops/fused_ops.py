@@ -42,3 +42,23 @@ def _fused_fc(ctx, inputs, attrs):
         out = out + b.reshape((1, -1))
     out = _ACTS[attrs.get("activation_type", "")](out)
     return one(out.reshape(lead + (w.shape[-1],)))
+
+
+@register_op("flash_attention", nondiff_inputs=["BiasQK"])
+def _flash_attention(ctx, inputs, attrs):
+    """Memory-efficient fused attention (Pallas on TPU, blockwise JAX
+    elsewhere). Replaces the matmul→softmax→dropout→matmul chain; see
+    ops/pallas_kernels/flash_attention.py."""
+    from .pallas_kernels import flash_attention as _fa
+
+    (q,) = inputs["Q"]
+    (k,) = inputs["K"]
+    (v,) = inputs["V"]
+    bias = opt_input(inputs, "BiasQK")
+    rate = attrs.get("dropout_prob", 0.0)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    key = None
+    if rate > 0.0 and not is_test:
+        key = ctx.rng()
+    return one(_fa(q, k, v, bias=bias, causal=attrs.get("causal", False),
+                   dropout_rate=0.0 if is_test else rate, dropout_key=key))
