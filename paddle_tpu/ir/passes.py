@@ -138,13 +138,21 @@ class ConstantFoldingPass(Pass):
             order = graph.topology_sort()
         except ValueError:
             return program
+        def _invalidate(skipped_op):
+            # non-SSA: a skipped op may overwrite a name recorded as constant
+            for names in skipped_op.outputs.values():
+                for n in names:
+                    const_vals.pop(n, None)
+
         for op in order:
             if _is_protected(op) or op.type in RANDOM_OPS or not has_op(op.type):
+                _invalidate(op)
                 continue
             if op.type in self.FOLD_SOURCES and not op.inputs:
                 pass  # source: evaluate below, keep the op itself
             elif not op.inputs or not all(
                     n in const_vals for n in op.input_names()):
+                _invalidate(op)
                 continue
             try:
                 inputs = {slot: [const_vals[n] for n in names]
@@ -152,6 +160,7 @@ class ConstantFoldingPass(Pass):
                 ctx = ExecContext(None, is_test=True)
                 outs = get_op(op.type).fn(ctx, inputs, op.attrs)
             except Exception:
+                _invalidate(op)
                 continue
             for slot, vals in outs.items():
                 for name, val in zip(op.output(slot), vals):

@@ -6,18 +6,24 @@ matmul over a materialized [B,H,T,T] score tensor — PaddleNLP on the SURVEY
 
 - forward: a Pallas kernel tiles Q into VMEM blocks and streams K/V blocks
   through the MXU, keeping the running max/denominator in VMEM scratch —
-  HBM traffic is O(T·D) instead of O(T²);
-- backward: flash-style recompute from the saved (out, logsumexp) pair, as a
-  blockwise scan — nothing quadratic is ever stored between fwd and bwd;
-- a pure-JAX two-pass fallback with identical semantics runs on CPU (tests),
-  for attention-probability dropout, and for shapes the kernel doesn't tile.
+  HBM traffic is O(T·D) instead of O(T²); attention-probability dropout is
+  generated *inside* the kernel from the on-core PRNG (per-block reseed),
+  so no mask tensor ever touches HBM;
+- backward: two Pallas kernels recompute p from the saved (q, k, lse)
+  blockwise — a dq kernel (grid b×nq×nk, dq accumulated in VMEM) and a
+  dk/dv kernel (grid b×nk×nq) — nothing quadratic is stored between fwd
+  and bwd. Dropout masks are regenerated bit-identically from the same
+  per-(batch, q-block, k-block) seeds;
+- a pure-JAX two-pass fallback with identical semantics runs on CPU (tests)
+  and for shapes the kernel doesn't tile.
 
 The public entry is `flash_attention(q, k, v, bias, causal, ...)` wrapped in
 `jax.custom_vjp`, so the framework's per-op autodiff tape picks up the
 memory-efficient backward automatically.
 
 Bias is additive, broadcastable against [B, H, Tq, Tk] — the BERT input mask
-([B,1,1,T]) and ALiBi-style biases both fit.
+([B,1,1,T]) and ALiBi-style biases both fit, and the bias gradient is
+returned (reduced over broadcast dims).
 """
 from __future__ import annotations
 
@@ -35,6 +41,11 @@ DEFAULT_BLOCK_K = 128
 _LANES = 128  # TPU lane width: scratch stats are kept lane-replicated
 _NEG_INF = -1e30
 
+# Tests may set this to run the Pallas kernels on CPU through the
+# interpreter (dropout kernels need pltpu.InterpretParams; the interpreter's
+# PRNG returns zeros, so dropout-path numerics are TPU-only).
+FORCE_PALLAS_INTERPRET = False
+
 
 def _on_tpu() -> bool:
     try:
@@ -43,13 +54,50 @@ def _on_tpu() -> bool:
         return False
 
 
+try:  # pallas import is deferred-safe: CPU-only envs still import this module
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = pltpu = None
+    _HAVE_PALLAS = False
+
+
+# ---------------------------------------------------------------------------
+# In-kernel dropout: per-(b, q-block, k-block) reseed of the core PRNG, so
+# forward and both backward kernels regenerate identical masks regardless of
+# their grid iteration order.
+# ---------------------------------------------------------------------------
+
+def _keep_mask(seed_ref, block_index, shape, rate):
+    # Mosaic supports at most 2 prng_seed values — the caller folds
+    # (b, q-block, k-block) into one grid-order-independent index so the
+    # same logical block regenerates the same stream in all three kernels.
+    pltpu.prng_seed(seed_ref[0], block_index)
+    bits = lax.bitcast_convert_type(pltpu.prng_random_bits(shape), jnp.uint32)
+    # drop iff bits < rate·2³² → P(keep) = 1 − rate
+    return bits >= jnp.uint32(int(round(rate * 4294967296.0)) & 0xFFFFFFFF)
+
+
+def _block_index(b, iq, ik, nq, nk):
+    return (b * nq + iq) * nk + ik
+
+
+def _seed_from_key(dropout_key):
+    if dropout_key is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jax.random.randint(dropout_key, (1,), 0, np.iinfo(np.int32).max,
+                              dtype=jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q, block_k):
-    iq, ik = pl.program_id(1), pl.program_id(2)
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q, block_k,
+                dropout_rate):
+    b, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(ik == 0)
@@ -79,10 +127,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                                # [bq, bk]
         corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
+        # denominator uses the *undropped* probabilities (dropout acts on
+        # normalized attention probs; masking/scaling commutes with the
+        # final division by l)
         l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            nq = pl.num_programs(1)
+            keep = _keep_mask(seed_ref, _block_index(b, iq, ik, nq, nk),
+                              (block_q, block_k), dropout_rate)
+            p_v = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+        else:
+            p_v = p
         v_blk = v_ref[0]
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            p_v.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -104,7 +162,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
 
 def _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, block_q, block_k,
-                      interpret=False):
+                      interpret=False, dropout_rate=0.0, seed=None):
     """q,k,v: [BH, T, D] (heads folded); bias: [BH, Tq_or_1, Tk] or None.
     Returns (out [BH,T,D], lse [BH,T])."""
     bh, t, d = q.shape
@@ -112,64 +170,375 @@ def _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, block_q, block_k,
     grid = (bh, nq, nk)
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
     ]
     args = [q, k, v]
     if bias is not None:
-        per_q = bias.shape[1] != 1
-        if per_q:
+        if bias.shape[1] != 1:
             in_specs.append(pl.BlockSpec(
-                (1, block_q, block_k), lambda b, i, j: (b, i, j)))
+                (1, block_q, block_k), lambda b, i, j, *_: (b, i, j)))
         else:
             in_specs.append(pl.BlockSpec(
-                (1, 1, block_k), lambda b, i, j: (b, 0, j)))
+                (1, 1, block_k), lambda b, i, j, *_: (b, 0, j)))
         args.append(bias)
 
     body = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                             block_q=block_q, block_k=block_k)
+                             block_q=block_q, block_k=block_k,
+                             dropout_rate=dropout_rate)
     if bias is not None:
         kernel = body
     else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
-            body(q_ref, k_ref, v_ref, None, o_ref, lse_ref, acc, m, l)
+        def kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
+            body(seed_ref, q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                 acc, m, l)
+
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
 
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, i, j, *_: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(*args)
+    )(seed, *args)
     return out, lse[:, :, 0]
 
 
-try:  # pallas import is deferred-safe: CPU-only envs still import this module
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    _HAVE_PALLAS = True
-except Exception:  # pragma: no cover
-    pl = pltpu = None
-    _HAVE_PALLAS = False
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (flash recompute from saved lse)
+#
+#   delta = Σ_d dO·out                              (precomputed, [BH,T])
+#   p  = exp(s − lse)                               (recomputed per block)
+#   dv = p_dropᵀ·dO          dp = dO·vᵀ (drop-scaled)
+#   ds = p·(dp − delta)      dk = dsᵀ·q·scale       dq = Σ_j ds·k·scale
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
+                   delta_ref, dq_ref, dbias_ref, dq_acc, *, sm_scale, causal,
+                   block_q, block_k, dropout_rate):
+    b, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _body():
+        q = q_ref[0]                                          # [bq, D]
+        k = k_ref[0]                                          # [bk, D]
+        v = v_ref[0]                                          # [bk, D]
+        g = g_ref[0]                                          # [bq, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale    # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            q_pos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        lse = lse_ref[0][:, :1]                               # [bq, 1]
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bk]
+        if dropout_rate > 0.0:
+            nq = pl.num_programs(1)
+            keep = _keep_mask(seed_ref, _block_index(b, iq, ik, nq, nk),
+                              (block_q, block_k), dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+        delta = delta_ref[0][:, :1]                           # [bq, 1]
+        ds = p * (dp - delta)                                 # [bq, bk] f32
+        if dbias_ref is not None:
+            dbias_ref[0] = ds.astype(dbias_ref.dtype)
+        ds_c = ds.astype(k.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds_c, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    if causal:
+        skip = ik * block_k > iq * block_q + block_q - 1
+
+        @pl.when(jnp.logical_not(skip))
+        def _():
+            _body()
+
+        if dbias_ref is not None:
+            @pl.when(skip)
+            def _():
+                dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+    else:
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dbias_col_ref, dk_acc, dv_acc,
+                    db_acc, *, sm_scale, causal, block_q, block_k,
+                    dropout_rate):
+    # grid is (bh, nk, nq): k-block outer, q-block inner
+    b, ik, iq = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+        if db_acc is not None:
+            db_acc[...] = jnp.zeros_like(db_acc)
+
+    def _body():
+        q = q_ref[0]                                          # [bq, D]
+        k = k_ref[0]                                          # [bk, D]
+        v = v_ref[0]                                          # [bk, D]
+        g = g_ref[0]                                          # [bq, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale    # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            q_pos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        lse = lse_ref[0][:, :1]
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bk]
+        if dropout_rate > 0.0:
+            # same (b, iq, ik) index as fwd/dq kernels → identical mask
+            nk_tot = pl.num_programs(1)
+            nq_tot = pl.num_programs(2)
+            keep = _keep_mask(seed_ref,
+                              _block_index(b, iq, ik, nq_tot, nk_tot),
+                              (block_q, block_k), dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            p_v = p
+        # dv += p_vᵀ·g   (contract q rows)
+        dv_acc[...] += jax.lax.dot_general(
+            p_v.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, D]
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta)                                 # [bq, bk] f32
+        ds_c = ds.astype(q.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds_c, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale    # [bk, D]
+        if db_acc is not None:
+            db_acc[...] += jnp.sum(ds, axis=0, keepdims=True)  # [1, bk]
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+        if dbias_col_ref is not None:
+            dbias_col_ref[0] = db_acc[...].astype(dbias_col_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, bias, g, lse, out, sm_scale, causal,
+                      block_q, block_k, dropout_rate=0.0, seed=None,
+                      interpret=False):
+    """Returns (dq, dk, dv, dbias). dbias is [BH,Tq,Tk] f32 for a per-q bias,
+    [BH,1,Tk] f32 for a broadcast (mask-like) bias, or None."""
+    bh, t, d = q.shape
+    nq, nk = t // block_q, t // block_k
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+
+    gf = g.astype(q.dtype)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                   # [BH, T]
+    lse_r = jnp.broadcast_to(lse[:, :, None], (bh, t, _LANES))
+    delta_r = jnp.broadcast_to(delta[:, :, None], (bh, t, _LANES))
+
+    has_bias = bias is not None
+    per_q_bias = has_bias and bias.shape[1] != 1
+
+    # ---- dq kernel: grid (bh, nq, nk) --------------------------------------
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),   # v
+    ]
+    args = [q, k, v]
+    if has_bias:
+        if per_q_bias:
+            in_specs.append(pl.BlockSpec(
+                (1, block_q, block_k), lambda b, i, j, *_: (b, i, j)))
+        else:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, block_k), lambda b, i, j, *_: (b, 0, j)))
+        args.append(bias)
+    in_specs += [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),   # g
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j, *_: (b, i, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j, *_: (b, i, 0)),
+    ]
+    args += [gf, lse_r, delta_r]
+
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, t, d), q.dtype)]
+    if per_q_bias:
+        out_specs.append(pl.BlockSpec(
+            (1, block_q, block_k), lambda b, i, j, *_: (b, i, j)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, t, t), jnp.float32))
+
+    body = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                             block_q=block_q, block_k=block_k,
+                             dropout_rate=dropout_rate)
+
+    def dq_kernel(seed_ref, *refs):
+        n_in = 6 + (1 if has_bias else 0)
+        ins, outs = refs[:n_in], refs[n_in:]
+        if has_bias:
+            q_r, k_r, v_r, b_r, g_r, l_r, d_r = ins
+        else:
+            (q_r, k_r, v_r, g_r, l_r, d_r), b_r = ins, None
+        if per_q_bias:
+            dq_r, db_r, acc = outs
+        else:
+            (dq_r, acc), db_r = outs, None
+        body(seed_ref, q_r, k_r, v_r, b_r, g_r, l_r, d_r, dq_r, db_r, acc)
+
+    dq_out = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nq, nk),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, *args)
+    if per_q_bias:
+        dq, dbias = dq_out
+    else:
+        (dq,), dbias = dq_out, None
+
+    # ---- dk/dv kernel: grid (bh, nk, nq) -----------------------------------
+    in_specs2 = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i, *_: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),   # v
+    ]
+    args2 = [q, k, v]
+    if has_bias:
+        if per_q_bias:
+            in_specs2.append(pl.BlockSpec(
+                (1, block_q, block_k), lambda b, j, i, *_: (b, i, j)))
+        else:
+            in_specs2.append(pl.BlockSpec(
+                (1, 1, block_k), lambda b, j, i, *_: (b, 0, j)))
+        args2.append(bias)
+    in_specs2 += [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i, *_: (b, i, 0)),   # g
+        pl.BlockSpec((1, block_q, _LANES), lambda b, j, i, *_: (b, i, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, j, i, *_: (b, i, 0)),
+    ]
+    args2 += [gf, lse_r, delta_r]
+
+    col_bias = has_bias and not per_q_bias
+    out_specs2 = [
+        pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+    ]
+    out_shape2 = [
+        jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+    ]
+    scratch2 = [pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32)]
+    if col_bias:
+        out_specs2.append(pl.BlockSpec(
+            (1, 1, block_k), lambda b, j, i, *_: (b, 0, j)))
+        out_shape2.append(jax.ShapeDtypeStruct((bh, 1, t), jnp.float32))
+        scratch2.append(pltpu.VMEM((1, block_k), jnp.float32))
+
+    body2 = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k, dropout_rate=dropout_rate)
+
+    def dkv_kernel(seed_ref, *refs):
+        n_in = 6 + (1 if has_bias else 0)
+        ins, rest = refs[:n_in], refs[n_in:]
+        if has_bias:
+            q_r, k_r, v_r, b_r, g_r, l_r, d_r = ins
+        else:
+            (q_r, k_r, v_r, g_r, l_r, d_r), b_r = ins, None
+        if col_bias:
+            dk_r, dv_r, dbc_r, dka, dva, dba = rest
+        else:
+            (dk_r, dv_r, dka, dva), dbc_r, dba = rest, None, None
+        body2(seed_ref, q_r, k_r, v_r, b_r, g_r, l_r, d_r,
+              dk_r, dv_r, dbc_r, dka, dva, dba)
+
+    dkv_out = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nk, nq),
+            in_specs=in_specs2,
+            out_specs=out_specs2,
+            scratch_shapes=scratch2,
+        ),
+        out_shape=out_shape2,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, *args2)
+    if col_bias:
+        dk, dv, dbias = dkv_out
+    else:
+        dk, dv = dkv_out
+
+    return dq, dk, dv, dbias
 
 
 # ---------------------------------------------------------------------------
-# Blockwise JAX path (CPU tests / dropout / fallback) — same math, two passes
+# Blockwise JAX path (CPU tests / fallback) — same math, two passes
 # ---------------------------------------------------------------------------
 
 def _bias_block(bias, j0, bk):
@@ -278,7 +647,9 @@ def _flash_bwd_jax(res, g, *, sm_scale, causal, block_k,
     dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t, d)
     dbias = None
     if has_bias:
-        dbias = jnp.moveaxis(dbias_blocks, 0, 3).reshape(bh, t, t)
+        # [nk, BH, Tq, bk] → [BH, Tq, nk, bk] → [BH, Tq, Tk]: the scanned
+        # block axis must precede the within-block key axis before reshape
+        dbias = jnp.moveaxis(dbias_blocks, 0, 2).reshape(bh, t, t)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dbias
 
 
@@ -291,6 +662,22 @@ def _pick_blocks(t: int):
     return bq, bq
 
 
+def _pallas_ok(t: int, d: int) -> bool:
+    """Static dispatch decision — must be identical in fwd and bwd so the
+    in-kernel dropout masks regenerate consistently."""
+    bq, _ = _pick_blocks(t)
+    return (_HAVE_PALLAS and (_on_tpu() or FORCE_PALLAS_INTERPRET)
+            and bq is not None and bq >= 64 and d % 64 == 0)
+
+
+def _interpret_arg(dropout_rate: float):
+    if not FORCE_PALLAS_INTERPRET or _on_tpu():
+        return False
+    # dropout kernels call pltpu.prng_*, which only the TPU-semantics
+    # interpreter accepts (it returns zero bits — numerics are TPU-only)
+    return pltpu.InterpretParams() if dropout_rate > 0.0 else True
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash_core(q, k, v, bias, dropout_key, sm_scale, causal, dropout_rate):
     out, _ = _flash_fwd_dispatch(q, k, v, bias, dropout_key, sm_scale,
@@ -300,13 +687,13 @@ def _flash_core(q, k, v, bias, dropout_key, sm_scale, causal, dropout_rate):
 
 def _flash_fwd_dispatch(q, k, v, bias, dropout_key, sm_scale, causal,
                         dropout_rate):
-    t = q.shape[1]
+    t, d = q.shape[1], q.shape[2]
     bq, bk = _pick_blocks(t)
-    use_pallas = (_HAVE_PALLAS and _on_tpu() and dropout_rate == 0.0
-                  and bq is not None and bq >= 64
-                  and q.shape[-1] % 64 == 0)
-    if use_pallas:
-        return _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, bq, bk)
+    if _pallas_ok(t, d):
+        seed = (_seed_from_key(dropout_key) if dropout_rate > 0.0 else None)
+        return _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, bq, bk,
+                                 dropout_rate=dropout_rate, seed=seed,
+                                 interpret=_interpret_arg(dropout_rate))
     if bq is None:
         raise ValueError(f"flash_attention: seq len {t} has no power-of-two "
                          f"block divisor ≥8; pad the sequence")
@@ -323,21 +710,29 @@ def _flash_core_fwd(q, k, v, bias, dropout_key, sm_scale, causal, dropout_rate):
 
 
 def _flash_core_bwd(sm_scale, causal, dropout_rate, res, g):
-    q = res[0]
-    _, bk = _pick_blocks(q.shape[1])
-    has_bias = res[3] is not None
-    dq, dk, dv, dbias = _flash_bwd_jax(
-        res, g, sm_scale=sm_scale, causal=causal, block_k=bk,
-        dropout_rate=dropout_rate, has_bias=has_bias)
+    q, k, v, bias, key, out, lse = res
+    t, d = q.shape[1], q.shape[2]
+    bq, bk = _pick_blocks(t)
+    has_bias = bias is not None
+    if _pallas_ok(t, d):
+        seed = (_seed_from_key(key) if dropout_rate > 0.0 else None)
+        dq, dk, dv, dbias = _flash_bwd_pallas(
+            q, k, v, bias, g, lse, out, sm_scale, causal, bq, bk,
+            dropout_rate=dropout_rate, seed=seed,
+            interpret=_interpret_arg(dropout_rate))
+    else:
+        dq, dk, dv, dbias = _flash_bwd_jax(
+            res, g, sm_scale=sm_scale, causal=causal, block_k=bk,
+            dropout_rate=dropout_rate, has_bias=has_bias)
     if has_bias:
-        # reduce over broadcast dims back to the bias shape
-        bias = res[3]
+        # reduce over broadcast dims back to the bias shape (the pallas
+        # col-sum path has already reduced the q axis)
         for ax in range(dbias.ndim):
             if bias.shape[ax] == 1 and dbias.shape[ax] != 1:
                 dbias = jnp.sum(dbias, axis=ax, keepdims=True)
         dbias = dbias.astype(bias.dtype)
-    dkey = (None if res[4] is None
-            else np.zeros(res[4].shape, jax.dtypes.float0))
+    dkey = (None if key is None
+            else np.zeros(np.shape(key), jax.dtypes.float0))
     return dq, dk, dv, dbias, dkey
 
 
@@ -355,6 +750,14 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     b, h, t, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(
+            f"flash_attention: dropout_rate must be in [0, 1), got "
+            f"{dropout_rate}")
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError(
+            "flash_attention: dropout_rate > 0 requires a dropout_key; "
+            "pass one or set dropout_rate=0 for inference")
 
     fold = lambda x: x.reshape(b * h, *x.shape[2:])
     qf, kf, vf = fold(q), fold(k), fold(v)

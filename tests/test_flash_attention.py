@@ -202,3 +202,109 @@ def test_pallas_kernel_interpret_mode(causal, with_bias):
 
 
 import jax  # noqa: E402  (used in interpret-mode lse check)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_multiblock_full_bias(causal):
+    """t=256 spans multiple K blocks (nk>1): exercises the online-softmax
+    correction across blocks AND the dbias block reassembly, including the
+    gradient w.r.t. a full trainable [B,H,T,T] bias (ALiBi-style)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+
+    b, h, t, d = 1, 1, 256, 16
+    q, k, v = (jnp.asarray(_rand((b, h, t, d), i)) for i in range(3))
+    bias = jnp.asarray(0.1 * _rand((b, h, t, t), 7))
+
+    def loss_flash(q, k, v, bias):
+        return jnp.sum(flash_attention(q, k, v, bias=bias, causal=causal) ** 2)
+
+    def loss_naive(q, k, v, bias):
+        return jnp.sum(_naive_attention(q, k, v, bias=bias, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g_naive = jax.grad(loss_naive, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for gf, gn in zip(g_flash, g_naive):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gn),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal,bias_kind", [
+    (False, "none"), (True, "none"), (False, "mask"), (True, "mask"),
+    (False, "full"), (True, "full"),
+])
+def test_pallas_backward_interpret_mode(causal, bias_kind):
+    """The Pallas dq and dk/dv kernels, run through the interpreter on CPU,
+    against the naive dense gradients — multi-block (t=256, block 128)."""
+    import importlib
+    import jax
+    import jax.numpy as jnp
+    fa_mod = importlib.import_module(
+        "paddle_tpu.ops.pallas_kernels.flash_attention")
+
+    b, h, t, d = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(_rand((b, h, t, d), i)) for i in range(3))
+    bias = None
+    if bias_kind == "mask":
+        m = np.where(np.random.RandomState(9).rand(b, 1, 1, t) > 0.3,
+                     0.0, -1e4).astype(np.float32)
+        bias = jnp.asarray(m)
+    elif bias_kind == "full":
+        bias = jnp.asarray(0.1 * _rand((b, h, t, t), 7))
+
+    def loss(fn):
+        def f(q, k, v, *rest):
+            bb = rest[0] if rest else bias
+            return jnp.sum(fn(q, k, v, bias=bb, causal=causal) ** 2)
+        return f
+
+    argnums = (0, 1, 2, 3) if bias_kind == "full" else (0, 1, 2)
+    args = (q, k, v, bias) if bias_kind == "full" else (q, k, v)
+
+    fa_mod.FORCE_PALLAS_INTERPRET = True
+    try:
+        assert fa_mod._pallas_ok(t, d)
+        g_pallas = jax.grad(loss(fa_mod.flash_attention), argnums)(*args)
+        out_pallas = fa_mod.flash_attention(q, k, v, bias=bias, causal=causal)
+    finally:
+        fa_mod.FORCE_PALLAS_INTERPRET = False
+    g_naive = jax.grad(loss(_naive_attention), argnums)(*args)
+    out_naive = _naive_attention(q, k, v, bias=bias, causal=causal)
+    np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(out_naive),
+                               rtol=2e-4, atol=2e-4)
+    for gp, gn in zip(g_pallas, g_naive):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gn),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_dropout_without_key_raises():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+
+    q = jnp.zeros((1, 1, 32, 8))
+    with pytest.raises(ValueError, match="dropout_key"):
+        flash_attention(q, q, q, dropout_rate=0.1)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="in-kernel PRNG numerics need a real TPU")
+def test_pallas_dropout_on_tpu():
+    """On hardware: in-kernel dropout is deterministic per key, consistent
+    between forward and backward, and statistically ≈ the requested rate."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+
+    b, h, t, d = 2, 2, 256, 64
+    q, k, v = (jnp.asarray(_rand((b, h, t, d), i)) for i in range(3))
+    key = jax.random.PRNGKey(3)
+    o1 = flash_attention(q, k, v, dropout_rate=0.3, dropout_key=key)
+    o2 = flash_attention(q, k, v, dropout_rate=0.3, dropout_key=key)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    o0 = flash_attention(q, k, v)
+    assert np.abs(np.asarray(o1)).mean() == pytest.approx(
+        np.abs(np.asarray(o0)).mean(), rel=0.5)
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, dropout_rate=0.3, dropout_key=key) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
