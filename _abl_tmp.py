@@ -1,0 +1,41 @@
+import numpy as np, time, sys
+import jax, jax.numpy as jnp
+import paddle_tpu as fluid
+from paddle_tpu.models import bert
+
+batch, seq = 64, 512
+def run_case(name, hidden_dropout, attn_dropout, train=True):
+    cfg = bert.BertConfig(num_layers=12, hidden_size=768, num_heads=12,
+                          ffn_size=3072, vocab_size=30522,
+                          hidden_dropout=hidden_dropout, attn_dropout=attn_dropout)
+    def _opt():
+        from paddle_tpu.contrib import mixed_precision as mp
+        return mp.decorate(fluid.optimizer.Adam(1e-4), dtype="bfloat16",
+                           use_dynamic_loss_scaling=False)
+    main_prog, startup, feeds, loss = bert.build_pretrain_program(
+        cfg, batch, seq, optimizer_factory=_opt if train else None,
+        is_test=not train)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {
+            "src_ids": rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"),
+            "pos_ids": np.tile(np.arange(seq), (batch, 1)).astype("int32"),
+            "sent_ids": np.zeros((batch, seq), dtype="int32"),
+            "input_mask": np.ones((batch, seq), dtype="float32"),
+            "mlm_labels": rng.randint(0, cfg.vocab_size, (batch, seq, 1)).astype("int32"),
+        }
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+        t0 = time.time()
+        n = 5
+        for _ in range(n):
+            exe.run(main_prog, feed=feed, fetch_list=[loss])
+        dt = (time.time()-t0)/n
+        print(f"{name}: step_ms={dt*1e3:.1f}", flush=True)
+
+run_case("fwd_only_nodrop", 0.0, 0.0, train=False)
+run_case("train_nodrop", 0.0, 0.0)
+run_case("train_hidden_drop_only", 0.1, 0.0)
+run_case("train_full_drop", 0.1, 0.1)

@@ -1,0 +1,61 @@
+import numpy as np, collections, re, sys
+import jax, jax.numpy as jnp
+import paddle_tpu as fluid
+from paddle_tpu.models import bert
+from paddle_tpu.core.executor import ExecContext, _run_block, _RNG_STATE
+
+cfg = bert.BertConfig(num_layers=12, hidden_size=768, num_heads=12,
+                      ffn_size=3072, vocab_size=30522,
+                      hidden_dropout=0.1, attn_dropout=0.1)
+def _opt():
+    from paddle_tpu.contrib import mixed_precision as mp
+    return mp.decorate(fluid.optimizer.Adam(1e-4), dtype="bfloat16",
+                       use_dynamic_loss_scaling=False)
+batch, seq = 64, 512
+main_prog, startup, feeds, loss = bert.build_pretrain_program(
+    cfg, batch, seq, optimizer_factory=_opt)
+exe = fluid.Executor(fluid.TPUPlace())
+exe.run(startup)
+scope = fluid.global_scope()
+state_names = sorted(v.name for v in main_prog.list_vars()
+                     if v.persistable and scope.has_var(v.name))
+rng = np.random.RandomState(0)
+feed = {
+    "src_ids": jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+    "pos_ids": jnp.asarray(np.tile(np.arange(seq), (batch, 1)), jnp.int32),
+    "sent_ids": jnp.zeros((batch, seq), jnp.int32),
+    "input_mask": jnp.ones((batch, seq), jnp.float32),
+    "mlm_labels": jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq, 1)), jnp.int32),
+}
+block = main_prog.global_block()
+amp = getattr(main_prog, "_amp", None)
+print("amp:", None if amp is None else amp["dtype"])
+state = {n: jnp.asarray(scope.find_var(n)) for n in state_names}
+key = jax.random.PRNGKey(0)
+
+def step(state, feed, key):
+    env = dict(state); env.update(feed)
+    ctx = ExecContext(key, amp=amp)
+    _run_block(block, env, ctx)
+    return env[loss.name], {n: env[n] for n in state_names}, ctx.final_key()
+
+lowered = jax.jit(step, donate_argnums=(0,)).lower(state, feed, key)
+comp = lowered.compile()
+txt = comp.as_text()
+# tally dot/conv ops by operand dtype and shape
+dots = collections.Counter()
+for m in re.finditer(r'%?(\w*dot[\w.]*|fusion[\w.]*)? = (\S+) (dot|convolution)\(', txt):
+    pass
+for line in txt.splitlines():
+    if ' dot(' in line or ' convolution(' in line:
+        mt = re.match(r'\s*(?:ROOT )?\S+ = (\S+?)\[([\d,]*)\]', line.strip())
+        if mt:
+            dots[(mt.group(1), mt.group(2))] += 1
+print("== dot output dtype/shape counts ==")
+for (dt, shp), c in sorted(dots.items(), key=lambda kv: -kv[1]):
+    print(f"{c:4d}  {dt}[{shp}]")
+ca = comp.cost_analysis()
+if ca:
+    print("flops:", ca.get("flops"), "bytes accessed:", ca.get("bytes accessed"))
+mem = comp.memory_analysis()
+print("mem:", mem)

@@ -1,0 +1,58 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+
+r = np.random.RandomState(0)
+N = 20
+
+def bench_chained(make_step, x0, name, flops=None):
+    @jax.jit
+    def run(x):
+        return lax.fori_loop(0, N, lambda i, x: make_step(x), x)
+    jax.block_until_ready(run(x0))
+    t0 = time.time(); jax.block_until_ready(run(x0)); dt = (time.time()-t0)/N
+    msg = f"{name}: {dt*1e3:.3f}ms"
+    if flops: msg += f" {flops/dt/1e12:.1f} TF/s"
+    print(msg, flush=True)
+
+# matmul peak, chained: x -> normalize(x@b@c) keeping shape
+for (m,k,n_) in [(32768,768,3072)]:
+    b1 = jnp.asarray(r.randn(k,n_)*0.01, jnp.bfloat16)
+    b2 = jnp.asarray(r.randn(n_,k)*0.01, jnp.bfloat16)
+    x0 = jnp.asarray(r.randn(m,k), jnp.bfloat16)
+    step = lambda x: ((x@b1)@b2)*jnp.bfloat16(0.001)
+    bench_chained(step, x0, f"2x matmul {m}x{k}x{n_}", flops=2*2*m*k*n_)
+
+bh, t, d = 768, 512, 64
+from paddle_tpu.ops.pallas_kernels.flash_attention import flash_attention
+import paddle_tpu.ops.pallas_kernels.flash_attention as FA
+q0 = jnp.asarray(r.randn(64,12,t,d)*0.1, jnp.bfloat16)
+mask = jnp.zeros((64,1,1,t), jnp.float32)
+attn_flops = 4*64*12*t*t*d
+for bq in (128, 256):
+    FA.DEFAULT_BLOCK_Q = bq; FA.DEFAULT_BLOCK_K = bq
+    bench_chained(lambda q: flash_attention(q,q,q,bias=mask).astype(jnp.bfloat16),
+                  q0, f"flash fwd bq={bq}", flops=attn_flops)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(q,q,q,bias=mask).astype(jnp.float32)**2))
+    bench_chained(lambda q: g(q).astype(jnp.bfloat16)*jnp.bfloat16(1e-3), q0,
+                  f"flash fwd+bwd bq={bq}", flops=int(attn_flops*3.5))
+FA.DEFAULT_BLOCK_Q = FA.DEFAULT_BLOCK_K = 128
+
+def dense_attn(q):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, q, preferred_element_type=jnp.float32)/8.0 + mask
+    p = jax.nn.softmax(s, -1).astype(jnp.bfloat16)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, q)
+bench_chained(lambda q: dense_attn(q), q0, "dense attn fwd", flops=attn_flops)
+gd = jax.grad(lambda q: jnp.sum(dense_attn(q).astype(jnp.float32)**2))
+bench_chained(lambda q: gd(q).astype(jnp.bfloat16)*jnp.bfloat16(1e-3), q0,
+              "dense attn fwd+bwd", flops=int(attn_flops*3.5))
+
+# dropout costs
+x0b = jnp.asarray(r.randn(64,512,3072), jnp.bfloat16)
+k0 = jax.random.PRNGKey(1)
+def tf_drop(x):
+    return jnp.where(jax.random.bernoulli(k0, 0.9, x.shape), x/jnp.bfloat16(0.9), jnp.bfloat16(0))
+bench_chained(tf_drop, x0b, "threefry dropout [64,512,3072]")
+def rbg_drop(x):
+    bits = jax.lax.rng_bit_generator(jnp.array([0,0,0,1],jnp.uint32), x.shape, dtype=jnp.uint32)[1]
+    return jnp.where(bits >= jnp.uint32(int(0.1*2**32)), x/jnp.bfloat16(0.9), jnp.bfloat16(0))
+bench_chained(rbg_drop, x0b, "rbg dropout [64,512,3072]")

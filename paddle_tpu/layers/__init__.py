@@ -5,10 +5,20 @@ from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401
 from .sequence import (  # noqa: F401
     sequence_concat,
+    sequence_conv,
+    sequence_enumerate,
+    sequence_erase,
+    sequence_expand_as,
     sequence_mask,
+    sequence_pad,
     sequence_pool,
+    sequence_reshape,
     sequence_reverse,
+    sequence_scatter,
+    sequence_slice,
     sequence_softmax,
+    sequence_topk_avg_pooling,
+    sequence_unpad,
 )
 from .control_flow import (  # noqa: F401
     DynamicRNN,

@@ -97,3 +97,203 @@ def _sequence_concat(ctx, inputs, attrs):
 @register_op("im2sequence")
 def _im2sequence(ctx, inputs, attrs):
     raise NotImplementedError("im2sequence: use conv/patch extraction layers")
+
+
+@register_op("sequence_pad", nondiff_inputs=["Length", "PadValue"])
+def _sequence_pad(ctx, inputs, attrs):
+    """sequence_pad_op.cc: re-pad [B, T, ...] + Length to `padded_length`
+    time steps filled with PadValue beyond each length."""
+    (x,) = inputs["X"]
+    (pad_value,) = inputs["PadValue"]
+    (length,) = inputs["Length"]
+    padded_len = attrs.get("padded_length", -1)
+    t = x.shape[1]
+    if padded_len is None or padded_len < 0:
+        padded_len = t
+    if padded_len >= t:
+        pad = [(0, 0), (0, padded_len - t)] + [(0, 0)] * (x.ndim - 2)
+        out = jnp.pad(x, pad)
+    else:
+        out = x[:, :padded_len]
+    mask = _mask_from_len(length, padded_len, jnp.bool_)
+    mask = mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    pv = jnp.asarray(pad_value, out.dtype).reshape((1, 1) + (1,) * (out.ndim - 2))
+    out = jnp.where(mask, out, pv)
+    out_len = jnp.minimum(length, padded_len)
+    return {"Out": [out], "Length": [out_len]}
+
+
+@register_op("sequence_unpad", nondiff_inputs=["Length"])
+def _sequence_unpad(ctx, inputs, attrs):
+    """sequence_unpad_op.cc: drop the pad region. Static shapes keep
+    [B, T, ...]; padding positions are zeroed (the dense analog of the
+    reference's flattened LoD output)."""
+    (x,) = inputs["X"]
+    (length,) = inputs["Length"]
+    mask = _mask_from_len(length, x.shape[1], x.dtype)
+    return one(x * mask.reshape(mask.shape + (1,) * (x.ndim - 2)))
+
+
+@register_op("sequence_conv", nondiff_inputs=["Length"])
+def _sequence_conv(ctx, inputs, attrs):
+    """sequence_conv_op.cc: context-window projection over the time axis.
+    Gathers a [ctx·D] window per step (zero beyond the sequence) and hits
+    the MXU with one [B·T, ctx·D]×[ctx·D, M] matmul — the im2col pattern
+    of the reference's math/context_project.h."""
+    (x,) = inputs["X"]                      # [B, T, D]
+    (filt,) = inputs["Filter"]              # [ctx*D, M]
+    length = inputs.get("Length", [None])[0]
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -((ctx_len - 1) // 2)))
+    b, t, d = x.shape
+    if length is not None:
+        mask = _mask_from_len(length, t, x.dtype)
+        x = x * mask[..., None]
+    cols = []
+    for j in range(ctx_len):
+        off = ctx_start + j
+        shifted = jnp.roll(x, -off, axis=1)
+        idx = jnp.arange(t) + off
+        valid = ((idx >= 0) & (idx < t))[None, :, None]
+        cols.append(jnp.where(valid, shifted, 0.0))
+    windows = jnp.concatenate(cols, axis=-1)            # [B, T, ctx*D]
+    out = jnp.einsum("btc,cm->btm", windows, filt,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if length is not None:
+        out = out * mask[..., None]
+    return one(out)
+
+
+@register_op("sequence_slice", nondiff_inputs=["Offset", "Length"])
+def _sequence_slice(ctx, inputs, attrs):
+    """sequence_slice_op.cc: per-row (offset, length) slice along time.
+    Output stays [B, T, ...]; positions ≥ length are zeroed."""
+    (x,) = inputs["X"]
+    (offset,) = inputs["Offset"]
+    (length,) = inputs["Length"]
+    t = x.shape[1]
+    idx = offset.reshape(-1, 1).astype(jnp.int32) + jnp.arange(t)[None, :]
+    idx_c = jnp.clip(idx, 0, t - 1)
+    out = jnp.take_along_axis(
+        x, idx_c.reshape(idx_c.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = _mask_from_len(length, t, x.dtype)
+    return one(out * mask.reshape(mask.shape + (1,) * (x.ndim - 2)))
+
+
+@register_op("sequence_erase", differentiable=False)
+def _sequence_erase(ctx, inputs, attrs):
+    """sequence_erase_op.cc: remove tokens ∈ `tokens`, left-compact the
+    rest. Fixed-shape: output stays [B, T] zero-padded, new lengths out."""
+    (x,) = inputs["X"]                      # [B, T] int
+    length = inputs.get("Length", [None])[0]
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    b, t = x.shape
+    in_range = (jnp.arange(t)[None, :] < length.reshape(-1, 1)) \
+        if length is not None else jnp.ones((b, t), bool)
+    keep = in_range & ~jnp.isin(x, tokens)
+    new_pos = jnp.cumsum(keep, axis=1) - 1                # target index
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    # dropped tokens contribute 0 at the previous kept slot (or index -1,
+    # dropped by mode="drop"); kept tokens land left-compacted
+    out = jnp.zeros_like(x).at[rows, new_pos].add(
+        jnp.where(keep, x, 0), mode="drop")
+    new_len = jnp.sum(keep, axis=1).astype(
+        length.dtype if length is not None else jnp.int32)
+    return {"Out": [out], "Length": [new_len]}
+
+
+@register_op("sequence_expand_as", nondiff_inputs=["Y", "Length"])
+def _sequence_expand_as(ctx, inputs, attrs):
+    """sequence_expand_as_op.cc: broadcast each row of X across Y's time
+    axis (x_i repeated per step of sequence i), masked by Y's length."""
+    (x,) = inputs["X"]                      # [B, ...]
+    (y,) = inputs["Y"]                      # [B, T, ...]
+    length = inputs.get("Length", [None])[0]
+    t = y.shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    if length is not None:
+        mask = _mask_from_len(length, t, out.dtype)
+        out = out * mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    return one(out)
+
+
+@register_op("sequence_enumerate", differentiable=False)
+def _sequence_enumerate(ctx, inputs, attrs):
+    """sequence_enumerate_op.cc: sliding win_size-grams along time;
+    positions past the end filled with pad_value."""
+    (x,) = inputs["X"]                      # [B, T] int
+    length = inputs.get("Length", [None])[0]
+    win = int(attrs.get("win_size", 2))
+    pad_value = attrs.get("pad_value", 0)
+    b, t = x.shape
+    lens = length.reshape(-1, 1) if length is not None else t
+    grams = []
+    for j in range(win):
+        idx = jnp.arange(t) + j
+        shifted = jnp.roll(x, -j, axis=1)
+        valid = idx[None, :] < (lens if length is not None else t)
+        grams.append(jnp.where(valid, shifted, pad_value))
+    return one(jnp.stack(grams, axis=-1))   # [B, T, win]
+
+
+@register_op("sequence_reshape", nondiff_inputs=["Length"])
+def _sequence_reshape(ctx, inputs, attrs):
+    """sequence_reshape_op.cc: re-chunk each sequence's row-major stream
+    of [T, D] into [T·D/new_dim, new_dim]; tail padding stays contiguous
+    so a plain reshape is exact. New length = len·D/new_dim."""
+    (x,) = inputs["X"]                      # [B, T, D]
+    length = inputs.get("Length", [None])[0]
+    new_dim = int(attrs["new_dim"])
+    b, t, d = x.shape
+    if (t * d) % new_dim:
+        raise ValueError(f"sequence_reshape: T*D={t*d} not divisible by "
+                         f"new_dim={new_dim}")
+    out = x.reshape(b, (t * d) // new_dim, new_dim)
+    outs = {"Out": [out]}
+    if length is not None:
+        outs["Length"] = [(length * d) // new_dim]
+    return outs
+
+
+@register_op("sequence_scatter", nondiff_inputs=["Ids", "Length"])
+def _sequence_scatter(ctx, inputs, attrs):
+    """sequence_scatter_op.cc: out[b, ids[b,s]] += updates[b,s] for
+    s < length[b] (per-sequence scatter-add into a dense row)."""
+    (x,) = inputs["X"]                      # [B, N]
+    (ids,) = inputs["Ids"]                  # [B, S] int
+    (upd,) = inputs["Updates"]              # [B, S]
+    length = inputs.get("Length", [None])[0]
+    b, s = ids.shape
+    if length is not None:
+        valid = jnp.arange(s)[None, :] < length.reshape(-1, 1)
+        upd = jnp.where(valid, upd, 0)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, s))
+    return one(x.at[rows, ids.astype(jnp.int32)].add(upd))
+
+
+@register_op("sequence_topk_avg_pooling", differentiable=False,
+             nondiff_inputs=["Length"])
+def _sequence_topk_avg_pooling(ctx, inputs, attrs):
+    """sequence_topk_avg_pooling_op.cc: per (batch, channel), average of
+    the top-k values over masked time steps, one column per k in `topks`."""
+    (x,) = inputs["X"]                      # [B, C, T]
+    length = inputs.get("Length", [None])[0]
+    topks = list(attrs.get("topks", [1]))
+    b, c, t = x.shape
+    if length is not None:
+        mask = _mask_from_len(length, t, x.dtype)[:, None, :]
+        x = jnp.where(mask > 0, x, jnp.finfo(x.dtype).min)
+    sorted_desc = -jnp.sort(-x, axis=-1)                   # [B, C, T]
+    cols = []
+    for k in topks:
+        k = min(int(k), t)
+        top = sorted_desc[..., :k]
+        if length is not None:
+            # only count positions < min(k, len)
+            kk = jnp.minimum(length, k).reshape(-1, 1, 1).astype(x.dtype)
+            valid = jnp.arange(k)[None, None, :] < kk
+            top = jnp.where(valid, top, 0.0)
+            cols.append(jnp.sum(top, -1) / jnp.maximum(kk[..., 0], 1))
+        else:
+            cols.append(jnp.mean(top, -1))
+    return one(jnp.stack(cols, axis=-1).reshape(b, c * len(topks)))
