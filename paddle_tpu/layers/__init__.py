@@ -1,4 +1,5 @@
 """Layers DSL (reference python/paddle/fluid/layers/)."""
+from . import math_op_patch  # noqa: F401  (attaches Variable operators)
 from . import control_flow  # noqa: F401
 from . import detection  # noqa: F401
 from . import rnn  # noqa: F401

@@ -1,0 +1,307 @@
+"""Sequence-op family numeric + grad checks.
+
+Reference analog: the per-op tests of
+python/paddle/fluid/tests/unittests/test_sequence_*.py over LoDTensor inputs.
+TPU-native contract (paddle_tpu/ops/sequence_ops.py): padded dense
+[batch, max_len, ...] + explicit integer Length tensors instead of LoD.
+"""
+import numpy as np
+import pytest
+
+from op_test_base import OpTest
+
+
+def _mask(length, t):
+    return (np.arange(t)[None, :] < length.reshape(-1, 1))
+
+
+class TestSequenceMask(OpTest):
+    def test_mask(self):
+        self.op_type = "sequence_mask"
+        length = np.array([2, 0, 5], dtype="int32")
+        exp = _mask(length, 6).astype("int32")
+        got = self.run_op({"X": length}, {"maxlen": 6, "out_dtype": "int32"},
+                          output_slots=("Y",))
+        np.testing.assert_array_equal(np.asarray(got["Y"]), exp)
+
+
+class TestSequencePool(OpTest):
+    def setup(self):
+        rng = np.random.RandomState(7)
+        self.x = rng.randn(3, 5, 4).astype("float32")
+        self.length = np.array([2, 5, 1], dtype="int32")
+        self.m = _mask(self.length, 5)[..., None]
+
+    def _run(self, pooltype, exp, **kw):
+        self.setup()
+        self.op_type = "sequence_pool"
+        self.check_output({"X": self.x, "Length": self.length},
+                          {"pooltype": pooltype}, {"Out": exp(self)}, **kw)
+
+    def test_sum(self):
+        self._run("SUM", lambda s: np.sum(s.x * s.m, axis=1))
+
+    def test_average(self):
+        self._run("AVERAGE", lambda s: np.sum(s.x * s.m, axis=1) /
+                  s.length.reshape(-1, 1))
+
+    def test_sqrt(self):
+        self._run("SQRT", lambda s: np.sum(s.x * s.m, axis=1) /
+                  np.sqrt(s.length.reshape(-1, 1)), atol=1e-4)
+
+    def test_max(self):
+        self._run("MAX", lambda s: np.max(
+            np.where(s.m, s.x, -np.inf), axis=1))
+
+    def test_last(self):
+        self._run("LAST", lambda s: s.x[np.arange(3), s.length - 1])
+
+    def test_first(self):
+        self._run("FIRST", lambda s: s.x[:, 0])
+
+    def test_sum_grad(self):
+        self.setup()
+        self.op_type = "sequence_pool"
+        self.check_grad({"X": self.x, "Length": self.length},
+                        {"pooltype": "SUM"}, grad_input_slot="X")
+
+
+class TestSequenceSoftmax(OpTest):
+    def test_softmax(self):
+        self.op_type = "sequence_softmax"
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 6).astype("float32")
+        length = np.array([4, 6], dtype="int32")
+        m = _mask(length, 6)
+        e = np.exp(np.where(m, x - np.max(np.where(m, x, -np.inf),
+                                          axis=1, keepdims=True), -np.inf))
+        exp = np.where(m, e / np.sum(e, axis=1, keepdims=True), 0.0)
+        self.check_output({"X": x, "Length": length}, {},
+                          {"Out": exp.astype("float32")}, atol=1e-5)
+
+
+class TestSequenceReverse(OpTest):
+    def test_reverse_with_length(self):
+        self.op_type = "sequence_reverse"
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 4, 3).astype("float32")
+        length = np.array([3, 4], dtype="int32")
+        exp = x.copy()
+        for b in range(2):
+            n = length[b]
+            exp[b, :n] = x[b, :n][::-1]
+        got = self.run_op({"X": x, "Length": length}, {}, output_slots=("Y",))
+        np.testing.assert_allclose(np.asarray(got["Y"]), exp, rtol=1e-6)
+
+
+class TestSequenceConcat(OpTest):
+    def test_concat(self):
+        self.op_type = "sequence_concat"
+        a = np.random.rand(2, 3, 4).astype("float32")
+        b = np.random.rand(2, 5, 4).astype("float32")
+        self.check_output({"X": [a, b]}, {},
+                          {"Out": np.concatenate([a, b], axis=1)})
+
+
+class TestSequencePad(OpTest):
+    def test_pad_extend(self):
+        self.op_type = "sequence_pad"
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 2).astype("float32")
+        length = np.array([2, 3], dtype="int32")
+        pv = np.array(-1.0, dtype="float32")
+        exp = np.full((2, 5, 2), -1.0, dtype="float32")
+        for b in range(2):
+            exp[b, :length[b]] = x[b, :length[b]]
+        got = self.run_op({"X": x, "PadValue": pv, "Length": length},
+                          {"padded_length": 5}, output_slots=("Out", "Length"))
+        np.testing.assert_allclose(np.asarray(got["Out"]), exp, rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(got["Length"]), length)
+
+    def test_pad_truncate(self):
+        self.op_type = "sequence_pad"
+        x = np.arange(2 * 4, dtype="float32").reshape(2, 4)
+        length = np.array([4, 2], dtype="int32")
+        pv = np.array(0.0, dtype="float32")
+        got = self.run_op({"X": x, "PadValue": pv, "Length": length},
+                          {"padded_length": 3}, output_slots=("Out", "Length"))
+        exp = x[:, :3].copy()
+        exp[1, 2:] = 0.0
+        np.testing.assert_allclose(np.asarray(got["Out"]), exp)
+        np.testing.assert_array_equal(np.asarray(got["Length"]), [3, 2])
+
+
+class TestSequenceUnpad(OpTest):
+    def test_unpad(self):
+        self.op_type = "sequence_unpad"
+        x = np.random.rand(2, 4, 3).astype("float32")
+        length = np.array([1, 3], dtype="int32")
+        exp = x * _mask(length, 4)[..., None]
+        self.check_output({"X": x, "Length": length}, {}, {"Out": exp})
+
+    def test_unpad_grad(self):
+        self.op_type = "sequence_unpad"
+        x = np.random.rand(2, 3, 2).astype("float32")
+        length = np.array([2, 3], dtype="int32")
+        self.check_grad({"X": x, "Length": length}, {}, grad_input_slot="X")
+
+
+def _seq_conv_ref(x, filt, length, ctx_len, ctx_start):
+    b, t, d = x.shape
+    m = _mask(length, t)[..., None]
+    xm = x * m
+    win = np.zeros((b, t, ctx_len * d), dtype=x.dtype)
+    for j in range(ctx_len):
+        off = ctx_start + j
+        for s in range(t):
+            src = s + off
+            if 0 <= src < t:
+                win[:, s, j * d:(j + 1) * d] = xm[:, src]
+    out = win @ filt
+    return out * m
+
+
+class TestSequenceConv(OpTest):
+    def test_conv(self):
+        self.op_type = "sequence_conv"
+        rng = np.random.RandomState(11)
+        x = rng.randn(2, 6, 3).astype("float32")
+        filt = rng.randn(9, 4).astype("float32")
+        length = np.array([4, 6], dtype="int32")
+        exp = _seq_conv_ref(x, filt, length, 3, -1)
+        self.check_output({"X": x, "Filter": filt, "Length": length},
+                          {"contextLength": 3, "contextStart": -1},
+                          {"Out": exp}, atol=1e-4)
+
+    def test_conv_grad(self):
+        self.op_type = "sequence_conv"
+        rng = np.random.RandomState(12)
+        x = rng.randn(2, 4, 2).astype("float32")
+        filt = rng.randn(6, 3).astype("float32")
+        length = np.array([3, 4], dtype="int32")
+        self.check_grad({"X": x, "Filter": filt, "Length": length},
+                        {"contextLength": 3, "contextStart": -1},
+                        grad_input_slot="Filter")
+
+
+class TestSequenceSlice(OpTest):
+    def test_slice(self):
+        self.op_type = "sequence_slice"
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 5, 2).astype("float32")
+        offset = np.array([1, 0], dtype="int32")
+        length = np.array([2, 4], dtype="int32")
+        exp = np.zeros_like(x)
+        for b in range(2):
+            exp[b, :length[b]] = x[b, offset[b]:offset[b] + length[b]]
+        self.check_output({"X": x, "Offset": offset, "Length": length}, {},
+                          {"Out": exp})
+
+
+class TestSequenceErase(OpTest):
+    def test_erase(self):
+        self.op_type = "sequence_erase"
+        x = np.array([[2, 1, 2, 3, 0], [5, 2, 2, 2, 1]], dtype="int32")
+        length = np.array([4, 5], dtype="int32")
+        got = self.run_op({"X": x, "Length": length}, {"tokens": [2]},
+                          output_slots=("Out", "Length"))
+        exp = np.array([[1, 3, 0, 0, 0], [5, 1, 0, 0, 0]], dtype="int32")
+        np.testing.assert_array_equal(np.asarray(got["Out"]), exp)
+        np.testing.assert_array_equal(np.asarray(got["Length"]), [2, 2])
+
+
+class TestSequenceExpandAs(OpTest):
+    def test_expand_as(self):
+        self.op_type = "sequence_expand_as"
+        x = np.random.rand(2, 3).astype("float32")
+        y = np.random.rand(2, 4, 3).astype("float32")
+        length = np.array([2, 4], dtype="int32")
+        exp = np.broadcast_to(x[:, None], (2, 4, 3)) * _mask(length, 4)[..., None]
+        self.check_output({"X": x, "Y": y, "Length": length}, {},
+                          {"Out": exp.astype("float32")})
+
+
+class TestSequenceEnumerate(OpTest):
+    def test_enumerate(self):
+        self.op_type = "sequence_enumerate"
+        x = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], dtype="int32")
+        length = np.array([3, 4], dtype="int32")
+        got = self.run_op({"X": x, "Length": length},
+                          {"win_size": 2, "pad_value": 0})
+        exp = np.array([[[1, 2], [2, 3], [3, 0], [0, 0]],
+                        [[5, 6], [6, 7], [7, 8], [8, 0]]], dtype="int32")
+        np.testing.assert_array_equal(np.asarray(got["Out"]), exp)
+
+
+class TestSequenceReshape(OpTest):
+    def test_reshape(self):
+        self.op_type = "sequence_reshape"
+        x = np.arange(2 * 4 * 6, dtype="float32").reshape(2, 4, 6)
+        length = np.array([2, 4], dtype="int32")
+        got = self.run_op({"X": x, "Length": length}, {"new_dim": 3},
+                          output_slots=("Out", "Length"))
+        np.testing.assert_allclose(np.asarray(got["Out"]), x.reshape(2, 8, 3))
+        np.testing.assert_array_equal(np.asarray(got["Length"]), [4, 8])
+
+
+class TestSequenceScatter(OpTest):
+    def test_scatter(self):
+        self.op_type = "sequence_scatter"
+        x = np.zeros((2, 6), dtype="float32")
+        ids = np.array([[0, 2, 2], [5, 1, 0]], dtype="int32")
+        upd = np.ones((2, 3), dtype="float32")
+        length = np.array([3, 2], dtype="int32")
+        exp = np.array([[1, 0, 2, 0, 0, 0], [0, 1, 0, 0, 0, 1]],
+                       dtype="float32")
+        self.check_output({"X": x, "Ids": ids, "Updates": upd,
+                           "Length": length}, {}, {"Out": exp})
+
+
+class TestSequenceTopkAvgPooling(OpTest):
+    def test_topk_avg(self):
+        self.op_type = "sequence_topk_avg_pooling"
+        rng = np.random.RandomState(9)
+        x = rng.randn(2, 3, 5).astype("float32")
+        length = np.array([4, 2], dtype="int32")
+        topks = [1, 3]
+        exp = np.zeros((2, 3 * len(topks)), dtype="float32")
+        for b in range(2):
+            for c in range(3):
+                vals = np.sort(x[b, c, :length[b]])[::-1]
+                for ki, k in enumerate(topks):
+                    kk = min(k, length[b])
+                    exp[b, c * len(topks) + ki] = vals[:kk].mean()
+        self.check_output({"X": x, "Length": length}, {"topks": topks},
+                          {"Out": exp}, atol=1e-5)
+
+
+class TestSequenceLayers:
+    """Layer-level smoke: sequence layers wire into a trainable program."""
+
+    def test_seq_conv_pool_pipeline_trains(self):
+        import paddle_tpu as fluid
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[6, 8], dtype="float32")
+            length = fluid.layers.data("len", shape=[], dtype="int32")
+            label = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.sequence_conv(x, num_filters=8, filter_size=3,
+                                           length=length, act="relu")
+            pooled = fluid.layers.sequence_pool(h, "max", length=length)
+            pred = fluid.layers.fc(pooled, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(pred - label))
+            opt = fluid.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(4, 6, 8).astype("float32"),
+                "len": np.array([3, 6, 2, 5], dtype="int32"),
+                "y": rng.randn(4, 1).astype("float32")}
+        losses = [exe.run(main, feed=feed, fetch_list=[loss])[0]
+                  for _ in range(5)]
+        assert float(losses[-1]) < float(losses[0]), \
+            f"sequence pipeline did not train: {losses}"
