@@ -174,7 +174,8 @@ class CompiledProgram:
         state = {n: jnp.asarray(scope.find_var(n)) for n in state_names}
         key = scope.find_var(_RNG_STATE)
         if key is None:
-            key = jax.random.PRNGKey(program.random_seed or 0)
+            from .executor import _make_key
+            key = _make_key(program.random_seed or 0)
 
         fetches, new_state, new_key = fn(state, feed_vals, key)
         for n, v in new_state.items():

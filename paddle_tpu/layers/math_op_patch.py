@@ -14,11 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.program import Variable
-from ..core.dtypes import convert_dtype, dtype_str
-
-
-def _is_float(dtype) -> bool:
-    return np.issubdtype(np.dtype(dtype_str(convert_dtype(dtype))), np.floating)
+from ..core.dtypes import dtype_str, is_floating as _is_float
 
 
 def _scalar_to_var(value, dtype):
@@ -76,20 +72,28 @@ def _binary(op_type, reverse=False):
         from . import ops as ops_layers
         out = getattr(ops_layers, op_type)(x, y)
         out.shape = _broadcast_shape(x, y)
+        # mixed-dtype operands promote at runtime (jnp rules); keep the
+        # static dtype in sync so dtype-keyed feeds/casts don't truncate
+        import jax.numpy as jnp
+        from ..core.dtypes import convert_dtype
+        promoted = jnp.promote_types(convert_dtype(x.dtype),
+                                     convert_dtype(y.dtype))
+        if dtype_str(promoted) != dtype_str(convert_dtype(out.dtype)):
+            out.dtype = dtype_str(promoted)
         return out
     fn.__name__ = f"__{op_type}__"
     return fn
 
 
-def _compare(op_type, reverse=False):
+def _compare(op_type):
+    # no reverse form: Python itself reflects comparisons by swapping operands
     def fn(self: Variable, other):
         try:
             other = _coerce(other, self)
         except TypeError:
             return NotImplemented
-        x, y = (other, self) if reverse else (self, other)
         from . import ops as ops_layers
-        return getattr(ops_layers, op_type)(x, y)
+        return getattr(ops_layers, op_type)(self, other)
     fn.__name__ = f"__{op_type}__"
     return fn
 
@@ -120,7 +124,9 @@ def monkey_patch_variable():
     Variable.__pow__ = _binary("elementwise_pow")
     Variable.__rpow__ = _binary("elementwise_pow", reverse=True)
     Variable.__mod__ = _binary("elementwise_mod")
+    Variable.__rmod__ = _binary("elementwise_mod", reverse=True)
     Variable.__floordiv__ = _binary("elementwise_floordiv")
+    Variable.__rfloordiv__ = _binary("elementwise_floordiv", reverse=True)
     Variable.__neg__ = _neg
     Variable.__matmul__ = _matmul
     # comparisons build boolean ops; __eq__/__ne__ stay Python identity so
