@@ -461,20 +461,28 @@ def crf_decoding(input, param_attr=None, length=None, label=None, name=None):
 def flash_attention(q: Variable, k: Variable, v: Variable,
                     attn_bias: Optional[Variable] = None,
                     causal: bool = False, dropout_prob: float = 0.0,
-                    is_test: bool = False, name=None) -> Variable:
-    """Fused memory-efficient attention over [B, H, T, D] tensors.
+                    is_test: bool = False, num_heads: Optional[int] = None,
+                    name=None) -> Variable:
+    """Fused memory-efficient attention.
 
     TPU-native replacement for the matmul→softmax→dropout→matmul attention
     pattern (no reference analog — the reference materializes the [B,H,T,T]
     score tensor). Pallas kernel on TPU; blockwise JAX elsewhere.
-    `attn_bias` is additive and broadcastable to [B, H, T, T]."""
+
+    Two layouts:
+    - [B, H, T, D] 4D q/k/v; `attn_bias` broadcastable to [B, H, T, T].
+    - packed [B, T, H·D] 3D q/k/v with `num_heads` (required for 3D) — the
+      convenience form for fused-qkv models; adapted internally to the
+      folded kernel layout. `attn_bias` is the [B, 1, T] mask."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
     inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
     if attn_bias is not None:
         inputs["BiasQK"] = [attn_bias.name]
+    attrs = {"causal": causal, "dropout_prob": dropout_prob,
+             "is_test": is_test}
+    if num_heads is not None:
+        attrs["num_heads"] = int(num_heads)
     helper.append_op(type="flash_attention", inputs=inputs,
-                     outputs={"Out": [out.name]},
-                     attrs={"causal": causal, "dropout_prob": dropout_prob,
-                            "is_test": is_test})
+                     outputs={"Out": [out.name]}, attrs=attrs)
     return out

@@ -70,25 +70,30 @@ def encoder_layer(cfg: BertConfig, x, attn_mask, idx: int, is_test=False):
                                         shard_spec=_tp(cfg, "tp")))
     q, k, v = layers.split(qkv, 3, dim=2)
 
-    def heads(t, name):
-        t = layers.reshape(t, [0, -1, nh, hd], name=name)
-        return layers.transpose(t, [0, 2, 1, 3])  # [B, nh, T, hd]
-
-    q, k, v = heads(q, f"{pre}.q"), heads(k, f"{pre}.k"), heads(v, f"{pre}.v")
     if cfg.use_flash_attention:
+        # packed [B, T, H] call — the head split/merge happens inside the
+        # fused op, keeping the graph free of reshape/transpose ops
         ctxv = layers.flash_attention(q, k, v, attn_mask,
                                       dropout_prob=cfg.attn_dropout,
-                                      is_test=is_test)  # [B, nh, T, hd]
+                                      is_test=is_test,
+                                      num_heads=nh)  # [B, T, H]
     else:
+        def heads(t, name):
+            t = layers.reshape(t, [0, -1, nh, hd], name=name)
+            return layers.transpose(t, [0, 2, 1, 3])  # [B, nh, T, hd]
+
+        q, k, v = (heads(q, f"{pre}.q"), heads(k, f"{pre}.k"),
+                   heads(v, f"{pre}.v"))
         scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(hd))
-        scores = layers.elementwise_add(scores, attn_mask)  # mask: [B,1,1,T] additive
+        # mask: [B,1,1,T] additive
+        scores = layers.elementwise_add(scores, layers.unsqueeze(attn_mask, [1]))
         probs = layers.softmax(scores)
         if cfg.attn_dropout > 0:
             probs = layers.dropout(probs, cfg.attn_dropout, is_test=is_test,
                                    dropout_implementation="upscale_in_train")
         ctxv = layers.matmul(probs, v)  # [B, nh, T, hd]
-    ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
-    ctxv = layers.reshape(ctxv, [0, -1, nh * hd])
+        ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
+        ctxv = layers.reshape(ctxv, [0, -1, nh * hd])
     # output proj: input dim sharded under TP (row-parallel)
     attn_out = layers.fc(ctxv, h, num_flatten_dims=2,
                          param_attr=_attr(cfg, f"{pre}.attn_out.w", _tp(cfg, "tp", None)),
@@ -145,11 +150,12 @@ def bert_encoder(cfg: BertConfig, src_ids, pos_ids, sent_ids, input_mask,
                  is_test=False):
     """input_mask: [B, T] float (1 = token). Returns sequence output [B,T,H]."""
     emb = embeddings(cfg, src_ids, pos_ids, sent_ids, is_test)
-    # additive mask [B,1,1,T]: (mask-1)*10000 → 0 for keep, -10000 for pad
+    # additive mask [B,1,T]: (mask-1)*10000 → 0 for keep, -10000 for pad
+    # (the packed flash path consumes [B,1,T]; the dense path re-expands)
     neg = layers.scale(layers.elementwise_add(input_mask,
                                               layers.fill_constant([1], "float32", -1.0)),
                        scale=10000.0)
-    mask4 = layers.unsqueeze(neg, [1, 2])
+    mask4 = layers.unsqueeze(neg, [1])
     x = emb
     for i in range(cfg.num_layers):
         x = encoder_layer(cfg, x, mask4, i, is_test)

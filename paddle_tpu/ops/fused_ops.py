@@ -49,7 +49,11 @@ def _flash_attention(ctx, inputs, attrs):
     """Memory-efficient fused attention (Pallas on TPU, blockwise JAX
     elsewhere). Replaces the matmul→softmax→dropout→matmul chain; see
     ops/pallas_kernels/flash_attention.py."""
-    from .pallas_kernels import flash_attention as _fa
+    import importlib
+    # the package re-exports the flash_attention *function* under the same
+    # name, shadowing the submodule — import the module explicitly
+    _fa = importlib.import_module(
+        "paddle_tpu.ops.pallas_kernels.flash_attention")
 
     (q,) = inputs["Q"]
     (k,) = inputs["K"]
@@ -60,5 +64,17 @@ def _flash_attention(ctx, inputs, attrs):
     key = None
     if rate > 0.0 and not is_test:
         key = ctx.rng()
-    return one(_fa(q, k, v, bias=bias, causal=attrs.get("causal", False),
-                   dropout_rate=0.0 if is_test else rate, dropout_key=key))
+    if q.ndim == 3:
+        # packed [B, T, H] layout — adapted to the folded kernel layout
+        # (see the layout note in pallas_kernels/flash_attention.py)
+        if "num_heads" not in attrs:
+            raise ValueError(
+                "flash_attention: 3D (packed [B,T,H]) q/k/v requires the "
+                "num_heads attr — pass num_heads= to layers.flash_attention")
+        return one(_fa.flash_attention_packed(
+            q, k, v, attrs["num_heads"], bias=bias,
+            causal=attrs.get("causal", False),
+            dropout_rate=0.0 if is_test else rate, dropout_key=key))
+    return one(_fa.flash_attention(
+        q, k, v, bias=bias, causal=attrs.get("causal", False),
+        dropout_rate=0.0 if is_test else rate, dropout_key=key))

@@ -12,6 +12,8 @@ TPU-native replacement for SelectedRows sparse rows (selected_rows.h).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -364,16 +366,72 @@ def _pick_hard_label(logp, label, axis, ignore):
     return loss
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _hard_label_ce(logits, idx, axis, ignore):
+    """Memory-lean hard-label CE: works on low-precision logits directly
+    (f32 reductions in-register), saves only (logits, idx, lse) for the
+    backward — never materializes a full-vocab f32 softmax. At BERT's MLM
+    head ([B·T, 30k] logits) this halves the HBM traffic of the loss."""
+    loss, _ = _hard_label_ce_fwd(logits, idx, axis, ignore)
+    return loss
+
+
+def _hard_label_ce_fwd(logits, idx, axis, ignore):
+    ax = axis % logits.ndim
+    # max over the native dtype is exact (max of bf16 values IS a bf16), and
+    # each .astype(f32) below has exactly one consumer chain so XLA fuses the
+    # cast into the reduce — a shared `lf = logits.astype(f32)` would
+    # materialize a full-vocab f32 copy (4 GB on the BERT-base MLM head)
+    m = jnp.max(logits, axis=ax, keepdims=True)
+    sumexp = jnp.sum(jnp.exp(logits.astype(jnp.float32)
+                             - m.astype(jnp.float32)),
+                     axis=ax, keepdims=True)
+    lse = m.astype(jnp.float32) + jnp.log(sumexp)
+    picked = jnp.take_along_axis(
+        logits, jnp.expand_dims(idx.astype(jnp.int32), ax),
+        axis=ax).astype(jnp.float32)
+    loss = lse - picked
+    if ignore is not None:
+        loss = jnp.where(jnp.expand_dims(idx == ignore, ax), 0.0, loss)
+    return loss, (logits, idx, lse)
+
+
+def _hard_label_ce_bwd(axis, ignore, res, g):
+    logits, idx, lse = res
+    ax = axis % logits.ndim
+    p = jnp.exp(logits.astype(jnp.float32) - lse)
+    iota = lax.broadcasted_iota(jnp.int32, logits.shape, ax)
+    onehot = iota == jnp.expand_dims(idx.astype(jnp.int32), ax)
+    gv = g
+    if ignore is not None:
+        gv = jnp.where(jnp.expand_dims(idx == ignore, ax), 0.0, gv)
+    dlogits = ((p - onehot) * gv).astype(logits.dtype)
+    return dlogits, None
+
+
+_hard_label_ce.defvjp(_hard_label_ce_fwd, _hard_label_ce_bwd)
+
+
 @register_op("softmax_with_cross_entropy", nondiff_inputs=["Label"])
 def _softmax_with_cross_entropy(ctx, inputs, attrs):
     (logits,) = inputs["Logits"]
     (label,) = inputs["Label"]
     axis = attrs.get("axis", -1)
-    logp = jax.nn.log_softmax(logits, axis=axis)
-    if attrs.get("soft_label", False):
-        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
-    else:
-        loss = _pick_hard_label(logp, label, axis, attrs.get("ignore_index", -100))
+    if not attrs.get("soft_label", False):
+        ax = axis % logits.ndim
+        idx = label
+        if idx.ndim == logits.ndim and idx.shape[ax] == 1:
+            idx = jnp.squeeze(idx, ax)
+        loss = _hard_label_ce(logits, idx, axis,
+                              attrs.get("ignore_index", -100))
+        # recomputed independently of the loss path → DCE'd when unused
+        softmax = jax.nn.softmax(logits.astype(jnp.float32), axis=axis)
+        return {"Loss": [loss], "Softmax": [softmax]}
+    # soft-label path: the op is AMP-white-listed (inputs may arrive bf16),
+    # so upcast — a vocab-length bf16 accumulation would lose ~3 digits
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis,
+                    keepdims=True)
     return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
 
 

@@ -102,7 +102,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q, block_k,
                 dropout_rate):
     b, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    nk = pl.num_programs(2)
+    nq, nk = pl.num_programs(1), pl.num_programs(2)
 
     @pl.when(ik == 0)
     def _init():
@@ -136,7 +136,6 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         # final division by l)
         l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
-            nq = pl.num_programs(1)
             keep = _keep_mask(seed_ref, _block_index(b, iq, ik, nq, nk),
                               (block_q, block_k), dropout_rate)
             p_v = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
@@ -227,6 +226,11 @@ def _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, *args)
+    # lse is sliced compact [BH, T] for the residual: keeping the
+    # lane-replicated [BH,T,128] form between fwd and bwd saves a
+    # slice→re-broadcast round trip (~2 ms/step) but costs 128× the memory
+    # (2.3 GB of residuals on BERT-base b=64) — which forces XLA into far
+    # more expensive rematerializations. Memory wins.
     return out, lse[:, :, 0]
 
 
@@ -243,7 +247,7 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
                    delta_ref, dq_ref, dbias_ref, dq_acc, *, sm_scale, causal,
                    block_q, block_k, dropout_rate):
     b, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    nk = pl.num_programs(2)
+    nq, nk = pl.num_programs(1), pl.num_programs(2)
 
     @pl.when(ik == 0)
     def _init():
@@ -271,7 +275,6 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bq, bk]
         if dropout_rate > 0.0:
-            nq = pl.num_programs(1)
             keep = _keep_mask(seed_ref, _block_index(b, iq, ik, nq, nk),
                               (block_q, block_k), dropout_rate)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
@@ -309,7 +312,7 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
                     dropout_rate):
     # grid is (bh, nk, nq): k-block outer, q-block inner
     b, ik, iq = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    nq = pl.num_programs(2)
+    nk, nq = pl.num_programs(1), pl.num_programs(2)
 
     @pl.when(iq == 0)
     def _init():
@@ -341,10 +344,8 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
             preferred_element_type=jnp.float32)               # [bq, bk]
         if dropout_rate > 0.0:
             # same (b, iq, ik) index as fwd/dq kernels → identical mask
-            nk_tot = pl.num_programs(1)
-            nq_tot = pl.num_programs(2)
             keep = _keep_mask(seed_ref,
-                              _block_index(b, iq, ik, nq_tot, nk_tot),
+                              _block_index(b, iq, ik, nq, nk),
                               (block_q, block_k), dropout_rate)
             inv = 1.0 / (1.0 - dropout_rate)
             p_v = jnp.where(keep, p * inv, 0.0)
@@ -393,6 +394,12 @@ def _flash_bwd_pallas(q, k, v, bias, g, lse, out, sm_scale, causal,
     gf = g.astype(q.dtype)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                   # [BH, T]
+    # tie the lse broadcast to g: without the data dependency XLA's
+    # scheduler hoists every layer's 128-lane-replicated broadcast to the
+    # start of the backward and keeps them all live (~190 MB × layers).
+    # optimization_barrier creates the ordering without a numeric path (a
+    # `+ 0*g[0]` tie would propagate a single inf/NaN to every row)
+    lse, _ = lax.optimization_barrier((lse, gf))
     lse_r = jnp.broadcast_to(lse[:, :, None], (bh, t, _LANES))
     delta_r = jnp.broadcast_to(delta[:, :, None], (bh, t, _LANES))
 
@@ -657,6 +664,70 @@ def _flash_bwd_jax(res, g, *, sm_scale, causal, block_k,
         # block axis must precede the within-block key axis before reshape
         dbias = jnp.moveaxis(dbias_blocks, 0, 2).reshape(bh, t, t)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dbias
+
+
+# ---------------------------------------------------------------------------
+# Packed-layout [B, T, H] public entry.
+#
+# A head-native Pallas path was measured and rejected: Mosaic requires
+# 128-divisible (or full) minor block dims, so a per-head 64-wide column
+# cannot be a block; head-batched tiles with in-kernel 64-lane slicing ran
+# 3× slower than the folded kernels (VPU relayouts), and batched dots with
+# batch dims in the middle don't lower at all ("batch dims pos must be 0").
+# The packed API therefore adapts to the folded layout — XLA inserts the
+# head-split transposes (~5% of a BERT-base step), which is the measured
+# optimum on v5e for d=64 heads.
+# ---------------------------------------------------------------------------
+
+def _pack_to_folded(x, nh):
+    b_, t, hdim = x.shape
+    d = hdim // nh
+    return x.reshape(b_, t, nh, d).transpose(0, 2, 1, 3).reshape(b_ * nh, t, d)
+
+
+def _folded_to_pack(x, b_):
+    bh, t, d = x.shape
+    nh = bh // b_
+    return x.reshape(b_, nh, t, d).transpose(0, 2, 1, 3).reshape(b_, t, nh * d)
+
+
+def flash_attention_packed(q, k, v, num_heads: int, bias=None,
+                           causal: bool = False,
+                           sm_scale: Optional[float] = None,
+                           dropout_rate: float = 0.0, dropout_key=None):
+    """Memory-efficient attention on packed [B, T, H] tensors (H = nh·d).
+
+    Adapts to the folded [B·nh, T, d] kernel layout; XLA inserts the
+    head-split transposes (see the layout note above — measured optimum for
+    d=64 heads on v5e). bias (optional) is the additive [B, 1, T] mask.
+    Returns [B, T, H]."""
+    b_, t, hdim = q.shape
+    if hdim % num_heads:
+        raise ValueError(f"hidden {hdim} not divisible by heads {num_heads}")
+    d = hdim // num_heads
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(
+            f"flash_attention: dropout_rate must be in [0, 1), got "
+            f"{dropout_rate}")
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError(
+            "flash_attention: dropout_rate > 0 requires a dropout_key; "
+            "pass one or set dropout_rate=0 for inference")
+    if bias is not None:
+        if bias.ndim != 3 or bias.shape[1] != 1:
+            raise ValueError(
+                f"packed flash_attention bias must be [B, 1, T], got "
+                f"{bias.shape}")
+        bias = jnp.broadcast_to(bias[:, None], (b_, num_heads, 1, t)).reshape(
+            b_ * num_heads, 1, t)
+    if dropout_rate == 0.0:
+        dropout_key = None
+    qf, kf, vf = (_pack_to_folded(x, num_heads) for x in (q, k, v))
+    out = _flash_core(qf, kf, vf, bias, dropout_key, float(sm_scale),
+                      bool(causal), float(dropout_rate))
+    return _folded_to_pack(out, b_)
 
 
 # ---------------------------------------------------------------------------
