@@ -14,8 +14,11 @@ WHITE_LIST = {"conv2d", "conv3d", "depthwise_conv2d", "conv2d_transpose",
 # deliberately NOT here: its kernel takes low-precision logits and does the
 # reductions in f32 internally (nn_ops._hard_label_ce) — black-listing it
 # would materialize a full-vocab f32 logits copy just to feed it.
+# batch_norm is gray (not listed): its kernel keeps x in the native dtype
+# and does the statistics in f32 internally — black-listing it would bounce
+# a bf16 conv trunk through f32 HBM at every layer.
 BLACK_LIST = {"cross_entropy", "mean",
-              "reduce_mean", "layer_norm", "batch_norm", "softmax", "sum",
+              "reduce_mean", "layer_norm", "softmax", "sum",
               "exp", "log", "rsqrt", "sqrt"}
 
 

@@ -60,15 +60,25 @@ def append_backward(
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """Reference backward.py:938 — grads of `targets` wrt arbitrary `inputs`."""
+    """Reference backward.py:938 `calc_gradient` — grads of one or more
+    `targets` w.r.t. arbitrary `inputs`, summed over targets. Each entry of
+    `target_gradients` (if given) seeds the corresponding target's cotangent;
+    None entries (or omitting the list) seed with ones."""
     if isinstance(targets, Variable):
         targets = [targets]
     if isinstance(inputs, Variable):
         inputs = [inputs]
-    if len(targets) != 1:
-        raise NotImplementedError("gradients() currently supports one target")
-    loss = targets[0]
-    block = loss.block
+    if not targets:
+        raise ValueError("gradients() needs at least one target")
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    elif isinstance(target_gradients, Variable):
+        target_gradients = [target_gradients]
+    if len(target_gradients) != len(targets):
+        raise ValueError(
+            f"target_gradients has {len(target_gradients)} entries for "
+            f"{len(targets)} targets")
+    block = targets[0].block
     names = [v.name if isinstance(v, Variable) else v for v in inputs]
     no_grad = {v.name if isinstance(v, Variable) else v for v in (no_grad_set or set())}
     names = [n for n in names if n not in no_grad]
@@ -80,12 +90,16 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
                               persistable=False, stop_gradient=True)
         outs.append(gv)
 
-    attrs = {"loss_name": loss.name, "targets": names}
-    inputs_map = {"Loss": [loss.name]}
-    if target_gradients is not None:
-        tg = target_gradients[0] if isinstance(target_gradients, (list, tuple)) else target_gradients
-        attrs["init_grad_name"] = tg.name
-        inputs_map["InitGrad"] = [tg.name]
+    loss_names = [t.name for t in targets]
+    init_names = [None if g is None else g.name for g in target_gradients]
+    attrs = {"loss_names": loss_names, "init_grad_names": init_names,
+             "targets": names,
+             # single-target aliases for backward compatibility
+             "loss_name": loss_names[0]}
+    inputs_map = {"Loss": loss_names}
+    seeds = [n for n in init_names if n is not None]
+    if seeds:
+        inputs_map["InitGrad"] = seeds
     block.append_op(
         type="autodiff",
         inputs=inputs_map,

@@ -222,11 +222,31 @@ def _run_autodiff(op, env, ctx: ExecContext):
         return bool(v is not None and v.stop_gradient)
 
     cots: Dict[str, object] = {}
-    init_name = op.attrs.get("init_grad_name")
-    if init_name is not None:
-        cots[loss_name] = env[init_name]
+    if "loss_names" in op.attrs:  # calc_gradient: one seed per target
+        init_names = op.attrs.get("init_grad_names") or [None] * len(
+            op.attrs["loss_names"])
+        for ln, ig in zip(op.attrs["loss_names"], init_names):
+            if ig is None:
+                seed = jnp.ones_like(env[ln])
+            else:  # conform seed to the target (e.g. [1] seed for a scalar)
+                seed = jnp.asarray(env[ig])
+                tgt_shape = jnp.shape(env[ln])
+                if seed.shape != tgt_shape:
+                    if seed.size == env[ln].size:
+                        seed = seed.reshape(tgt_shape)
+                    elif seed.size == 1:
+                        seed = jnp.broadcast_to(seed.reshape(()), tgt_shape)
+                    else:
+                        raise ValueError(
+                            f"target_gradient for {ln!r} has shape "
+                            f"{seed.shape}, target has {tgt_shape}")
+            cots[ln] = cots[ln] + seed if ln in cots else seed
     else:
-        cots[loss_name] = jnp.ones_like(env[loss_name])
+        init_name = op.attrs.get("init_grad_name")
+        if init_name is not None:
+            cots[loss_name] = env[init_name]
+        else:
+            cots[loss_name] = jnp.ones_like(env[loss_name])
 
     for entry in reversed(ctx.tape):
         if not any(n in cots for n in entry.out_names):

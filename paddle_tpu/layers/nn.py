@@ -182,7 +182,8 @@ def batch_norm(input: Variable, act: Optional[str] = None, is_test: bool = False
                                          initializer=ConstantInitializer(0.0))
     var = helper.create_global_variable([c], input.dtype, name=moving_variance_name,
                                         initializer=ConstantInitializer(1.0))
-    out = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=input.shape)
     saved_mean = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
     saved_var = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
     helper.append_op(

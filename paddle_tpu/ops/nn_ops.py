@@ -48,12 +48,14 @@ def _conv2d(ctx, inputs, attrs):
     else:
         padding = [(pads[0], pads[0]), (pads[1], pads[1])] if len(pads) == 2 else \
             [(pads[0], pads[1]), (pads[2], pads[3])]
+    # no preferred_element_type=f32: the MXU accumulates bf16 convs in f32
+    # regardless and only rounds the output, while jax 0.9's conv transpose
+    # rule mishandles mixed (bf16, f32) operands it would create
     out = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    return one(out.astype(x.dtype))
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return one(out)
 
 
 @register_op("depthwise_conv2d")
@@ -185,14 +187,20 @@ def _batch_norm(ctx, inputs, attrs):
         saved_mean = mean
         saved_var = var
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        # statistics always in f32 (bf16 accumulation over N·H·W terms would
+        # lose digits); x itself stays in its native dtype — the op is
+        # AMP-"gray" so a bf16 conv trunk never round-trips through f32 HBM
+        use_mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        # two-pass variance: E[x²]−E[x]² cancels catastrophically for
+        # large-mean/small-spread channels (can go negative → rsqrt NaN)
+        use_var = jnp.var(x.astype(jnp.float32), axis=axes)
         mean_out = momentum * mean + (1.0 - momentum) * use_mean
         var_out = momentum * var + (1.0 - momentum) * use_var
         saved_mean = use_mean
         saved_var = use_var
-    inv = lax.rsqrt(use_var.reshape(shape) + eps)
-    y = (x - use_mean.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
+    inv = lax.rsqrt(use_var.astype(jnp.float32).reshape(shape) + eps)
+    y = ((x.astype(jnp.float32) - use_mean.astype(jnp.float32).reshape(shape))
+         * inv * scale.reshape(shape) + bias.reshape(shape)).astype(x.dtype)
     return {
         "Y": [y],
         "MeanOut": [lax.stop_gradient(mean_out)],

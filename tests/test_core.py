@@ -145,3 +145,33 @@ def test_rng_reproducible_across_programs():
     b = run_once()
     np.testing.assert_allclose(a, b)
     assert np.abs(a).sum() > 0
+
+
+def test_gradients_multi_target():
+    """calc_gradient parity (reference backward.py:820): several targets,
+    per-target seed cotangents, contributions summed."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [3])
+        t1 = fluid.layers.reduce_sum(fluid.layers.square(x))      # d/dx = 2x
+        t2 = fluid.layers.reduce_sum(fluid.layers.scale(x, 3.0))  # d/dx = 3
+        (gx,) = fluid.gradients([t1, t2], [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+        (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 2 * xv + 3.0, rtol=1e-6)
+
+
+def test_gradients_multi_target_seeded():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [3])
+        t1 = fluid.layers.reduce_sum(fluid.layers.square(x))
+        t2 = fluid.layers.reduce_sum(fluid.layers.scale(x, 3.0))
+        seed = fluid.layers.fill_constant([1], "float32", 10.0)
+        (gx,) = fluid.gradients([t1, t2], [x],
+                                target_gradients=[None, seed])
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+        (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 2 * xv + 30.0, rtol=1e-6)

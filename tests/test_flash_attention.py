@@ -308,3 +308,43 @@ def test_pallas_dropout_on_tpu():
     g = jax.grad(lambda q: jnp.sum(
         flash_attention(q, k, v, dropout_rate=0.3, dropout_key=key) ** 2))(q)
     assert np.isfinite(np.asarray(g)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_multi_kblock(causal):
+    """Gradients with t > block (nk > 1) exercise the online-softmax
+    correction across K blocks and the dbias reassembly — the paths a
+    single-block seq len never reaches (ADVICE r1). Runs the Pallas
+    kernels through the interpreter; full [B,H,T,T] trainable bias
+    included, compared against the naive attention gradient."""
+    import importlib
+    import jax
+    import jax.numpy as jnp
+    fa_mod = importlib.import_module(
+        "paddle_tpu.ops.pallas_kernels.flash_attention")
+
+    b, h, d = 1, 2, 64  # d must satisfy the _pallas_ok d%64 gate
+    t = fa_mod.DEFAULT_BLOCK_Q * 2  # guarantees nq = nk = 2
+    old = fa_mod.FORCE_PALLAS_INTERPRET
+    fa_mod.FORCE_PALLAS_INTERPRET = True
+    try:
+        assert fa_mod._pallas_ok(t, d), "test must exercise the Pallas path"
+        q, k, v = (jnp.asarray(_rand((b, h, t, d), i)) for i in range(3))
+        bias = jnp.asarray(_rand((b, h, t, t), 7) * 0.5)
+
+        def loss_flash(q, k, v, bias):
+            o = fa_mod.flash_attention(q, k, v, bias=bias, causal=causal)
+            return jnp.sum(o * jnp.cos(o))
+
+        def loss_naive(q, k, v, bias):
+            o = _naive_attention(q, k, v, bias=bias, causal=causal)
+            return jnp.sum(o * jnp.cos(o))
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        gn = jax.grad(loss_naive, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for name, a, bb in zip(("dq", "dk", "dv", "dbias"), gf, gn):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(bb), rtol=5e-4, atol=5e-4,
+                err_msg=f"{name} mismatch at t={t} (multi-block)")
+    finally:
+        fa_mod.FORCE_PALLAS_INTERPRET = old

@@ -90,3 +90,26 @@ def test_mnist_mlp_infer_matches_train_graph():
         (a,) = exe.run(test_prog, feed={"img": x, "label": y}, fetch_list=[logits])
         (b,) = exe.run(test_prog, feed={"img": x, "label": y}, fetch_list=[logits])
         np.testing.assert_allclose(a, b)
+
+
+def test_resnet50_convergence_smoke():
+    """Depth-50 static-graph ResNet trains and the loss decreases
+    (BASELINE config 2; reference book/test_image_classification.py)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, feeds, loss, acc = resnet.build_train_program(
+            depth=50, num_classes=10, lr=0.01, img_shape=(3, 32, 32))
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        img = rng.randn(4, 3, 32, 32).astype("float32") * 0.1
+        label = rng.randint(0, 10, (4, 1)).astype("int64")
+        losses = []
+        for _ in range(6):
+            l, _ = exe.run(main, feed={"img": img, "label": label},
+                           fetch_list=[loss, acc])
+            losses.append(float(l))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
