@@ -14,6 +14,10 @@ import time
 
 import numpy as np
 
+# v5e bf16 peak; CPU placeholder for non-TPU smoke runs
+def _peak_flops(on_tpu):
+    return 197e12 if on_tpu else 1e12
+
 
 def bench_resnet(on_tpu):
     """ResNet-50 train-step throughput (BASELINE config 2). Returns
@@ -59,8 +63,7 @@ def bench_resnet(on_tpu):
     imgs_per_sec = batch / dt
     # ResNet-50 @224²: ~4.1 GFLOP fwd; fwd+bwd ≈ 3×
     flops_per_img = 3 * 4.1e9 if hw == 224 else 3 * 4.1e9 * (hw / 224) ** 2
-    peak = 197e12 if on_tpu else 1e12
-    mfu = imgs_per_sec * flops_per_img / peak
+    mfu = imgs_per_sec * flops_per_img / _peak_flops(on_tpu)
     return round(imgs_per_sec, 2), round(mfu, 4), round(dt * 1e3, 2)
 
 
@@ -121,8 +124,7 @@ def main():
     tokens_per_sec = batch * seq / dt
     n_params = bert.param_count(cfg)
     flops_per_token = 6 * n_params  # fwd+bwd dense estimate
-    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; CPU placeholder
-    mfu = tokens_per_sec * flops_per_token / peak
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(on_tpu)
 
     # second BASELINE metric: ResNet-50 imgs/s/chip (failures don't take
     # down the primary metric)

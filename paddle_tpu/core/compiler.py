@@ -134,10 +134,13 @@ class CompiledProgram:
         key_sh = NamedSharding(mesh, P())
         out_state_sh = {n: self._state_sharding(n) for n in out_state_names}
 
+        # fetches are replicated so every process can np.asarray() them
+        # (a partially-addressable fetch would fail on multi-host)
+        fetch_sh = [NamedSharding(mesh, P()) for _ in fetch_names]
         return jax.jit(
             step,
             in_shardings=(state_sh, feed_sh, key_sh),
-            out_shardings=(None, out_state_sh, key_sh),
+            out_shardings=(fetch_sh, out_state_sh, key_sh),
             donate_argnums=(0,),
         )
 
@@ -153,12 +156,24 @@ class CompiledProgram:
         scope = scope or _scope()
         fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
 
+        multiproc = jax.process_count() > 1
+
         block = program.global_block()
         feed_vals = {}
         for name, val in feed.items():
             var = block._find_var_recursive(name)
             dtype = var.dtype if var is not None else None
-            feed_vals[name] = jnp.asarray(val, dtype=dtype)
+            if multiproc and not isinstance(val, jax.Array):
+                # each trainer process feeds its LOCAL batch shard (the
+                # reference's per-trainer reader contract, test_dist_base.py);
+                # assemble the global array across processes
+                local = np.asarray(val)
+                if dtype is not None:
+                    local = local.astype(jnp.dtype(dtype))
+                feed_vals[name] = jax.make_array_from_process_local_data(
+                    self._feed_sharding(), local)
+            else:
+                feed_vals[name] = jnp.asarray(val, dtype=dtype)
 
         state_names = sorted(
             v.name for v in program.list_vars()
@@ -171,11 +186,30 @@ class CompiledProgram:
             fn = self._build(sorted(feed_vals), fetch_names, state_names, out_state_names)
             self._cache[key_sig] = fn
 
-        state = {n: jnp.asarray(scope.find_var(n)) for n in state_names}
+        state = {}
+        for n in state_names:
+            v = scope.find_var(n)
+            if multiproc and not isinstance(v, jax.Array):
+                # process-local startup values are identical across ranks
+                # (same seed) and hold the FULL value; the callback slices
+                # each device's shard from it, which stays correct for
+                # sharded (shard_spec) parameters, unlike
+                # make_array_from_process_local_data (which would treat the
+                # full copy as this process's shard)
+                full = np.asarray(v)
+                state[n] = jax.make_array_from_callback(
+                    full.shape, self._state_sharding(n),
+                    lambda idx, _full=full: _full[idx])
+            else:
+                state[n] = jnp.asarray(v)
         key = scope.find_var(_RNG_STATE)
         if key is None:
             from .executor import _make_key
             key = _make_key(program.random_seed or 0)
+        if multiproc and not (isinstance(key, jax.Array)
+                              and len(key.sharding.device_set) > 1):
+            key = jax.make_array_from_process_local_data(
+                NamedSharding(self._mesh, P()), np.asarray(key))
 
         fetches, new_state, new_key = fn(state, feed_vals, key)
         for n, v in new_state.items():

@@ -149,7 +149,10 @@ def _run_op(op, env: Dict[str, object], ctx: ExecContext):
     in_vals = {slot: [env[n] for n in names] for slot, names in op.inputs.items()}
 
     flat_in_names = [n for slot in sorted(op.inputs) for n in op.inputs[slot]]
-    differentiable = opdef.differentiable and not ctx.is_test
+    diff = opdef.differentiable
+    if callable(diff):  # attr-dependent (e.g. `while` with a trip bound)
+        diff = diff(op.attrs)
+    differentiable = diff and not ctx.is_test
 
     if differentiable and flat_in_names:
         in_slots = sorted(op.inputs)
@@ -222,6 +225,7 @@ def _run_autodiff(op, env, ctx: ExecContext):
         return bool(v is not None and v.stop_gradient)
 
     cots: Dict[str, object] = {}
+    finished: Dict[str, object] = {}  # target cotangents consumed by the walk
     if "loss_names" in op.attrs:  # calc_gradient: one seed per target
         init_names = op.attrs.get("init_grad_names") or [None] * len(
             op.attrs["loss_names"])
@@ -255,6 +259,14 @@ def _run_autodiff(op, env, ctx: ExecContext):
             cots.get(n, _zero_cotangent(v))
             for n, v in zip(entry.out_names, entry.out_vals))
         in_cots = entry.vjp_fn(out_cots)
+        # non-SSA names: this entry's outputs are now consumed — clear them
+        # so an op whose inputs reuse an output name (while/assign carries)
+        # replaces the cotangent instead of double-counting it. Requested
+        # targets keep their first-consumed (= final-instance) cotangent.
+        for n in entry.out_names:
+            g = cots.pop(n, None)
+            if g is not None and n in target_set and n not in finished:
+                finished[n] = g
         for name, g in zip(entry.in_names, in_cots):
             if g is None or name in entry.nondiff_in or _stop_grad(name):
                 continue
@@ -267,7 +279,10 @@ def _run_autodiff(op, env, ctx: ExecContext):
 
     for t in targets:
         gname = grad_var_name(t)
-        env[gname] = cots.get(t, jnp.zeros_like(env[t]))
+        if t in finished:
+            env[gname] = finished[t]
+        else:
+            env[gname] = cots.get(t, jnp.zeros_like(env[t]))
 
 
 def _run_block(block: Block, env: Dict[str, object], ctx: ExecContext):

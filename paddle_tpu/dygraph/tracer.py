@@ -76,7 +76,10 @@ class Tracer:
         opdef = registry.get_op(op_type)
         self._ctx.is_test = not self._train_mode
 
-        need_grad = (self.grad_enabled and opdef.differentiable
+        diff = opdef.differentiable
+        if callable(diff):  # attr-dependent (e.g. `while` with a trip bound)
+            diff = diff(attrs)
+        need_grad = (self.grad_enabled and diff
                      and any(not v.stop_gradient for vs in inputs.values() for v in vs))
         if not need_grad:
             in_vals = {s: [v.value for v in vs] for s, vs in inputs.items()}

@@ -83,9 +83,14 @@ class While:
     loop-invariant.
     """
 
-    def __init__(self, cond: Variable, is_test: bool = False, name=None):
+    def __init__(self, cond: Variable, is_test: bool = False, name=None,
+                 max_iters: Optional[int] = None):
+        """`max_iters` (TPU extension): a static trip bound. When given, the
+        loop lowers to a fixed-length scan of masked updates and becomes
+        reverse-mode differentiable (reference WhileGradOp capability)."""
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
+        self.max_iters = max_iters
         self._parent = None
         self._block = None
 
@@ -108,13 +113,16 @@ class While:
         carried = list(dict.fromkeys(reads + writes))
         if self.cond_var.name not in carried:
             carried.append(self.cond_var.name)
+        attrs = {"sub_block": self._block,
+                 "loop_vars": carried,
+                 "cond_name": self.cond_var.name}
+        if self.max_iters is not None:
+            attrs["max_iters"] = int(self.max_iters)
         self._parent.append_op(
             type="while",
             inputs={"X": carried},
             outputs={"Out": carried},
-            attrs={"sub_block": self._block,
-                   "loop_vars": carried,
-                   "cond_name": self.cond_var.name})
+            attrs=attrs)
         return False
 
 

@@ -34,22 +34,43 @@ def _lower_subblock(ctx, block, env_names: List[str]):
     return fn
 
 
-@register_op("while", differentiable=False)
+@register_op("while",
+             differentiable=lambda attrs: attrs.get("max_iters") is not None)
 def _while(ctx, inputs, attrs):
-    """while_op.cc parity via lax.while_loop. Carried vars are the declared
-    loop vars (attr 'loop_vars'); Condition is a scalar bool var name."""
+    """while_op.cc parity. Two lowerings:
+
+    - unbounded (no `max_iters`): lax.while_loop — data-dependent trip
+      count, non-differentiable (inference decoding loops);
+    - bounded (`max_iters=N`): a fixed-length lax.scan of masked updates —
+      the loop body runs N times and each carried value only advances while
+      the condition still holds. Reverse-mode differentiable, which is what
+      gives the reference's WhileGradOp (while_op.cc) capability a
+      TPU-native answer: trained dynamic decoders with a known bound.
+    """
     block = attrs["sub_block"]
     loop_vars: List[str] = attrs["loop_vars"]
     cond_name: str = attrs["cond_name"]
+    max_iters = attrs.get("max_iters")
     xs = inputs["X"]
     body = _lower_subblock(ctx, block, loop_vars)
-
     cond_idx = loop_vars.index(cond_name)
 
-    def cond_fn(vals):
-        return vals[cond_idx].reshape(()).astype(bool)
+    if max_iters is None:
+        def cond_fn(vals):
+            return vals[cond_idx].reshape(()).astype(bool)
 
-    out = lax.while_loop(cond_fn, lambda v: body(v), tuple(xs))
+        out = lax.while_loop(cond_fn, lambda v: body(v), tuple(xs))
+        return {"Out": list(out)}
+
+    def step(vals, _):
+        alive = vals[cond_idx].reshape(()).astype(bool)
+        new = body(vals)
+        merged = tuple(
+            jnp.where(alive, n.astype(v.dtype) if hasattr(n, "astype") else n, v)
+            for n, v in zip(new, vals))
+        return merged, None
+
+    out, _ = lax.scan(step, tuple(xs), None, length=int(max_iters))
     return {"Out": list(out)}
 
 
@@ -185,7 +206,7 @@ def _select(ctx, inputs, attrs):
 # preallocated [max_len, ...] buffer var plus an int64 length scalar,
 # updated via dynamic_update_slice — usable inside while loops.
 
-@register_op("array_write", differentiable=False)
+@register_op("array_write", nondiff_inputs=["I", "Length"])
 def _array_write(ctx, inputs, attrs):
     (arr,) = inputs["Array"]
     (i,) = inputs["I"]
@@ -196,7 +217,7 @@ def _array_write(ctx, inputs, attrs):
     return {"Out": [new], "LengthOut": [jnp.maximum(n, (idx + 1).astype(n.dtype))]}
 
 
-@register_op("array_read", differentiable=False)
+@register_op("array_read", nondiff_inputs=["I"])
 def _array_read(ctx, inputs, attrs):
     (arr,) = inputs["Array"]
     (i,) = inputs["I"]

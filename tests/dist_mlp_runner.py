@@ -1,0 +1,71 @@
+"""Two-process localhost-cluster trainer (reference test_dist_base.py:61
+TestDistRunnerBase.run_trainer analog).
+
+Launched by tests/test_dist_cluster.py via paddle_tpu.distributed.launch with
+PADDLE_TRAINER_* env wiring. Each process hosts 4 virtual CPU devices; the
+two processes form one 8-device dp mesh through jax.distributed. Prints one
+JSON line: {"rank": r, "losses": [...]}.
+
+Run with --local for the single-process reference (no jax.distributed).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_and_run(steps=4):
+    import paddle_tpu as fluid
+    from paddle_tpu.initializer import NumpyArrayInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        w = np.random.RandomState(5).rand(16, 4).astype("float32") * 0.1
+        logits = fluid.layers.fc(
+            x, 4, bias_attr=False,
+            param_attr=ParamAttr(name="w", initializer=NumpyArrayInitializer(w)))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    prog = fluid.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+
+    # every rank feeds the same local batch; with the batch duplicated
+    # across the two ranks the global mean loss/grads equal the
+    # single-process run on one copy — the test_dist_base loss-equality trick
+    rng = np.random.RandomState(0)
+    xv = rng.rand(32, 16).astype("float32")
+    yv = rng.randint(0, 4, (32, 1)).astype("int64")
+    return [float(exe.run(prog, feed={"x": xv, "y": yv},
+                          fetch_list=[loss])[0]) for _ in range(steps)]
+
+
+def main():
+    if "--local" in sys.argv:
+        print(json.dumps({"rank": -1, "losses": build_and_run()}), flush=True)
+        return
+    from paddle_tpu.parallel import env as penv
+
+    active = penv.init_parallel_env()
+    assert active, "init_parallel_env did not activate distributed mode"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+    losses = build_and_run()
+    print(json.dumps({"rank": penv.get_rank(), "losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -175,3 +175,18 @@ def test_gradients_multi_target_seeded():
         xv = np.array([[1.0, 2.0, 3.0]], dtype="float32")
         (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
     np.testing.assert_allclose(gv, 2 * xv + 30.0, rtol=1e-6)
+
+
+def test_gradients_wrt_intermediate_var():
+    """Grad w.r.t. an op OUTPUT (not a leaf) must survive the non-SSA
+    cotangent-consumption rule in the tape walk."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [3])
+        h = fluid.layers.scale(x, 2.0)
+        loss = fluid.layers.reduce_sum(h)
+        (gh,) = fluid.gradients([loss], [h])
+        exe = fluid.Executor(fluid.CPUPlace())
+        (gv,) = exe.run(main, feed={"x": np.ones((1, 3), "float32")},
+                        fetch_list=[gh])
+    np.testing.assert_allclose(gv, np.ones((1, 3)))
