@@ -208,8 +208,18 @@ class CompiledProgram:
             key = _make_key(program.random_seed or 0)
         if multiproc and not (isinstance(key, jax.Array)
                               and len(key.sharding.device_set) > 1):
-            key = jax.make_array_from_process_local_data(
-                NamedSharding(self._mesh, P()), np.asarray(key))
+            sh = NamedSharding(self._mesh, P())
+            if jax.dtypes.issubdtype(getattr(key, "dtype", None),
+                                     jax.dtypes.prng_key):
+                # typed keys (rbg on TPU) can't round-trip through numpy
+                impl = jax.random.key_impl(key)
+                data = np.asarray(jax.random.key_data(key))
+                key = jax.random.wrap_key_data(
+                    jax.make_array_from_process_local_data(sh, data),
+                    impl=impl)
+            else:
+                key = jax.make_array_from_process_local_data(
+                    sh, np.asarray(key))
 
         fetches, new_state, new_key = fn(state, feed_vals, key)
         for n, v in new_state.items():

@@ -20,6 +20,7 @@ from . import (  # noqa: F401
     metric_ops,
     nn_ops,
     optimizer_ops,
+    pipeline_ops,
     reduce_ops,
     rnn_ops,
     sequence_ops,

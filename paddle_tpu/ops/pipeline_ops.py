@@ -1,0 +1,108 @@
+"""Program-level pipeline-parallel op.
+
+Reference analog: ``python/paddle/fluid/optimizer.py:2677`` PipelineOptimizer
+(cuts a user program into sections) executed by PipelineTrainer/SectionWorker
+(section_worker.cc:141 — scopes flowing through CPU queues between devices).
+
+TPU-native redesign: the cut stages must be *isomorphic* (the transformer
+per-layer case); one template sub-block is kept and its parameters are
+stage-stacked, then the whole GPipe schedule (parallel/pipeline.py —
+lax.scan over ppermute ring) compiles into the one jitted step and is
+differentiable end-to-end, so the backward pipeline and the per-stage
+parameter gradients fall out of the vjp tape. Without a `pp` mesh axis the
+op degrades to a sequential loop over stages (same math, no pipelining).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("pipeline")
+def _pipeline(ctx, inputs, attrs):
+    from ..core.executor import ExecContext, _run_block
+
+    (x,) = inputs["X"]
+    flat_params = inputs["Params"]          # [stage-major][param]
+    n_stages = attrs["n_stages"]
+    n_params = attrs["n_params"]
+    m = attrs.get("num_microbatches", 1)
+    axis = attrs.get("axis", "pp")
+    data_axis = attrs.get("data_axis")
+    block = attrs["sub_block"]
+    in_name = attrs["in_name"]
+    out_name = attrs["out_name"]
+    param_names = attrs["param_names"]      # template (stage-0) param names
+    capture_names = attrs.get("capture_names", [])
+    captures = inputs.get("Captures", [])
+    b = x.shape[0]
+
+    # captures with a leading batch dim (attention masks etc.) must be
+    # microbatched and travel WITH the activation through the ring — at any
+    # tick each stage holds a DIFFERENT microbatch; batch-free captures
+    # (scalars, tables) are safely closed over. capture_spec overrides the
+    # shape heuristic for ambiguous cases (a [T,...] table with T == batch).
+    spec = attrs.get("capture_spec") or {}
+
+    def _is_batched(name, c):
+        if name in spec:
+            return spec[name] == "batched"
+        return getattr(c, "ndim", 0) >= 1 and c.shape[0] == b
+
+    batched = [i for i, c in enumerate(captures)
+               if _is_batched(capture_names[i], c)]
+    static = {capture_names[i]: captures[i]
+              for i in range(len(captures)) if i not in batched}
+    bc_names = [capture_names[i] for i in batched]
+
+    # one subkey per step from the threaded stream; stages fold in their
+    # stage index so dropout masks differ per stage AND advance per step.
+    # (Known limitation: within one step, a stage reuses its mask across
+    # microbatches — acceptable GPipe approximation.)
+    import jax as _jax
+    from jax import lax as _lax
+    base_key = ctx.rng() if not ctx.is_test else None
+
+    def stage_fn(params_list, payload, stage_key=None):
+        inp, *bcaps = payload
+        env = dict(zip(param_names, params_list))
+        env.update(static)
+        env.update(zip(bc_names, bcaps))
+        env[in_name] = inp
+        sub = ExecContext(stage_key, is_test=ctx.is_test, mesh=ctx.mesh)
+        _run_block(block, env, sub)
+        return (env[out_name], *bcaps)
+
+    mesh = ctx.mesh
+    if mesh is None or axis not in mesh.axis_names:
+        # no pp axis: sequential stages (identical math, no overlap)
+        payload = (x, *[captures[i] for i in batched])
+        for s in range(n_stages):
+            sk = (None if base_key is None
+                  else _jax.random.fold_in(base_key, s))
+            payload = stage_fn(
+                flat_params[s * n_params:(s + 1) * n_params], payload, sk)
+        return {"Out": [payload[0]]}
+
+    def staged_fn(params_list, payload):
+        sk = (None if base_key is None
+              else _jax.random.fold_in(base_key, _lax.axis_index(axis)))
+        return stage_fn(params_list, payload, sk)
+
+    from ..parallel.pipeline import pipeline_step
+
+    stacked = [jnp.stack([flat_params[s * n_params + j]
+                          for s in range(n_stages)])
+               for j in range(n_params)]
+    if b % m:
+        raise ValueError(f"pipeline: batch {b} not divisible by "
+                         f"num_microbatches {m}")
+
+    def micro(a):
+        return a.reshape((m, b // m) + a.shape[1:])
+
+    xs = (micro(x), *[micro(captures[i]) for i in batched])
+    out = pipeline_step(staged_fn, stacked, xs, mesh, axis,
+                        data_axis=data_axis)
+    return {"Out": [out.reshape(x.shape)]}

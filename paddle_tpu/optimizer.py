@@ -537,6 +537,165 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         super().__init__(learning_rate, momentum, **kw)
 
 
+class PipelineOptimizer:
+    """Program-level pipeline parallelism (reference optimizer.py:2677).
+
+    ``cut_list`` is the ordered chain of boundary variables
+    ``[stage0_input, boundary1, ..., final_output]`` — N stages for N+1
+    entries. The ops between consecutive boundaries must be isomorphic
+    (same op-type sequence with same-shaped parameters — the
+    transformer-by-layers case); ``minimize`` replaces them with ONE
+    `pipeline` op holding the stage-0 template sub-block plus every stage's
+    parameters, driven by the GPipe schedule in parallel/pipeline.py. The
+    reference's CPU scope-queues (section_worker.cc:141) don't exist under
+    XLA; the compiled schedule overlaps stages via a ppermute ring instead.
+
+    Usage::
+
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.Adam(1e-4), cut_list=[h0, h1, h2],
+            num_microbatches=4)
+        opt.minimize(loss)
+        prog = fluid.CompiledProgram(main).with_mesh(mesh, data_axis="dp")
+    """
+
+    def __init__(self, optimizer, cut_list, num_microbatches: int = 1,
+                 axis: str = "pp", data_axis=None, capture_spec=None,
+                 queue_size=None, place_list=None, concurrency_list=None,
+                 sync_steps=None, start_cpu_core_id=None):
+        # trailing args are reference-API compat (scope-queue knobs — moot).
+        # capture_spec: {var_name: "batched"|"shared"} override for captured
+        # prologue activations — by default a capture whose leading dim
+        # equals the batch size is microbatched along with the activations;
+        # use "shared" for e.g. a [T, T] table where T happens to equal B.
+        if len(cut_list) < 3:
+            raise ValueError("cut_list needs [input, boundary..., output] "
+                             "(>= 2 stages)")
+        self._opt = optimizer
+        self._cut = list(cut_list)
+        self._m = int(num_microbatches)
+        self._axis = axis
+        self._data_axis = data_axis
+        self._capture_spec = dict(capture_spec or {})
+
+    def _producer_idx(self, ops, name):
+        for i in range(len(ops) - 1, -1, -1):
+            if name in ops[i].output_names():
+                return i
+        return -1  # feed/data var: the pipelined region starts at op 0
+
+    def _transform(self, program):
+        from .core.program import Operator
+
+        block = program.global_block()
+        ops = block.ops
+        names = [v.name for v in self._cut]
+        bounds = [self._producer_idx(ops, n) for n in names]
+        if bounds != sorted(bounds):
+            raise ValueError("cut_list variables are not in program order")
+        n_stages = len(names) - 1
+
+        # per-stage op ranges: (producer(b_{k}) , producer(b_{k+1})]
+        stage_ranges = [(bounds[k] + 1, bounds[k + 1] + 1)
+                        for k in range(n_stages)]
+        stage_ops = [ops[a:b] for a, b in stage_ranges]
+
+        sig0 = [op.type for op in stage_ops[0]]
+        for k, sops in enumerate(stage_ops[1:], 1):
+            if [op.type for op in sops] != sig0:
+                raise ValueError(
+                    f"pipeline stages must be isomorphic: stage {k} op "
+                    f"sequence differs from stage 0 ({[o.type for o in sops]}"
+                    f" vs {sig0})")
+
+        def stage_params(sops):
+            seen, out = set(), []
+            for op in sops:
+                for n in op.input_names():
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable and n not in seen:
+                        seen.add(n)
+                        out.append(n)
+            return out
+
+        per_stage_params = [stage_params(s) for s in stage_ops]
+        n_params = len(per_stage_params[0])
+        for k, ps in enumerate(per_stage_params):
+            if len(ps) != n_params:
+                raise ValueError(
+                    f"stage {k} has {len(ps)} params, stage 0 has {n_params}")
+            for j, (a, b) in enumerate(zip(per_stage_params[0], ps)):
+                va, vb = block.var(a), block.var(b)
+                if tuple(va.shape or ()) != tuple(vb.shape or ()):
+                    raise ValueError(
+                        f"param {j} shape mismatch across stages: "
+                        f"{a}:{va.shape} vs {b}:{vb.shape}")
+
+        # captured external activations (e.g. a shared attention mask built
+        # in the prologue): read by stage ops, produced outside every stage
+        def stage_captures(sops, skip):
+            produced = set()
+            caps = []
+            for op in sops:
+                for n in op.input_names():
+                    v = block._find_var_recursive(n)
+                    if (n not in produced and n not in skip
+                            and not (v is not None and v.persistable)
+                            and n not in caps):
+                        caps.append(n)
+                produced.update(op.output_names())
+            return caps
+
+        captures = stage_captures(stage_ops[0],
+                                  set(per_stage_params[0]) | {names[0]})
+        for k, sops in enumerate(stage_ops[1:], 1):
+            got = stage_captures(sops, set(per_stage_params[k]) | {names[k]})
+            if got != captures:
+                raise ValueError(
+                    f"pipeline stages must share captured vars: stage {k} "
+                    f"captures {got}, stage 0 captures {captures}")
+
+        # template sub-block = stage 0's ops, re-homed
+        cur = program.current_block_idx
+        program.current_block_idx = block.idx
+        sub = program.create_block()
+        program.rollback()
+        program.current_block_idx = cur
+        for op in stage_ops[0]:
+            op.block = sub
+            sub.ops.append(op)
+
+        # splice: remove all stage op ranges, insert the pipeline op
+        lo, hi = stage_ranges[0][0], stage_ranges[-1][1]
+        flat_params = [p for ps in per_stage_params for p in ps]
+        pipe_op = Operator(
+            block, "pipeline",
+            inputs={"X": [names[0]], "Params": flat_params,
+                    "Captures": captures},
+            outputs={"Out": [names[-1]]},
+            attrs={"sub_block": sub, "n_stages": n_stages,
+                   "n_params": n_params, "num_microbatches": self._m,
+                   "axis": self._axis, "data_axis": self._data_axis,
+                   "in_name": names[0], "out_name": names[1],
+                   "param_names": per_stage_params[0],
+                   "capture_names": captures,
+                   "capture_spec": self._capture_spec})
+        block.ops[lo:hi] = [pipe_op]
+        program._bump_version()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        self._transform(loss.block.program)
+        return self._opt.minimize(loss, startup_program, parameter_list,
+                                  no_grad_set)
+
+    def backward(self, *a, **kw):
+        return self._opt.backward(*a, **kw)
+
+    def apply_gradients(self, *a, **kw):
+        return self._opt.apply_gradients(*a, **kw)
+
+
 class ModelAverage(Optimizer):
     """optimizer.py:2257 — maintain sliding-window parameter averages."""
 

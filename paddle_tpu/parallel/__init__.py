@@ -15,6 +15,8 @@ from .collective import (  # noqa: F401
     broadcast,
     reduce_scatter,
 )
+from .checkpoint import (  # noqa: F401
+    Checkpointer, load_checkpoint, save_checkpoint)
 from .env import get_rank, get_world_size, init_parallel_env  # noqa: F401
 from .mesh import DistributedStrategy, auto_mesh, make_mesh  # noqa: F401
 from .pipeline import GPipe, pipeline_step  # noqa: F401

@@ -1,0 +1,187 @@
+"""Async, reshardable training checkpoints.
+
+Reference analog: save/load ops streamed per var (save_op.cc, load_op.cc;
+io.py:487 save_persistables) plus the pserver checkpoint-notify hook
+(distributed_ops/checkpoint_notify_op.cc). The reference cannot restore
+under a different device topology (SURVEY §5 "no optimizer-state resharding
+on topology change"); this module can — the TPU-native bar.
+
+Design (orbax-style, self-contained):
+- `save` snapshots every persistable var to host (device→host copies are
+  started async, then a background thread finishes materialization and
+  writes the bundle) — the training loop resumes while the write is in
+  flight;
+- files are written to a temp name and renamed, and the `latest` marker is
+  updated only after the bundle is durable — a preemption mid-write never
+  corrupts the previous checkpoint;
+- bundles store plain host arrays, so `restore` works under ANY mesh: the
+  compiler lifts host values into whatever sharding the new topology
+  declares (CompiledProgram._run), which is what makes checkpoints
+  reshardable across dp/tp splits.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.program import Program, default_main_program
+from ..core.scope import Scope, _scope
+
+_RNG_STATE = "@rng_state@"
+
+
+def _snapshot(program: Program, scope: Scope) -> Dict[str, np.ndarray]:
+    import jax
+    import jax.numpy as jnp
+
+    names = [v.name for v in program.list_vars() if v.persistable]
+    out = {}
+    for n in names:
+        v = scope.find_var(n)
+        if v is None:
+            continue
+        if isinstance(v, jax.Array):
+            # device-side copy: the training loop's next step DONATES the
+            # live buffers, so the background writer must own its own copy;
+            # then start the d2h transfer without blocking
+            v = jnp.copy(v)
+            if hasattr(v, "copy_to_host_async"):
+                try:
+                    v.copy_to_host_async()
+                except Exception:
+                    pass
+        out[n] = v
+    return out
+
+
+class Checkpointer:
+    """`Checkpointer(dirname).save(step)` / `.restore()` over a Program's
+    persistables. One background writer thread; `wait()` joins it."""
+
+    def __init__(self, dirname: str, keep: int = 3):
+        self.dirname = dirname
+        self.keep = keep
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(dirname, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.dirname, f"ckpt-{step}.pkl")
+
+    def _write(self, step: int, vals: Dict[str, object]):
+        bundle = {n: np.asarray(v) for n, v in vals.items()}
+        path = self._path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"step": step, "vars": bundle}, f, protocol=4)
+        os.replace(tmp, path)  # atomic: never a half-written ckpt-N
+        marker = os.path.join(self.dirname, "latest")
+        with open(marker + ".tmp", "w") as f:
+            f.write(str(step))
+        os.replace(marker + ".tmp", marker)
+        self._gc(step)
+
+    def _gc(self, newest: int):
+        steps = sorted(self.all_steps())
+        for s in steps[:-self.keep] if self.keep else []:
+            if s != newest:
+                try:
+                    os.remove(self._path(s))
+                except OSError:
+                    pass
+
+    def all_steps(self):
+        out = []
+        for f in os.listdir(self.dirname):
+            if f.startswith("ckpt-") and f.endswith(".pkl"):
+                try:
+                    out.append(int(f[5:-4]))
+                except ValueError:
+                    pass
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        marker = os.path.join(self.dirname, "latest")
+        if os.path.exists(marker):
+            with open(marker) as f:
+                s = int(f.read().strip())
+            if os.path.exists(self._path(s)):
+                return s
+        steps = self.all_steps()
+        return max(steps) if steps else None
+
+    def save(self, step: int, program: Optional[Program] = None,
+             scope: Optional[Scope] = None, blocking: bool = False):
+        """Snapshot now, write in the background (orbax async-save shape)."""
+        program = program or default_main_program()
+        scope = scope or _scope()
+        self.wait()  # one write in flight at a time
+        vals = _snapshot(program, scope)
+        rng = scope.find_var(_RNG_STATE)
+        if rng is not None:
+            import jax
+            if jax.dtypes.issubdtype(getattr(rng, "dtype", None),
+                                     jax.dtypes.prng_key):
+                # typed keys can't cross numpy; store raw data + impl name
+                vals["@rng@"] = np.asarray(jax.random.key_data(rng))
+                vals["@rng_impl@"] = np.asarray(
+                    str(jax.random.key_impl(rng)))
+            else:
+                vals["@rng@"] = np.asarray(rng)
+        self._thread = threading.Thread(
+            target=self._write, args=(step, vals), daemon=True)
+        self._thread.start()
+        if blocking:
+            self.wait()
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def restore(self, step: Optional[int] = None,
+                program: Optional[Program] = None,
+                scope: Optional[Scope] = None) -> Optional[int]:
+        """Load step (default: latest durable) into the scope as host arrays;
+        the next compiled step lifts them into the current mesh's shardings —
+        save under dp=8, restore under dp=4×tp=2 just works."""
+        program = program or default_main_program()
+        scope = scope or _scope()
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        with open(self._path(step), "rb") as f:
+            payload = pickle.load(f)
+        names = {v.name for v in program.list_vars() if v.persistable}
+        for n, arr in payload["vars"].items():
+            if n in names:
+                scope.set_var(n, arr)
+        if "@rng@" in payload["vars"]:  # resume the random stream too
+            import jax
+            import jax.numpy as jnp
+            raw = payload["vars"]["@rng@"]
+            impl = payload["vars"].get("@rng_impl@")
+            if impl is not None:
+                key = jax.random.wrap_key_data(jnp.asarray(raw),
+                                               impl=str(impl))
+            else:
+                key = jnp.asarray(raw)
+            scope.set_var(_RNG_STATE, key)
+        return payload["step"]
+
+
+def save_checkpoint(dirname: str, step: int, program=None, scope=None,
+                    blocking: bool = True):
+    ck = Checkpointer(dirname)
+    ck.save(step, program=program, scope=scope, blocking=blocking)
+    return ck
+
+
+def load_checkpoint(dirname: str, program=None, scope=None,
+                    step: Optional[int] = None):
+    return Checkpointer(dirname).restore(step, program=program, scope=scope)

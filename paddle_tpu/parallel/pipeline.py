@@ -26,29 +26,36 @@ from .collective import shard_map
 
 def _pipe_local(params, xs, stage_fn, axis: str):
     """Per-device GPipe schedule. params: this stage's params (leading stage
-    dim already sliced to 1 by shard_map — squeezed here). xs: [M, mb, ...]
-    microbatches (replicated)."""
+    dim already sliced to 1 by shard_map — squeezed here). xs: a payload
+    PYTREE of [M, mb, ...] microbatch arrays; the first leaf is the pipeline
+    value, the rest (per-microbatch side inputs like attention masks) travel
+    with it through the ring."""
+    tmap = jax.tree_util.tree_map
     n = lax.psum(1, axis)
     idx = lax.axis_index(axis)
-    params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), params)
-    m = xs.shape[0]
+    params = tmap(lambda p: jnp.squeeze(p, 0), params)
+    m = jax.tree_util.tree_leaves(xs)[0].shape[0]
 
     def step(carry, t):
         buf_in, outbuf = carry
-        x_t = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, m - 1), 0, keepdims=False)
-        inp = jnp.where(idx == 0, x_t, buf_in)
+        tc = jnp.clip(t, 0, m - 1)
+        x_t = tmap(lambda a: lax.dynamic_index_in_dim(a, tc, 0, keepdims=False), xs)
+        inp = tmap(lambda a, b: jnp.where(idx == 0, a, b), x_t, buf_in)
         out = stage_fn(params, inp)
         pos = t - (n - 1)
         write = jnp.logical_and(idx == n - 1, pos >= 0)
-        upd = lax.dynamic_update_index_in_dim(outbuf, out, jnp.clip(pos, 0, m - 1), 0)
+        out_x = jax.tree_util.tree_leaves(out)[0]
+        upd = lax.dynamic_update_index_in_dim(outbuf, out_x, jnp.clip(pos, 0, m - 1), 0)
         outbuf = jnp.where(write, upd, outbuf)
         perm = [(i, (i + 1) % n) for i in range(n)]
-        nxt = lax.ppermute(out, axis, perm)
+        nxt = tmap(lambda a: lax.ppermute(a, axis, perm), out)
         return (nxt, outbuf), None
 
-    out_shape = jax.eval_shape(stage_fn, params, xs[0])
-    init = (jnp.zeros(out_shape.shape, out_shape.dtype),
-            jnp.zeros((m,) + out_shape.shape, out_shape.dtype))
+    x0 = tmap(lambda a: a[0], xs)
+    out_shape = jax.eval_shape(stage_fn, params, x0)
+    first = jax.tree_util.tree_leaves(out_shape)[0]
+    init = (tmap(lambda s: jnp.zeros(s.shape, s.dtype), out_shape),
+            jnp.zeros((m,) + first.shape, first.dtype))
     (_, outbuf), _ = lax.scan(step, init, jnp.arange(m + n - 1))
     # only the last stage holds real outputs; replicate via masked psum
     outbuf = lax.psum(jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf)), axis)
@@ -56,16 +63,26 @@ def _pipe_local(params, xs, stage_fn, axis: str):
 
 
 def pipeline_step(stage_fn: Callable, stacked_params, xs, mesh: Mesh,
-                  axis: str = "pp"):
+                  axis: str = "pp", data_axis: str = None):
     """Run microbatches [M, mb, ...] through n_stages = mesh.shape[axis]
     identical-signature stages. stacked_params: pytree with leading stage dim
     == n_stages. Returns outputs [M, mb, ...].
 
+    `data_axis` (optional): a mesh axis the per-microbatch batch dim is
+    sharded over — pp×dp composition; each dp shard runs its own pipeline.
+
     Constraint (GPipe over a ring): every stage's output shape must equal its
     input shape (standard for transformer blocks)."""
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    if data_axis is not None and data_axis not in mesh.axis_names:
+        raise ValueError(
+            f"pipeline_step: data_axis {data_axis!r} is not a mesh axis "
+            f"{mesh.axis_names} — a typo here would silently all-gather the "
+            f"batch and lose data parallelism")
+    one_spec = P(None, data_axis) if data_axis is not None else P()
+    xspec = jax.tree_util.tree_map(lambda _: one_spec, xs)
     fn = shard_map(partial(_pipe_local, stage_fn=stage_fn, axis=axis),
-                   mesh, in_specs=(pspec, P()), out_specs=P())
+                   mesh, in_specs=(pspec, xspec), out_specs=one_spec)
     return fn(stacked_params, xs)
 
 
