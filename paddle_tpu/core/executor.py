@@ -154,6 +154,53 @@ def _run_op(op, env: Dict[str, object], ctx: ExecContext):
         diff = diff(op.attrs)
     differentiable = diff and not ctx.is_test
 
+    custom_grad = None
+    if differentiable and flat_in_names and opdef.grad_fn is not None:
+        custom_grad = opdef.grad_fn(op.attrs)
+
+    if custom_grad is not None:
+        # hand-written gradient (GradOpMaker analog): used where the
+        # cotangent is not a dense array — e.g. SelectedRows embedding rows
+        ins_c = _amp_cast({s: list(v) for s, v in in_vals.items()},
+                          op.type, ctx.amp)
+        out = opdef.fn(ctx, ins_c, op.attrs)
+        out_names, flat_out_vals = [], []
+        for slot in sorted(op.outputs):
+            vals = out.get(slot, [])
+            names = op.outputs[slot]
+            if len(names) != len(vals):
+                raise RuntimeError(
+                    f"op {op.type}: slot {slot} returned {len(vals)} values, "
+                    f"declared {len(names)}")
+            for n, v in zip(names, vals):
+                env[n] = v
+                out_names.append(n)
+                flat_out_vals.append(v)
+
+        out_slots = sorted(op.outputs)
+        out_counts = [len(op.outputs[s]) for s in out_slots]
+        in_slots = sorted(op.inputs)
+
+        def vjp_fn(out_cots, _ins=ins_c, _out=out, _op=op, _ctx=ctx):
+            by_slot, i = {}, 0
+            for s, c in zip(out_slots, out_counts):
+                by_slot[s] = list(out_cots[i:i + c])
+                i += c
+            in_cots = custom_grad(_ctx, _ins, _op.attrs, _out, by_slot)
+            flat = []
+            for s in in_slots:
+                got = in_cots.get(s)
+                flat.extend(got if got is not None
+                            else [None] * len(_op.inputs[s]))
+            return tuple(flat)
+
+        nondiff_in = set()
+        for slot in opdef.nondiff_inputs:
+            nondiff_in.update(op.inputs.get(slot, []))
+        ctx.tape.append(TapeEntry(flat_in_names, out_names, vjp_fn,
+                                  flat_out_vals, nondiff_in))
+        return
+
     if differentiable and flat_in_names:
         in_slots = sorted(op.inputs)
         in_counts = [len(op.inputs[s]) for s in in_slots]

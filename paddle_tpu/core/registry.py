@@ -34,25 +34,34 @@ OpImpl = Callable[..., Dict[str, List[Any]]]
 
 
 class OpDef:
-    __slots__ = ("type", "fn", "differentiable", "nondiff_inputs", "mutable_persistables")
+    __slots__ = ("type", "fn", "differentiable", "nondiff_inputs",
+                 "mutable_persistables", "grad_fn")
 
     def __init__(self, type: str, fn: OpImpl, differentiable: bool = True,
-                 nondiff_inputs: Optional[List[str]] = None):
+                 nondiff_inputs: Optional[List[str]] = None, grad_fn=None):
         self.type = type
         self.fn = fn
         self.differentiable = differentiable
         # input slots that never receive gradients (e.g. integer indices)
         self.nondiff_inputs = set(nondiff_inputs or [])
+        # hand-written gradient (GradOpMaker analog) for ops whose cotangent
+        # is not a dense array — e.g. lookup_table's SelectedRows rows.
+        # Signature: grad_fn(ctx, inputs, attrs, outputs, out_cots) ->
+        # {slot: [cotangent or None, ...]}. May return None to fall back to
+        # jax.vjp for this invocation (attr-dependent sparsity).
+        self.grad_fn = grad_fn
 
 
 _REGISTRY: Dict[str, OpDef] = {}
 
 
-def register_op(type: str, differentiable: bool = True, nondiff_inputs=None):
+def register_op(type: str, differentiable: bool = True, nondiff_inputs=None,
+                grad_fn=None):
     def deco(fn: OpImpl):
         if type in _REGISTRY:
             raise ValueError(f"op {type!r} registered twice")
-        _REGISTRY[type] = OpDef(type, fn, differentiable, nondiff_inputs)
+        _REGISTRY[type] = OpDef(type, fn, differentiable, nondiff_inputs,
+                                grad_fn)
         return fn
 
     return deco

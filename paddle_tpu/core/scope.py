@@ -60,7 +60,10 @@ _current_scope = _global_scope
 
 
 def global_scope() -> Scope:
-    return _global_scope
+    """Reference parity (executor.py g_scope + _switch_scope): scope_guard
+    REDIRECTS what global_scope() returns, so user code inside a guard reads
+    the guarded scope's variables."""
+    return _current_scope
 
 
 def _scope() -> Scope:

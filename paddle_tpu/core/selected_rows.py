@@ -1,0 +1,87 @@
+"""SelectedRows — sparse row-gradient carrier.
+
+Reference analog: ``paddle/fluid/framework/selected_rows.h`` + the
+lookup_table sparse-grad path (lookup_table_op.cc LookupTableGradKernel with
+is_sparse=True) and math/selected_rows_functor.cc (merge/add).
+
+TPU-native redesign: a (ids, rows) pair with STATIC shapes — N = number of
+lookups, duplicates allowed (XLA scatter-add accumulates them); it flows
+through the vjp tape as a regular pytree value so a [vocab, dim] dense
+gradient is never materialized. Optimizer kernels (sgd/adam) consume it
+row-wise; anything else can call ``to_dense()`` explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: [N, D] gradient rows; ids: [N] int32 row indices into a
+    [height, D] table. Duplicate ids are allowed and mean "add"."""
+
+    def __init__(self, ids, rows, height: int):
+        self.ids = ids
+        self.rows = rows
+        self.height = int(height)
+
+    # -- pytree ------------------------------------------------------------
+    def tree_flatten(self):
+        return (self.ids, self.rows), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        ids, rows = children
+        return cls(ids, rows, height)
+
+    # -- semantics ---------------------------------------------------------
+    @property
+    def dtype(self):
+        return self.rows.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.rows.shape[1:])
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.rows.dtype)
+        return dense.at[self.ids].add(self.rows)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.ids, other.ids]),
+                jnp.concatenate([self.rows, other.rows]), self.height)
+        # dense + sparse → dense
+        return other.at[self.ids].add(self.rows.astype(other.dtype))
+
+    __radd__ = __add__
+
+    def merged(self):
+        """(ids, rows_bcast) where every duplicate position carries the FULL
+        per-id sum — so a scatter-`set` of values computed from rows_bcast is
+        deterministic under duplicates. Static shapes (sort + run scans)."""
+        n = self.ids.shape[0]
+        order = jnp.argsort(self.ids)
+        sids = self.ids[order]
+        srows = self.rows[order]
+        csum = jnp.cumsum(srows, axis=0)
+        pos = jnp.arange(n)
+        first = jnp.concatenate([jnp.ones((1,), bool), sids[1:] != sids[:-1]])
+        last = jnp.concatenate([sids[1:] != sids[:-1], jnp.ones((1,), bool)])
+        # run_start[i] / run_end[i] via prefix/suffix max-scans
+        start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(first, pos, 0))
+        end = jnp.flip(jax.lax.associative_scan(
+            jnp.minimum, jnp.flip(jnp.where(last, pos, n - 1))))
+        prev = csum[jnp.maximum(start - 1, 0)]
+        total = csum[end] - jnp.where((start > 0)[:, None], prev,
+                                      jnp.zeros_like(prev))
+        return sids, total
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={getattr(self.rows, 'shape', None)})")

@@ -15,11 +15,17 @@ from paddle_tpu.param_attr import ParamAttr
 
 def deepfm(sparse_ids, dense_feats, vocab_size: int, num_fields: int,
            embed_dim: int = 16, hidden_sizes=(400, 400, 400),
-           shard_axis=None):
-    """sparse_ids: [B, num_fields] int64; dense_feats: [B, num_dense]."""
+           shard_axis=None, is_sparse: bool = False):
+    """sparse_ids: [B, num_fields] int64; dense_feats: [B, num_dense].
+
+    is_sparse=True (opt-in) routes the table gradients through SelectedRows
+    rows (lookup_table_op.cc sparse path) — O(batch·dim) gradient work
+    instead of a dense [vocab, dim] scatter per step. Opt-in because only
+    sgd/adam have SelectedRows kernels (grad clipping and other optimizers
+    need dense grads), matching the reference's constraint."""
     spec = (shard_axis, None) if shard_axis else None
     # first-order weights
-    w1 = layers.embedding(sparse_ids, [vocab_size, 1],
+    w1 = layers.embedding(sparse_ids, [vocab_size, 1], is_sparse=is_sparse,
                           param_attr=ParamAttr(name="fm_w1",
                                                initializer=UniformInitializer(-1e-4, 1e-4),
                                                shard_spec=spec))
@@ -27,6 +33,7 @@ def deepfm(sparse_ids, dense_feats, vocab_size: int, num_fields: int,
 
     # second-order: embeddings [B, F, D]
     emb = layers.embedding(sparse_ids, [vocab_size, embed_dim],
+                           is_sparse=is_sparse,
                            param_attr=ParamAttr(name="fm_emb",
                                                 initializer=UniformInitializer(-1e-2, 1e-2),
                                                 shard_spec=spec))
@@ -50,7 +57,8 @@ def deepfm(sparse_ids, dense_feats, vocab_size: int, num_fields: int,
 
 
 def build_train_program(vocab_size=100000, num_fields=26, num_dense=13,
-                        embed_dim=16, lr=1e-3, shard_axis=None):
+                        embed_dim=16, lr=1e-3, shard_axis=None,
+                        is_sparse=False):
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
@@ -58,7 +66,7 @@ def build_train_program(vocab_size=100000, num_fields=26, num_dense=13,
         dense = layers.data("dense", [num_dense])
         label = layers.data("label", [1])
         logit = deepfm(ids, dense, vocab_size, num_fields, embed_dim,
-                       shard_axis=shard_axis)
+                       shard_axis=shard_axis, is_sparse=is_sparse)
         loss = layers.mean(
             layers.sigmoid_cross_entropy_with_logits(logit, label))
         prob = layers.sigmoid(logit)

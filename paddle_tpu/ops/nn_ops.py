@@ -302,11 +302,38 @@ def _dropout(ctx, inputs, attrs):
     return {"Out": [y], "Mask": [lax.stop_gradient(mask)]}
 
 
-@register_op("lookup_table", nondiff_inputs=["Ids"])
+def _lookup_sparse_grad(attrs):
+    """lookup_table_op.cc is_sparse=True GradOpMaker analog: the table's
+    cotangent is SelectedRows (ids, dOut rows) — a [vocab, dim] dense
+    gradient is never materialized (SURVEY §7 DeepFM-scale hard part)."""
+    if not attrs.get("is_sparse"):
+        return None  # dense path: generic jax.vjp scatter-add
+
+    def grad(ctx, inputs, attrs2, outputs, out_cots):
+        from ..core.selected_rows import SelectedRows
+
+        (w,) = inputs["W"]
+        (ids,) = inputs["Ids"]
+        (g,) = out_cots["Out"]
+        squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+        idx = ids[..., 0] if squeeze_last else ids
+        flat_ids = idx.reshape(-1).astype(jnp.int32)
+        rows = g.reshape(-1, g.shape[-1]).astype(w.dtype)
+        padding_idx = attrs2.get("padding_idx", -1)
+        if padding_idx is not None and padding_idx >= 0:
+            rows = jnp.where((flat_ids == padding_idx)[:, None], 0.0, rows)
+        return {"W": [SelectedRows(flat_ids, rows, w.shape[0])],
+                "Ids": [None]}
+
+    return grad
+
+
+@register_op("lookup_table", nondiff_inputs=["Ids"],
+             grad_fn=_lookup_sparse_grad)
 def _lookup_table(ctx, inputs, attrs):
     """lookup_table_op.cc: W[ids]; padding_idx rows produce zeros. Grad is an
-    XLA scatter-add (dense) — the SelectedRows sparse path is unnecessary on
-    TPU where the embedding table is HBM-resident and shardable."""
+    XLA scatter-add (dense) by default; with is_sparse=True the grad is a
+    SelectedRows rows bundle consumed row-wise by sgd/adam."""
     (w,) = inputs["W"]
     (ids,) = inputs["Ids"]
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
@@ -318,7 +345,8 @@ def _lookup_table(ctx, inputs, attrs):
     return one(out)
 
 
-@register_op("lookup_table_v2", nondiff_inputs=["Ids"])
+@register_op("lookup_table_v2", nondiff_inputs=["Ids"],
+             grad_fn=_lookup_sparse_grad)
 def _lookup_table_v2(ctx, inputs, attrs):
     return _lookup_table_impl(ctx, inputs, attrs)
 

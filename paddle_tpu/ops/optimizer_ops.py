@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
 
 
 def _lr(inputs):
@@ -26,6 +27,11 @@ def _lr(inputs):
 def _sgd(ctx, inputs, attrs):
     (p,) = inputs["Param"]
     (g,) = inputs["Grad"]
+    if isinstance(g, SelectedRows):
+        # sgd_op.cc SelectedRows kernel: touched rows only (duplicates
+        # accumulate in the scatter-add)
+        return {"ParamOut": [p.at[g.ids].add(
+            (-_lr(inputs)) * g.rows.astype(p.dtype))]}
     return {"ParamOut": [p - _lr(inputs) * g.astype(p.dtype)]}
 
 
@@ -77,10 +83,27 @@ def _adam(ctx, inputs, attrs):
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(inputs)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    if isinstance(g, SelectedRows):
+        # adam_op.cc SelectedRows kernel (lazy mode): only touched rows
+        # advance; duplicates are merged first (adam is nonlinear in g, so
+        # scatter-add of per-occurrence updates would be wrong). merged()
+        # broadcasts each id's total to every duplicate position, making the
+        # scatter-`set`s deterministic.
+        ids, rows = g.merged()
+        rows = rows.astype(p.dtype)
+        m_r = b1 * m[ids] + (1 - b1) * rows
+        v_r = b2 * v[ids] + (1 - b2) * rows * rows
+        p_r = p[ids] - lr_t * m_r / (jnp.sqrt(v_r) + eps)
+        return {
+            "ParamOut": [p.at[ids].set(p_r)],
+            "Moment1Out": [m.at[ids].set(m_r)],
+            "Moment2Out": [v.at[ids].set(v_r)],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2],
+        }
     g = g.astype(p.dtype)
     m_out = b1 * m + (1 - b1) * g
     v_out = b2 * v + (1 - b2) * g * g
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
     return {
         "ParamOut": [p_out], "Moment1Out": [m_out], "Moment2Out": [v_out],

@@ -70,7 +70,8 @@ def _pipeline(ctx, inputs, attrs):
         env.update(static)
         env.update(zip(bc_names, bcaps))
         env[in_name] = inp
-        sub = ExecContext(stage_key, is_test=ctx.is_test, mesh=ctx.mesh)
+        sub = ExecContext(stage_key, is_test=ctx.is_test, mesh=ctx.mesh,
+                          amp=ctx.amp)
         _run_block(block, env, sub)
         return (env[out_name], *bcaps)
 

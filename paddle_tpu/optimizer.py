@@ -607,6 +607,20 @@ class PipelineOptimizer:
                     f"pipeline stages must be isomorphic: stage {k} op "
                     f"sequence differs from stage 0 ({[o.type for o in sops]}"
                     f" vs {sig0})")
+            # attrs must match too — every stage executes with the stage-0
+            # template's attrs, so a per-stage dropout_prob/scale difference
+            # would be silently lost
+            for j, (o0, ok) in enumerate(zip(stage_ops[0], sops)):
+                a0 = {k2: v for k2, v in o0.attrs.items()}
+                ak = {k2: v for k2, v in ok.attrs.items()}
+                if a0.keys() != ak.keys() or any(
+                        not np.array_equal(a0[k2], ak[k2])
+                        if isinstance(a0[k2], np.ndarray)
+                        else a0[k2] != ak[k2] for k2 in a0):
+                    raise ValueError(
+                        f"pipeline stages must be isomorphic: op {j} "
+                        f"({o0.type}) attrs differ between stage 0 and "
+                        f"stage {k} — per-stage attrs cannot be pipelined")
 
         def stage_params(sops):
             seen, out = set(), []

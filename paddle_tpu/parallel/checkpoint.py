@@ -28,10 +28,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..core.executor import _RNG_STATE
 from ..core.program import Program, default_main_program
 from ..core.scope import Scope, _scope
-
-_RNG_STATE = "@rng_state@"
 
 
 def _snapshot(program: Program, scope: Scope) -> Dict[str, np.ndarray]:
@@ -66,12 +65,19 @@ class Checkpointer:
         self.dirname = dirname
         self.keep = keep
         self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
         os.makedirs(dirname, exist_ok=True)
 
     def _path(self, step: int) -> str:
         return os.path.join(self.dirname, f"ckpt-{step}.pkl")
 
     def _write(self, step: int, vals: Dict[str, object]):
+        try:
+            self._write_impl(step, vals)
+        except BaseException as e:  # surfaced by the next wait()/save()
+            self._error = e
+
+    def _write_impl(self, step: int, vals: Dict[str, object]):
         bundle = {n: np.asarray(v) for n, v in vals.items()}
         path = self._path(step)
         tmp = path + ".tmp"
@@ -138,9 +144,14 @@ class Checkpointer:
             self.wait()
 
     def wait(self):
+        """Join the in-flight write; re-raises a writer failure (a silently
+        lost checkpoint must not look durable)."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("checkpoint write failed") from err
 
     def restore(self, step: Optional[int] = None,
                 program: Optional[Program] = None,

@@ -114,3 +114,43 @@ def test_functional_roundtrip(tmp_path):
         assert step == 1
         np.testing.assert_array_equal(
             np.asarray(fluid.global_scope().find_var("w0")), w_saved)
+
+
+def test_checkpoint_resumes_rng_stream(tmp_path):
+    """Dropout sequences after restore continue the saved random stream
+    (same as the uninterrupted run) rather than restarting from the seed."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            main.random_seed = startup.random_seed = 5
+            x = fluid.layers.data("x", [8])
+            h = fluid.layers.dropout(
+                x, 0.5, dropout_implementation="upscale_in_train")
+            loss = fluid.layers.reduce_mean(h)
+        return main, startup, loss
+
+    feed = {"x": np.ones((4, 8), "float32")}
+
+    main, startup, loss = build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        ref = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+               for _ in range(6)]
+
+    ck = Checkpointer(str(tmp_path / "rng"))
+    main, startup, loss = build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        got_a = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                 for _ in range(3)]
+        ck.save(3, program=main, blocking=True)
+    main2, startup2, loss2 = build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup2)
+        ck.restore(program=main2)
+        got_b = [float(exe.run(main2, feed=feed, fetch_list=[loss2])[0])
+                 for _ in range(3)]
+    np.testing.assert_allclose(got_a + got_b, ref, rtol=1e-6)
