@@ -43,6 +43,7 @@ from .rnn import (  # noqa: F401
     lstm,
     lstm_unit,
 )
+from . import distributions  # noqa: F401
 from . import learning_rate_scheduler  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,

@@ -1,0 +1,207 @@
+"""Probability distributions over static-graph Variables.
+
+Reference analog: ``python/paddle/fluid/layers/distributions.py`` —
+Distribution:28, Uniform:113, Normal:246, Categorical:401,
+MultivariateNormalDiag:494. Same API (sample/entropy/log_prob/
+kl_divergence) built from the layers DSL so every method emits graph ops.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.program import Variable
+from . import nn as nn_layers
+from . import ops as ops_layers
+from . import reduce as reduce_layers
+from . import tensor as tensor_layers
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _to_var(v):
+    if isinstance(v, Variable):
+        return v
+    arr = np.asarray(v, np.float32)
+    return tensor_layers.assign(arr)
+
+
+def _random(op_type, shape, attrs):
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference("float32",
+                                                    shape=list(shape))
+    helper.append_op(type=op_type, inputs={},
+                     outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), **attrs})
+    return out
+
+
+class Distribution:
+    """Abstract base (reference distributions.py:28)."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference :113)."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = _random("uniform_random", shape,
+                    {"min": 0.0, "max": 1.0, "seed": seed})
+        span = ops_layers.elementwise_sub(self.high, self.low)
+        return ops_layers.elementwise_add(
+            ops_layers.elementwise_mul(u, span), self.low)
+
+    def log_prob(self, value):
+        span = ops_layers.elementwise_sub(self.high, self.low)
+        lb = ops_layers.cast(ops_layers.less_than(self.low, value), "float32")
+        ub = ops_layers.cast(ops_layers.less_than(value, self.high), "float32")
+        inside = ops_layers.elementwise_mul(lb, ub)
+        return ops_layers.elementwise_sub(
+            ops_layers.log(inside), ops_layers.log(span))
+
+    def entropy(self):
+        return ops_layers.log(ops_layers.elementwise_sub(self.high, self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference :246)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = _random("gaussian_random", shape,
+                    {"mean": 0.0, "std": 1.0, "seed": seed})
+        return ops_layers.elementwise_add(
+            ops_layers.elementwise_mul(z, self.scale), self.loc)
+
+    def entropy(self):
+        c = 0.5 + 0.5 * math.log(2.0 * math.pi)
+        return ops_layers.elementwise_add(
+            ops_layers.log(self.scale),
+            tensor_layers.fill_constant([1], "float32", c))
+
+    def log_prob(self, value):
+        var = ops_layers.elementwise_mul(self.scale, self.scale)
+        d = ops_layers.elementwise_sub(value, self.loc)
+        sq = ops_layers.elementwise_mul(d, d)
+        log_scale = ops_layers.log(self.scale)
+        t = ops_layers.elementwise_div(
+            sq, ops_layers.scale(var, scale=2.0))
+        c = 0.5 * math.log(2.0 * math.pi)
+        return ops_layers.scale(
+            ops_layers.elementwise_add(
+                ops_layers.elementwise_add(
+                    t, log_scale),
+                tensor_layers.fill_constant([1], "float32", c)),
+            scale=-1.0)
+
+    def kl_divergence(self, other: "Normal"):
+        # KL(p||q) = log σq/σp + (σp² + (μp−μq)²)/(2σq²) − 1/2
+        var_p = ops_layers.elementwise_mul(self.scale, self.scale)
+        var_q = ops_layers.elementwise_mul(other.scale, other.scale)
+        d = ops_layers.elementwise_sub(self.loc, other.loc)
+        num = ops_layers.elementwise_add(
+            var_p, ops_layers.elementwise_mul(d, d))
+        t1 = ops_layers.elementwise_sub(
+            ops_layers.log(other.scale), ops_layers.log(self.scale))
+        t2 = ops_layers.elementwise_div(
+            num, ops_layers.scale(var_q, scale=2.0))
+        return ops_layers.elementwise_add(
+            ops_layers.elementwise_sub(
+                t2, tensor_layers.fill_constant([1], "float32", 0.5)), t1)
+
+
+class Categorical(Distribution):
+    """Categorical(logits) (reference :401 — entropy/kl only there; sample
+    added here via sampling_id)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _probs(self):
+        return nn_layers.softmax(self.logits)
+
+    def sample(self, shape=None, seed=0):
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper("sampling_id")
+        out = helper.create_variable_for_type_inference("int64")
+        helper.append_op(type="sampling_id",
+                         inputs={"X": [self._probs().name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"seed": seed})
+        return out
+
+    def entropy(self):
+        p = self._probs()
+        logp = ops_layers.log(
+            ops_layers.elementwise_add(
+                p, tensor_layers.fill_constant([1], "float32", 1e-12)))
+        return ops_layers.scale(
+            reduce_layers.reduce_sum(
+                ops_layers.elementwise_mul(p, logp), dim=-1), scale=-1.0)
+
+    def kl_divergence(self, other: "Categorical"):
+        p = self._probs()
+        eps = tensor_layers.fill_constant([1], "float32", 1e-12)
+        logp = ops_layers.log(ops_layers.elementwise_add(p, eps))
+        logq = ops_layers.log(
+            ops_layers.elementwise_add(other._probs(), eps))
+        return reduce_layers.reduce_sum(
+            ops_layers.elementwise_mul(
+                p, ops_layers.elementwise_sub(logp, logq)), dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (reference :494 — entropy and
+    kl for diagonal Σ given as a [D, D] matrix)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)      # [D]
+        self.scale = _to_var(scale)  # [D, D] diagonal
+
+    def _diag(self):
+        # extract the diagonal via elementwise mask (no dedicated op needed)
+        d = self.scale.shape[-1]
+        eye = tensor_layers.assign(np.eye(d, dtype=np.float32))
+        return reduce_layers.reduce_sum(
+            ops_layers.elementwise_mul(self.scale, eye), dim=-1)
+
+    def entropy(self):
+        d = self.scale.shape[-1]
+        c = 0.5 * d * (1.0 + math.log(2.0 * math.pi))
+        logdet = reduce_layers.reduce_sum(ops_layers.log(self._diag()))
+        return ops_layers.elementwise_add(
+            ops_layers.scale(logdet, scale=0.5),
+            tensor_layers.fill_constant([1], "float32", c))
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        sp, sq = self._diag(), other._diag()
+        var_ratio = ops_layers.elementwise_div(sp, sq)
+        var_ratio = ops_layers.elementwise_mul(var_ratio, var_ratio)
+        d = ops_layers.elementwise_sub(self.loc, other.loc)
+        t = ops_layers.elementwise_div(ops_layers.elementwise_mul(d, d),
+                                       ops_layers.elementwise_mul(sq, sq))
+        inner = ops_layers.elementwise_sub(
+            ops_layers.elementwise_add(var_ratio, t),
+            tensor_layers.fill_constant([1], "float32", 1.0))
+        inner = ops_layers.elementwise_sub(inner, ops_layers.log(var_ratio))
+        return ops_layers.scale(reduce_layers.reduce_sum(inner), scale=0.5)

@@ -487,3 +487,100 @@ def flash_attention(q: Variable, k: Variable, v: Variable,
     helper.append_op(type="flash_attention", inputs=inputs,
                      outputs={"Out": [out.name]}, attrs=attrs)
     return out
+
+
+def nce(input: Variable, label: Variable, num_total_classes: int,
+        sample_weight=None, param_attr=None, bias_attr=None,
+        num_neg_samples: int = 10, name=None, sampler: str = "uniform",
+        custom_dist=None, seed: int = 0, is_sparse: bool = False) -> Variable:
+    """Noise-contrastive estimation loss (reference layers/nn.py nce →
+    nce_op.cc). Uniform negative sampler; returns per-row cost [B, 1]."""
+    if sampler != "uniform":
+        raise NotImplementedError(
+            f"nce: only the uniform sampler is implemented (got "
+            f"{sampler!r}); log_uniform/custom_dist change the NCE noise "
+            f"correction and must not be silently substituted")
+    if custom_dist is not None or sample_weight is not None:
+        raise NotImplementedError(
+            "nce: custom_dist / sample_weight are not supported")
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    inputs = {"Input": [input.name], "Weight": [w.name],
+              "Label": [label.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_total_classes],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b.name]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    sample_labels = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost.name], "SampleLogits": [sample_logits.name],
+                 "SampleLabels": [sample_labels.name]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples, "seed": seed,
+               "sampler": 0 if sampler == "uniform" else 1,
+               "is_sparse": is_sparse})
+    return cost
+
+
+def hsigmoid(input: Variable, label: Variable, num_classes: int,
+             param_attr=None, bias_attr=None, name=None,
+             path_table=None, path_code=None, is_custom: bool = False,
+             is_sparse: bool = False) -> Variable:
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference layers/nn.py hsigmoid → hierarchical_sigmoid_op.cc)."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid: custom trees (is_custom/path_table/path_code) are "
+            "not implemented — only the default complete binary tree")
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    inputs = {"X": [input.name], "W": [w.name], "Label": [label.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_classes - 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b.name]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": [out.name], "PreOut": [pre.name]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits: Variable, label: Variable,
+                                       num_samples: int,
+                                       num_true: int = 1,
+                                       remove_accidental_hits: bool = True,
+                                       use_customized_samples: bool = False,
+                                       seed: int = 0, name=None) -> Variable:
+    """Sampled softmax CE (reference layers/nn.py
+    sampled_softmax_with_cross_entropy → sample_logits_op.cc + softmax CE
+    over [true + sampled] classes)."""
+    helper = LayerHelper("sampled_softmax_with_cross_entropy", name=name)
+    sampled_logits = helper.create_variable_for_type_inference(logits.dtype)
+    sampled_label = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    samples = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    probs = helper.create_variable_for_type_inference(
+        logits.dtype, stop_gradient=True)
+    helper.append_op(
+        type="sample_logits",
+        inputs={"Logits": [logits.name], "Labels": [label.name]},
+        outputs={"SampledLogits": [sampled_logits.name],
+                 "SampledLabels": [sampled_label.name],
+                 "Samples": [samples.name],
+                 "Probabilities": [probs.name]},
+        attrs={"num_samples": num_samples, "seed": seed,
+               "remove_accidental_hits": remove_accidental_hits})
+    return softmax_with_cross_entropy(sampled_logits, sampled_label)

@@ -18,11 +18,13 @@ from . import (  # noqa: F401
     fused_ops,
     math_ops,
     metric_ops,
+    misc_ops,
     nn_ops,
     optimizer_ops,
     pipeline_ops,
     reduce_ops,
     rnn_ops,
+    sampled_ops,
     sequence_ops,
     tensor_ops,
 )

@@ -152,3 +152,423 @@ def _yolo_box(ctx, inputs, attrs):
     scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
     mask = (conf.reshape(n, -1, 1) > conf_thresh).astype(x.dtype)
     return {"Boxes": [boxes * mask], "Scores": [scores * mask]}
+
+
+# ---------------------------------------------------------------------------
+# SSD / RCNN detection family (static-shape, padded-output redesigns of
+# operators/detection/: multiclass_nms_op.cc, anchor_generator_op.cc,
+# density_prior_box_op.cc, roi_pool_op.cc, generate_proposals_op.cc,
+# box_clip_op.cc, bipartite_match_op.cc, target_assign_op.cc,
+# sigmoid_focal_loss_op.cc, mine_hard_examples_op.cc,
+# polygon_box_transform_op.cc, box_decoder_and_assign_op.cc, psroi_pool_op.cc)
+# ---------------------------------------------------------------------------
+
+def _nms_single(boxes, scores, iou_thr, score_thr, top_k):
+    """Greedy NMS over one class: returns keep mask [N] (static shapes)."""
+    n = boxes.shape[0]
+    areas = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    inter = jnp.prod(jnp.maximum(rb - lt, 0), axis=-1)
+    iou = inter / jnp.maximum(areas[:, None] + areas[None, :] - inter, 1e-10)
+
+    order = jnp.argsort(-scores)
+    iou_o = iou[order][:, order]
+    valid = scores[order] > score_thr
+
+    def body(keep, i):
+        sup = jnp.any(jnp.where(jnp.arange(n) < i,
+                                keep & (iou_o[i] > iou_thr), False))
+        k = valid[i] & jnp.logical_not(sup)
+        return keep.at[i].set(k), None
+
+    keep0 = jnp.zeros(n, bool)
+    keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+    if top_k > 0:
+        rank = jnp.cumsum(keep) - 1
+        keep = keep & (rank < top_k)
+    # un-sort back to original order
+    inv = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n))
+    return keep[inv]
+
+
+@register_op("multiclass_nms", differentiable=False)
+def _multiclass_nms(ctx, inputs, attrs):
+    """multiclass_nms_op.cc, padded: BBoxes [N, M, 4], Scores [N, C, M] →
+    Out [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), padded with
+    label = -1 (the reference emits variable-row LoD; XLA needs static)."""
+    (bboxes,) = inputs["BBoxes"]
+    (scores,) = inputs["Scores"]
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    bg = int(attrs.get("background_label", 0))
+    n, c, m = scores.shape
+    if keep_top_k <= 0:
+        keep_top_k = m
+
+    def per_image(bb, sc):
+        rows = []
+        for cls in range(c):
+            if cls == bg:
+                continue
+            keep = _nms_single(bb, sc[cls], nms_thr, score_thr, nms_top_k)
+            s = jnp.where(keep, sc[cls], -1.0)
+            rows.append(jnp.concatenate(
+                [jnp.full((m, 1), float(cls)), s[:, None], bb], axis=1))
+        allr = jnp.concatenate(rows, axis=0)          # [(C-?)·M, 6]
+        order = jnp.argsort(-allr[:, 1])
+        top = allr[order[:keep_top_k]]
+        lab = jnp.where(top[:, 1] > -1.0, top[:, 0], -1.0)
+        return jnp.concatenate([lab[:, None], top[:, 1:]], axis=1)
+
+    out = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out]}
+
+
+@register_op("anchor_generator", differentiable=False)
+def _anchor_generator(ctx, inputs, attrs):
+    """anchor_generator_op.cc: per-pixel anchors for an FPN level."""
+    (x,) = inputs["Input"]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs["aspect_ratios"]]
+    stride = [float(s) for s in attrs["stride"]]
+    offset = attrs.get("offset", 0.5)
+    var = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    h, w = x.shape[-2], x.shape[-1]
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    boxes = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * (r ** 0.5)
+            ah = s / (r ** 0.5)
+            boxes.append((aw, ah))
+    gx, gy = jnp.meshgrid(cx, cy)                      # [H, W]
+    anchors = jnp.stack([
+        jnp.stack([gx - aw / 2, gy - ah / 2, gx + aw / 2, gy + ah / 2], -1)
+        for aw, ah in boxes], axis=2)                  # [H, W, A, 4]
+    variances = jnp.broadcast_to(jnp.asarray(var, jnp.float32),
+                                 anchors.shape)
+    return {"Anchors": [anchors], "Variances": [variances]}
+
+
+@register_op("density_prior_box", differentiable=False)
+def _density_prior_box(ctx, inputs, attrs):
+    """density_prior_box_op.cc: dense multi-density SSD priors."""
+    (x,) = inputs["Input"]
+    (img,) = inputs["Image"]
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs["fixed_ratios"]]
+    densities = [int(d) for d in attrs["densities"]]
+    sw = attrs.get("step_w", 0.0)
+    sh = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+    var = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    fh, fw = x.shape[-2], x.shape[-1]
+    ih, iw = img.shape[-2], img.shape[-1]
+    step_w = sw if sw > 0 else iw / fw
+    step_h = sh if sh > 0 else ih / fh
+    pris = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            dstep_w = step_w / density
+            dstep_h = step_h / density
+            for di in range(density):
+                for dj in range(density):
+                    pris.append((bw, bh,
+                                 (dj + 0.5) * dstep_w - step_w / 2,
+                                 (di + 0.5) * dstep_h - step_h / 2))
+    cx = (jnp.arange(fw) + offset) * step_w
+    cy = (jnp.arange(fh) + offset) * step_h
+    gx, gy = jnp.meshgrid(cx, cy)
+    out = jnp.stack([
+        jnp.stack([(gx + dx - bw / 2) / iw, (gy + dy - bh / 2) / ih,
+                   (gx + dx + bw / 2) / iw, (gy + dy + bh / 2) / ih], -1)
+        for bw, bh, dx, dy in pris], axis=2)           # [H, W, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(var, jnp.float32), out.shape)
+    return {"Boxes": [out], "Variances": [variances]}
+
+
+@register_op("roi_pool", nondiff_inputs=["ROIs"])
+def _roi_pool(ctx, inputs, attrs):
+    """roi_pool_op.cc: max pooling of each ROI into pooled_h × pooled_w."""
+    (x,) = inputs["X"]
+    (rois,) = inputs["ROIs"]          # [R, 5] (batch_idx, x1, y1, x2, y2)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[b]                                     # [C, H, W]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        iy = jnp.clip(((ys[None, :] - y1) * ph) // rh, -1, ph)   # bin of row
+        ix = jnp.clip(((xs[None, :] - x1) * pw) // rw, -1, pw)
+        out = jnp.full((c, ph, pw), -jnp.inf)
+        for bin_y in range(ph):
+            for bin_x in range(pw):
+                my = ((ys >= y1) & (ys <= y2) & (iy[0] == bin_y))
+                mx = ((xs >= x1) & (xs <= x2) & (ix[0] == bin_x))
+                mask = my[:, None] & mx[None, :]
+                v = jnp.where(mask[None], img, -jnp.inf).max((1, 2))
+                out = out.at[:, bin_y, bin_x].set(v)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return {"Out": [jax.vmap(one_roi)(rois.astype(jnp.float32))]}
+
+
+@register_op("psroi_pool", nondiff_inputs=["ROIs"])
+def _psroi_pool(ctx, inputs, attrs):
+    """psroi_pool_op.cc: position-sensitive average ROI pooling."""
+    (x,) = inputs["X"]
+    (rois,) = inputs["ROIs"]
+    oc = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * scale
+        y1 = roi[2] * scale
+        x2 = roi[3] * scale
+        y2 = roi[4] * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        img = x[b]
+        ys = jnp.arange(h) + 0.5
+        xs = jnp.arange(w) + 0.5
+        out = jnp.zeros((oc, ph, pw))
+        for by in range(ph):
+            for bx in range(pw):
+                ys0 = y1 + by * rh / ph
+                ys1 = y1 + (by + 1) * rh / ph
+                xs0 = x1 + bx * rw / pw
+                xs1 = x1 + (bx + 1) * rw / pw
+                my = (ys >= ys0) & (ys < ys1)
+                mx = (xs >= xs0) & (xs < xs1)
+                mask = (my[:, None] & mx[None, :]).astype(x.dtype)
+                cnt = jnp.maximum(mask.sum(), 1.0)
+                for co in range(oc):
+                    ch = (co * ph + by) * pw + bx
+                    out = out.at[co, by, bx].set(
+                        (img[ch] * mask).sum() / cnt)
+        return out
+
+    return {"Out": [jax.vmap(one_roi)(rois.astype(jnp.float32))]}
+
+
+@register_op("box_clip", differentiable=False)
+def _box_clip(ctx, inputs, attrs):
+    (boxes,) = inputs["Input"]
+    (im_info,) = inputs["ImInfo"]          # [N, 3] (h, w, scale)
+    h = im_info[:, 0] - 1.0
+    w = im_info[:, 1] - 1.0
+    shape = (-1,) + (1,) * (boxes.ndim - 1)
+    x1 = jnp.clip(boxes[..., 0::4], 0, w.reshape(shape)[..., 0:1])
+    y1 = jnp.clip(boxes[..., 1::4], 0, h.reshape(shape)[..., 0:1])
+    x2 = jnp.clip(boxes[..., 2::4], 0, w.reshape(shape)[..., 0:1])
+    y2 = jnp.clip(boxes[..., 3::4], 0, h.reshape(shape)[..., 0:1])
+    out = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(boxes.shape)
+    return {"Output": [out]}
+
+
+@register_op("bipartite_match", differentiable=False)
+def _bipartite_match(ctx, inputs, attrs):
+    """bipartite_match_op.cc: greedy max bipartite matching on a [N, M]
+    distance matrix (rows = ground truth, cols = priors)."""
+    (dist,) = inputs["DistMat"]
+    match_type = attrs.get("match_type", "bipartite")
+    overlap_thr = attrs.get("dist_threshold", 0.5)
+    n, m = dist.shape
+
+    def body(carry, _):
+        d, row_match, col_match = carry
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        ok = d[i, j] > 0
+        row_match = jnp.where(ok, row_match.at[i].set(j), row_match)
+        col_match = jnp.where(ok, col_match.at[j].set(i), col_match)
+        d = jnp.where(ok, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+        return (d, row_match, col_match), None
+
+    init = (dist, jnp.full(n, -1, jnp.int32), jnp.full(m, -1, jnp.int32))
+    (_, _, col_match), _ = jax.lax.scan(body, init, None, length=min(n, m))
+    col_dist = jnp.where(col_match >= 0,
+                         dist[jnp.maximum(col_match, 0), jnp.arange(m)], 0.0)
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0)
+        best = dist[best_row, jnp.arange(m)]
+        extra = (col_match < 0) & (best > overlap_thr)
+        col_match = jnp.where(extra, best_row.astype(jnp.int32), col_match)
+        col_dist = jnp.where(extra, best, col_dist)
+    return {"ColToRowMatchIndices": [col_match[None]],
+            "ColToRowMatchDist": [col_dist[None]]}
+
+
+@register_op("target_assign", differentiable=False)
+def _target_assign(ctx, inputs, attrs):
+    """target_assign_op.cc: scatter per-prior targets from matched rows."""
+    (x,) = inputs["X"]                 # [N?, M_gt, K] gt boxes/labels
+    (match,) = inputs["MatchIndices"]  # [N, M_prior]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    xe = x if x.ndim == 3 else x[None]
+    gathered = jnp.take_along_axis(
+        xe, jnp.maximum(match, 0)[..., None].astype(jnp.int32), axis=1)
+    out = jnp.where((match >= 0)[..., None], gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    wt = (match >= 0).astype(jnp.float32)[..., None]
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+@register_op("sigmoid_focal_loss", nondiff_inputs=["Label", "FgNum"])
+def _sigmoid_focal_loss(ctx, inputs, attrs):
+    """sigmoid_focal_loss_op.cc: RetinaNet focal loss over [N, C] logits;
+    Label [N, 1] in [0, C] (0 = background), FgNum normalizer."""
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    (fg,) = inputs["FgNum"]
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    n, c = x.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    t = (lab[:, None] == (jnp.arange(c)[None, :] + 1)).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    w = t * alpha * jnp.power(1 - p, gamma) + \
+        (1 - t) * (1 - alpha) * jnp.power(p, gamma)
+    fgn = jnp.maximum(fg.reshape(()).astype(x.dtype), 1.0)
+    return {"Out": [w * ce / fgn]}
+
+
+@register_op("mine_hard_examples", differentiable=False)
+def _mine_hard_examples(ctx, inputs, attrs):
+    """mine_hard_examples_op.cc (max_negative mining): keep the top
+    neg_pos_ratio·#pos highest-loss negatives per image."""
+    (cls_loss,) = inputs["ClsLoss"]
+    (match,) = inputs["MatchIndices"]
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg = match < 0
+    npos = jnp.sum(match >= 0, axis=1)
+    nneg = jnp.minimum((npos * ratio).astype(jnp.int32),
+                       jnp.sum(neg, axis=1))
+    loss = jnp.where(neg, cls_loss.reshape(match.shape), -jnp.inf)
+    order = jnp.argsort(-loss, axis=1)
+    rank = jnp.zeros_like(order).at[
+        jnp.arange(order.shape[0])[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(order.shape[1])[None], order.shape))
+    sel = neg & (rank < nneg[:, None])
+    return {"NegIndices": [sel.astype(jnp.int32)],
+            "UpdatedMatchIndices": [jnp.where(sel, -1, match)]}
+
+
+@register_op("polygon_box_transform", differentiable=False)
+def _polygon_box_transform(ctx, inputs, attrs):
+    """polygon_box_transform_op.cc: offset channels → absolute coords
+    (in[n, 2k, h, w]: even channels += col·4, odd += row·4 where active)."""
+    (x,) = inputs["Input"]
+    n, c, h, w = x.shape
+    cols = jnp.broadcast_to(jnp.arange(w)[None, :] * 4.0, (h, w))
+    rows = jnp.broadcast_to(jnp.arange(h)[:, None] * 4.0, (h, w))
+    add = jnp.stack([cols if i % 2 == 0 else rows for i in range(c)])
+    return {"Output": [jnp.where(x != 0, add[None] - x, 0.0)]}
+
+
+@register_op("box_decoder_and_assign", differentiable=False)
+def _box_decoder_and_assign(ctx, inputs, attrs):
+    """box_decoder_and_assign_op.cc: decode per-class deltas, pick the
+    highest-scoring class's box per prior."""
+    (prior,) = inputs["PriorBox"]       # [M, 4]
+    (pvar,) = inputs["PriorBoxVar"]     # [M, 4]
+    (target,) = inputs["TargetBox"]     # [M, 4·C]
+    (score,) = inputs["BoxScore"]       # [M, C]
+    m, c = score.shape
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    phh = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + phh * 0.5
+    t = target.reshape(m, c, 4) * pvar[:, None, :]
+    cx = t[..., 0] * pw[:, None] + pcx[:, None]
+    cy = t[..., 1] * phh[:, None] + pcy[:, None]
+    bw = jnp.exp(t[..., 2]) * pw[:, None]
+    bh = jnp.exp(t[..., 3]) * phh[:, None]
+    dec = jnp.stack([cx - bw / 2, cy - bh / 2,
+                     cx + bw / 2 - 1, cy + bh / 2 - 1], -1)  # [M, C, 4]
+    best = jnp.argmax(score[:, 1:], axis=1) + 1              # skip bg
+    assigned = jnp.take_along_axis(
+        dec, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": [dec.reshape(m, c * 4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register_op("generate_proposals", differentiable=False)
+def _generate_proposals(ctx, inputs, attrs):
+    """generate_proposals_op.cc, padded: decode anchors with deltas, clip,
+    NMS, emit post_nms_topN rows per image (padded by lowest scores)."""
+    (scores,) = inputs["Scores"]        # [N, A, H, W]
+    (deltas,) = inputs["BboxDeltas"]    # [N, 4A, H, W]
+    (im_info,) = inputs["ImInfo"]       # [N, 3]
+    (anchors,) = inputs["Anchors"]      # [H, W, A, 4]
+    variances = inputs.get("Variances")
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thr = attrs.get("nms_thresh", 0.7)
+    n = scores.shape[0]
+    a = anchors.shape[2]
+    hw = anchors.shape[0] * anchors.shape[1]
+    anc = anchors.reshape(hw * a, 4)
+    var = (variances[0].reshape(hw * a, 4) if variances
+           else jnp.ones((hw * a, 4), jnp.float32))
+
+    def per_image(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(-1)                 # [HWA]
+        d = dl.reshape(a, 4, *dl.shape[1:]).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        dv = d * var
+        cx = dv[:, 0] * aw + acx
+        cy = dv[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(dv[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(dv[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], -1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], -1)
+        k = min(pre_n, s.shape[0])
+        pn = min(post_n, k)   # small feature maps: fewer anchors than topN
+        top_s, top_i = jax.lax.top_k(s, k)
+        top_b = boxes[top_i]
+        keep = _nms_single(top_b, top_s, nms_thr, -jnp.inf, pn)
+        sel_s = jnp.where(keep, top_s, -jnp.inf)
+        out_s, oi = jax.lax.top_k(sel_s, pn)
+        ob = top_b[oi]
+        if pn < post_n:       # pad to the declared static output size
+            pad = post_n - pn
+            ob = jnp.concatenate([ob, jnp.zeros((pad, 4), ob.dtype)])
+            out_s = jnp.concatenate([out_s, jnp.full((pad,), -jnp.inf)])
+        return ob, out_s
+
+    rois, rscores = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [rscores]}

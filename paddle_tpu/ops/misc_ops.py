@@ -1,0 +1,723 @@
+"""Misc op families: ranking/margin losses, normalization, image/layout
+reshuffles, interpolation, indexed pooling, batch-size-like random fills,
+and v2 (XShape-carrying) aliases.
+
+Reference analogs (paddle/fluid/operators/): hinge_loss_op.h, rank_loss_op.h,
+modified_huber_loss_op.h, bpr_loss_op.h, teacher_student_sigmoid_loss_op.cc,
+center_loss_op.h, squared_l2_distance_op.h, label_smooth_op.h, selu_op.h,
+l1_norm_op.h, norm_op.h, minus_op.cc, multiplex_op.cc, reverse_op.cc,
+crop_op.h, pad_constant_like_op.h, space_to_depth_op.cc, pixel_shuffle_op.h,
+shuffle_channel_op.h, temporal_shift_op.h, unfold_op.h, affine_channel_op.cc,
+lrn_op.h, row_conv_op.cc, conv_shift_op.cc, add_position_encoding_op.h,
+bilinear_tensor_product_op.h, interpolate_op.h (nearest/bilinear/trilinear),
+pool_with_index_op.h, unpool_op.h, spp_op.h, mean_iou_op.h,
+grid_sampler_op.h, affine_grid_op.h, spectral_norm_op.h, sampling_id_op.h,
+*_batch_size_like ops, reshape_op.cc (reshape2/transpose2/squeeze2/
+unsqueeze2 v2 forms with XShape), cross_entropy2 (cross_entropy_op2.h),
+get_tensor_from_selected_rows_op.cc, merge_selected_rows_op.cc.
+
+All static-shape, jnp/XLA-native; v2 ops emit the XShape shadow output the
+reference uses for in-place reshape grad (here just metadata parity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+from .common import one
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+@register_op("hinge_loss", nondiff_inputs=["Labels"])
+def _hinge_loss(ctx, inputs, attrs):
+    (x,) = inputs["Logits"]
+    (y,) = inputs["Labels"]
+    return {"Loss": [jnp.maximum(1.0 - x * (2.0 * y - 1.0), 0.0)]}
+
+
+@register_op("rank_loss", nondiff_inputs=["Label"])
+def _rank_loss(ctx, inputs, attrs):
+    (label,) = inputs["Label"]
+    (left,) = inputs["Left"]
+    (right,) = inputs["Right"]
+    d = left - right
+    return one(jax.nn.softplus(d) - label * d)
+
+
+@register_op("modified_huber_loss", nondiff_inputs=["Y"])
+def _modified_huber_loss(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    v = x * (2.0 * y - 1.0)
+    loss = jnp.where(v < -1.0, -4.0 * v,
+                     jnp.where(v < 1.0, jnp.square(1.0 - v), 0.0))
+    return {"IntermediateVal": [v], "Out": [loss]}
+
+
+@register_op("bpr_loss", nondiff_inputs=["Label"])
+def _bpr_loss(ctx, inputs, attrs):
+    """Bayesian personalized ranking: mean over negatives of
+    softplus(x_neg − x_pos)."""
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    n, c = x.shape[0], x.shape[-1]
+    idx = label.reshape(n).astype(jnp.int32)
+    pos = jnp.take_along_axis(x.reshape(n, c), idx[:, None], axis=1)
+    sp = jax.nn.softplus(x.reshape(n, c) - pos)
+    mask = jax.nn.one_hot(idx, c, dtype=x.dtype)
+    loss = jnp.sum(sp * (1.0 - mask), axis=1, keepdims=True) / (c - 1)
+    return {"Y": [loss]}
+
+
+@register_op("teacher_student_sigmoid_loss", nondiff_inputs=["Label"])
+def _ts_sigmoid_loss(ctx, inputs, attrs):
+    """teacher_student_sigmoid_loss_op.cc: CTR distillation loss —
+    label < -1 → teacher-only, two-part piecewise otherwise."""
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
+    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    sce = jnp.maximum(z, 0.0) - z * jnp.where(label > -1.0, label, 0.0) \
+        + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return {"Y": [sce]}
+
+
+@register_op("squared_l2_distance", nondiff_inputs=[])
+def _squared_l2_distance(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    sub = x - jnp.broadcast_to(y, x.shape)
+    out = jnp.sum(jnp.square(sub).reshape(x.shape[0], -1), axis=1,
+                  keepdims=True)
+    return {"sub_result": [sub], "Out": [out]}
+
+
+@register_op("center_loss", nondiff_inputs=["Label", "Centers",
+                                            "CenterUpdateRate"])
+def _center_loss(ctx, inputs, attrs):
+    """center_loss_op.h: ||x − center_label||²/2 + running center update."""
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    (centers,) = inputs["Centers"]
+    (alpha,) = inputs["CenterUpdateRate"]
+    idx = label.reshape(-1).astype(jnp.int32)
+    c = centers[idx]
+    diff = x - c
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if attrs.get("need_update", True) and not ctx.is_test:
+        counts = jnp.zeros(centers.shape[0], x.dtype).at[idx].add(1.0)
+        delta = jnp.zeros_like(centers).at[idx].add(diff)
+        upd = centers + alpha.reshape(()) * delta / (counts[:, None] + 1.0)
+        new_centers = lax.stop_gradient(upd)
+    else:
+        new_centers = centers
+    return {"Loss": [loss], "SampleCenterDiff": [lax.stop_gradient(diff)],
+            "CentersOut": [new_centers]}
+
+
+@register_op("label_smooth", nondiff_inputs=["PriorDist"])
+def _label_smooth(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    eps = attrs.get("epsilon", 0.0)
+    prior = inputs.get("PriorDist")
+    if prior:
+        return one((1.0 - eps) * x + eps * prior[0])
+    return one((1.0 - eps) * x + eps / x.shape[-1])
+
+
+@register_op("mean_iou", differentiable=False)
+def _mean_iou(ctx, inputs, attrs):
+    (pred,) = inputs["Predictions"]
+    (label,) = inputs["Labels"]
+    n = attrs["num_classes"]
+    p = pred.reshape(-1).astype(jnp.int32)
+    t = label.reshape(-1).astype(jnp.int32)
+    inter = jnp.zeros(n, jnp.float32).at[jnp.where(p == t, p, n - 1)].add(
+        jnp.where(p == t, 1.0, 0.0))
+    area_p = jnp.zeros(n, jnp.float32).at[p].add(1.0)
+    area_t = jnp.zeros(n, jnp.float32).at[t].add(1.0)
+    union = area_p + area_t - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+    valid = (union > 0).sum()
+    mean = jnp.sum(iou) / jnp.maximum(valid.astype(jnp.float32), 1.0)
+    return {"OutMeanIou": [mean.reshape(1)], "OutWrong": [(area_p - inter)],
+            "OutCorrect": [inter]}
+
+
+# ---------------------------------------------------------------------------
+# normalization / elementwise
+# ---------------------------------------------------------------------------
+
+@register_op("selu")
+def _selu(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return one(scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)))
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.sum(jnp.abs(x)).reshape(1))
+
+
+@register_op("norm")
+def _norm(ctx, inputs, attrs):
+    """norm_op.h: l2-normalize along `axis`; Norm output saves the norms."""
+    (x,) = inputs["X"]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / nrm], "Norm": [nrm]}
+
+
+@register_op("minus")
+def _minus(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    return one(x - y)
+
+
+@register_op("multiplex", nondiff_inputs=["Ids"])
+def _multiplex(ctx, inputs, attrs):
+    (ids,) = inputs["Ids"]
+    xs = inputs["X"]
+    stacked = jnp.stack(xs)                        # [k, B, ...]
+    sel = ids.reshape(-1).astype(jnp.int32)        # [B]
+    return one(stacked[sel, jnp.arange(stacked.shape[1])])
+
+
+@register_op("reverse")
+def _reverse(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axes = attrs.get("axis", [0])
+    axes = axes if isinstance(axes, (list, tuple)) else [axes]
+    return one(jnp.flip(x, axis=tuple(int(a) for a in axes)))
+
+
+@register_op("crop")
+def _crop(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    return one(lax.slice(x, [int(o) for o in offsets],
+                         [int(o) + int(s) for o, s in zip(offsets, shape)]))
+
+
+@register_op("pad_constant_like", nondiff_inputs=["X"])
+def _pad_constant_like(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    val = attrs.get("pad_value", 0.0)
+    pads = [(0, xd - yd, 0) for xd, yd in zip(x.shape, y.shape)]
+    return one(lax.pad(y, jnp.asarray(val, y.dtype), pads))
+
+
+@register_op("size", differentiable=False)
+def _size(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    return one(jnp.asarray(int(np.prod(x.shape) if x.ndim else 1),
+                           jnp.int64).reshape(()))
+
+
+@register_op("is_empty", differentiable=False)
+def _is_empty(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.asarray(int(np.prod(x.shape)) == 0).reshape(1))
+
+
+@register_op("fill", differentiable=False)
+def _fill(ctx, inputs, attrs):
+    from ..core.dtypes import convert_dtype
+    value = np.asarray(attrs["value"], convert_dtype(attrs.get("dtype", "float32")))
+    return one(jnp.asarray(value).reshape(attrs["shape"]))
+
+
+@register_op("fill_any_like", differentiable=False)
+def _fill_any_like(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.full_like(x, attrs.get("value", 0.0)))
+
+
+@register_op("fill_zeros_like2", differentiable=False)
+def _fill_zeros_like2(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.zeros_like(x))
+
+
+@register_op("get_tensor_from_selected_rows", differentiable=False)
+def _get_tensor_from_selected_rows(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    from ..core.selected_rows import SelectedRows
+    return one(x.to_dense() if isinstance(x, SelectedRows) else x)
+
+
+@register_op("merge_selected_rows", differentiable=False)
+def _merge_selected_rows(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    from ..core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        ids, rows = x.merged()
+        return one(SelectedRows(ids, rows, x.height))
+    return one(x)
+
+
+# ---------------------------------------------------------------------------
+# image / layout
+# ---------------------------------------------------------------------------
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    bs = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return one(out.reshape(n, c * bs * bs, h // bs, w // bs))
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    r = int(attrs.get("upscale_factor", 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return one(out.reshape(n, c // (r * r), h * r, w * r))
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    return one(x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+               .reshape(n, c, h, w))
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ctx, inputs, attrs):
+    """temporal_shift_op.h: shift 1/shift_ratio of channels ±1 along T."""
+    (x,) = inputs["X"]
+    t = int(attrs["seg_num"])
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // t
+    v = x.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    pad = jnp.zeros_like(v[:, :1])
+    fwd = jnp.concatenate([v[:, 1:, :c1], pad[:, :, :c1]], axis=1)
+    bwd = jnp.concatenate([pad[:, :, c1:c2], v[:, :-1, c1:c2]], axis=1)
+    keep = v[:, :, c2:]
+    return one(jnp.concatenate([fwd, bwd, keep], axis=2).reshape(nt, c, h, w))
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (scale,) = inputs["Scale"]
+    (bias,) = inputs["Bias"]
+    layout = attrs.get("data_layout", "NCHW")
+    shape = ([1, -1] + [1] * (x.ndim - 2)) if layout == "NCHW" else None
+    if shape is not None:
+        return one(x * scale.reshape(shape) + bias.reshape(shape))
+    return one(x * scale + bias)
+
+
+@register_op("lrn")
+def _lrn(ctx, inputs, attrs):
+    """lrn_op.h local response normalization over channels (NCHW)."""
+    (x,) = inputs["X"]
+    n = int(attrs.get("n", 5))
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ctx, inputs, attrs):
+    """add_position_encoding_op.h: x*alpha + beta*sinusoid(pos)."""
+    (x,) = inputs["X"]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / d)
+    enc = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    return one(alpha * x + beta * enc[None].astype(x.dtype))
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, inputs, attrs):
+    """out[b,k] = x[b]·W_k·y[b] (+ bias)."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    (w,) = inputs["Weight"]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    bias = inputs.get("Bias")
+    if bias:
+        out = out + bias[0]
+    return one(out)
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, inputs, attrs):
+    """conv_shift_op.cc: circular correlation, y length odd ≤ x length."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    b, m = x.shape
+    n = y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    return one(jnp.einsum("bmn,bn->bm", x[:, idx.reshape(-1)].reshape(b, m, n), y))
+
+
+@register_op("row_conv")
+def _row_conv(ctx, inputs, attrs):
+    """row_conv_op.cc (lookahead conv, batch-major [B, T, D] redesign of the
+    LoD form): out[t] = Σ_{i<future_len} x[t+i]·w[i]."""
+    (x,) = inputs["X"]
+    (w,) = inputs["Filter"]          # [future_len, D]
+    fl = w.shape[0]
+    b, t, d = x.shape
+    pad = jnp.concatenate([x, jnp.zeros((b, fl - 1, d), x.dtype)], axis=1)
+    out = sum(pad[:, i:i + t] * w[i][None, None, :] for i in range(fl))
+    return one(out)
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, inputs, attrs):
+    """grid_sampler_op.h: bilinear sampling of x [N,C,H,W] at grid [N,H,W,2]
+    (normalized [-1,1] coords, zero padding)."""
+    (x,) = inputs["X"]
+    (grid,) = inputs["Grid"]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+
+    def gather(yi, xi):
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = x[jnp.arange(n)[:, None, None], :, yi_c, xi_c]    # [N,Ho,Wo,C]
+        ok = ((yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1))
+        return v * ok[..., None].astype(x.dtype)
+
+    wx = gx - x0
+    wy = gy - y0
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+           + gather(y0, x0 + 1) * (wx * (1 - wy))[..., None]
+           + gather(y0 + 1, x0) * ((1 - wx) * wy)[..., None]
+           + gather(y0 + 1, x0 + 1) * (wx * wy)[..., None])
+    return {"Output": [jnp.moveaxis(out, -1, 1)]}
+
+
+@register_op("affine_grid")
+def _affine_grid(ctx, inputs, attrs):
+    """affine_grid_op.h: theta [N,2,3] → sampling grid [N,H,W,2]."""
+    (theta,) = inputs["Theta"]
+    shape = inputs.get("OutputShape")
+    if shape:
+        hw = np.asarray(shape[0]).reshape(-1)
+        h, w = int(hw[-2]), int(hw[-1])
+    else:
+        os_ = attrs["output_shape"]
+        h, w = int(os_[-2]), int(os_[-1])
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # [H,W,3]
+    out = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return {"Output": [out]}
+
+
+@register_op("spectral_norm")
+def _spectral_norm(ctx, inputs, attrs):
+    """spectral_norm_op.h: weight / sigma_max via power iteration."""
+    (w,) = inputs["Weight"]
+    (u,) = inputs["U"]
+    (v,) = inputs["V"]
+    dim = attrs.get("dim", 0)
+    iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    uu, vv = u.reshape(-1), v.reshape(-1)
+    for _ in range(max(iters, 0)):
+        vv = wm.T @ uu
+        vv = vv / (jnp.linalg.norm(vv) + eps)
+        uu = wm @ vv
+        uu = uu / (jnp.linalg.norm(uu) + eps)
+    uu, vv = lax.stop_gradient(uu), lax.stop_gradient(vv)
+    sigma = uu @ wm @ vv
+    return one(w / sigma)
+
+
+# ---------------------------------------------------------------------------
+# interpolation (interpolate_op.h family)
+# ---------------------------------------------------------------------------
+
+def _interp(x, attrs, method):
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    if scale and scale > 0:
+        tgt = tuple(int(s * scale) for s in spatial)
+    elif len(spatial) == 3:
+        tgt = (int(attrs.get("out_d", -1)), int(out_h), int(out_w))
+    else:
+        tgt = (int(out_h), int(out_w))
+    align = attrs.get("align_corners", True)
+    if method == "nearest":
+        # index-map resize (matches the reference's floor rule)
+        idxs = []
+        for s, t in zip(spatial, tgt):
+            ratio = (s - 1) / (t - 1) if (align and t > 1) else s / t
+            ix = (jnp.arange(t) * ratio)
+            idxs.append((ix + (0.5 if align else 0.0)).astype(jnp.int32).clip(0, s - 1))
+        out = x
+        for d, ix in enumerate(idxs):
+            out = jnp.take(out, ix, axis=2 + d)
+        return out
+    mth = {"bilinear": "linear", "trilinear": "linear"}[method]
+    return jax.image.resize(x, (n, c) + tgt, method=mth)
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(_interp(x, attrs, "nearest"))
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(_interp(x, attrs, "bilinear"))
+
+
+@register_op("trilinear_interp")
+def _trilinear_interp(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(_interp(x, attrs, "trilinear"))
+
+
+# ---------------------------------------------------------------------------
+# pooling with indices / unpool / spp / pool3d
+# ---------------------------------------------------------------------------
+
+def _pool_patches(x, ksize, strides, paddings):
+    """[N,C,Ho,Wo,kh*kw] patches (−inf padded) + flat-index helper."""
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=-jnp.inf)
+    ho = (h + 2 * ph - kh) // sh + 1
+    wo = (w + 2 * pw - kw) // sw + 1
+    patches = []
+    flat_idx = []
+    for i in range(kh):
+        for j in range(kw):
+            sub = lax.slice(xp, (0, 0, i, j),
+                            (n, c, i + (ho - 1) * sh + 1, j + (wo - 1) * sw + 1),
+                            (1, 1, sh, sw))
+            patches.append(sub)
+            rows = (jnp.arange(ho) * sh + i - ph)[:, None]
+            cols = (jnp.arange(wo) * sw + j - pw)[None, :]
+            flat_idx.append(jnp.broadcast_to(rows * w + cols, (ho, wo)))
+    return jnp.stack(patches, -1), jnp.stack(flat_idx, -1), ho, wo
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    ks = [int(k) for k in attrs["ksize"]]
+    st = [int(s) for s in attrs.get("strides", ks)]
+    pd = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if attrs.get("global_pooling", False):
+        ks = list(x.shape[2:])
+        pd = [0, 0]
+    patches, fidx, ho, wo = _pool_patches(x, ks, st, pd)
+    arg = jnp.argmax(patches, axis=-1)
+    out = jnp.take_along_axis(patches, arg[..., None], axis=-1)[..., 0]
+    mask = jnp.take_along_axis(fidx[None, None], arg[..., None], axis=-1)[..., 0]
+    return {"Out": [out], "Mask": [lax.stop_gradient(mask.astype(jnp.int32))]}
+
+
+@register_op("unpool", nondiff_inputs=["Indices"])
+def _unpool(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (indices,) = inputs["Indices"]
+    oh, ow = [int(v) for v in attrs["unpooled_size"]] \
+        if "unpooled_size" in attrs else (None, None)
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].add(
+        x.reshape(n, c, -1))
+    return one(flat.reshape(n, c, oh, ow))
+
+
+@register_op("spp")
+def _spp(ctx, inputs, attrs):
+    """spp_op.h spatial pyramid pooling: levels 0..L-1 of (2^l)² bins."""
+    (x,) = inputs["X"]
+    levels = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        ks = (int(np.ceil(h / bins)), int(np.ceil(w / bins)))
+        st = ks
+        ph = (ks[0] * bins - h + 1) // 2
+        pw = (ks[1] * bins - w + 1) // 2
+        patches, _, ho, wo = _pool_patches(x, ks, st, (ph, pw))
+        if ptype == "max":
+            o = jnp.max(patches, axis=-1)
+        else:
+            cnt = jnp.sum(jnp.isfinite(patches), axis=-1)
+            o = jnp.sum(jnp.where(jnp.isfinite(patches), patches, 0.0), -1) \
+                / jnp.maximum(cnt, 1)
+        outs.append(o.reshape(n, c, -1))
+    return one(jnp.concatenate(outs, axis=-1).reshape(n, -1))
+
+
+@register_op("pool3d")
+def _pool3d(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    ks = [int(k) for k in attrs["ksize"]]
+    st = [int(s) for s in attrs.get("strides", ks)]
+    pd = [int(p) for p in attrs.get("paddings", [0, 0, 0])]
+    if attrs.get("global_pooling", False):
+        ks, pd = list(x.shape[2:]), [0, 0, 0]
+    ptype = attrs.get("pooling_type", "max")
+    dims = (1, 1) + tuple(ks)
+    strides = (1, 1) + tuple(st)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    if ptype == "max":
+        return one(lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads))
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if attrs.get("exclusive", True):
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return one(s / cnt)
+    return one(s / float(np.prod(ks)))
+
+
+# ---------------------------------------------------------------------------
+# batch-size-like randoms + sampling
+# ---------------------------------------------------------------------------
+
+def _batch_size_like_shape(attrs, ref):
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    return shape
+
+
+@register_op("uniform_random_batch_size_like", differentiable=False)
+def _uniform_random_bsl(ctx, inputs, attrs):
+    (ref,) = inputs["Input"]
+    shape = _batch_size_like_shape(attrs, ref)
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    return one(jax.random.uniform(ctx.rng(), shape, jnp.float32, lo, hi))
+
+
+@register_op("gaussian_random_batch_size_like", differentiable=False)
+def _gaussian_random_bsl(ctx, inputs, attrs):
+    (ref,) = inputs["Input"]
+    shape = _batch_size_like_shape(attrs, ref)
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    return one(mean + std * jax.random.normal(ctx.rng(), shape, jnp.float32))
+
+
+@register_op("sampling_id", differentiable=False)
+def _sampling_id(ctx, inputs, attrs):
+    """sampling_id_op.h: one categorical draw per row of a prob matrix."""
+    (x,) = inputs["X"]
+    ids = jax.random.categorical(ctx.rng(), jnp.log(jnp.maximum(x, 1e-30)),
+                                 axis=-1)
+    return one(ids.astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# v2 aliases (XShape shadow for in-place grad machinery — metadata parity)
+# ---------------------------------------------------------------------------
+
+def _with_xshape(out, x):
+    return {"Out": [out],
+            "XShape": [lax.stop_gradient(jnp.zeros((0,) + x.shape, x.dtype))]}
+
+
+@register_op("reshape2")
+def _reshape2(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    shape = list(attrs["shape"])
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return _with_xshape(x.reshape(shape), x)
+
+
+@register_op("transpose2")
+def _transpose2(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return _with_xshape(jnp.transpose(x, attrs["axis"]), x)
+
+
+@register_op("squeeze2")
+def _squeeze2(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    axes = attrs.get("axes", [])
+    if axes:
+        out = x
+        for a in sorted((a % x.ndim for a in axes), reverse=True):
+            if out.shape[a] == 1:
+                out = jnp.squeeze(out, a)
+    else:
+        out = jnp.squeeze(x)
+    return _with_xshape(out, x)
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    out = x
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return _with_xshape(out, x)
+
+
+@register_op("cross_entropy2", nondiff_inputs=["Label"])
+def _cross_entropy2(ctx, inputs, attrs):
+    """cross_entropy2 (hard label over probs, saves MatchX for grad)."""
+    (x,) = inputs["X"]
+    (label,) = inputs["Label"]
+    idx = label
+    if idx.ndim == x.ndim and idx.shape[-1] == 1:
+        idx = idx[..., 0]
+    match = jnp.take_along_axis(x, idx[..., None].astype(jnp.int32),
+                                axis=-1)
+    loss = -jnp.log(jnp.maximum(match, 1e-30))
+    return {"Y": [loss], "MatchX": [lax.stop_gradient(match)],
+            "XShape": [lax.stop_gradient(jnp.zeros((0,) + x.shape, x.dtype))]}

@@ -1,0 +1,295 @@
+"""Sampled / tree classifiers and small aliases.
+
+Reference analogs: nce_op.cc/.h (noise-contrastive estimation),
+hierarchical_sigmoid_op.cc + math/matrix_bit_code.h (default complete-tree
+bit codes), sample_logits_op.cc (the sampled-softmax building block),
+edit_distance_op.h, ctc_align_op.h, proximal_adagrad_op.cc, cvm_op.cc,
+data_norm_op.cc, array ops (write_to_array/read_from_array — tensor-array
+aliases), tensor_array_to_tensor_op.cc.
+
+TPU notes: samplers draw with the executor's threaded PRNG; bit-code paths
+use the reference's default complete binary tree (code = label + num_classes,
+walk the high bits), masked to static max depth.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _hsig_paths(num_classes: int):
+    """Static (index, bit, mask) tables [num_classes, max_depth] for the
+    default complete-tree bit code (matrix_bit_code.h SimpleCode):
+    code = c + num_classes; at depth d: node = (code >> (d+1)) - 1,
+    bit = (code >> d) & 1,走 from the deepest bit down."""
+    max_depth = int(_math.floor(_math.log2(2 * num_classes - 1)))
+    idx = np.zeros((num_classes, max_depth), np.int32)
+    bit = np.zeros((num_classes, max_depth), np.float32)
+    msk = np.zeros((num_classes, max_depth), np.float32)
+    for c in range(num_classes):
+        code = c + num_classes
+        length = int(_math.floor(_math.log2(code)))
+        for d in range(length):
+            shift = length - d - 1
+            idx[c, d] = (code >> (shift + 1)) - 1
+            bit[c, d] = (code >> shift) & 1
+            msk[c, d] = 1.0
+    return jnp.asarray(idx), jnp.asarray(bit), jnp.asarray(msk)
+
+
+@register_op("hierarchical_sigmoid", nondiff_inputs=["Label"])
+def _hierarchical_sigmoid(ctx, inputs, attrs):
+    """hierarchical_sigmoid_op.cc (default complete tree): loss_i =
+    Σ_path softplus((1 − 2·bit)·(w_node·x_i + b_node))."""
+    (x,) = inputs["X"]
+    (w,) = inputs["W"]                     # [num_classes-1, D]
+    (label,) = inputs["Label"]
+    bias = inputs.get("Bias")
+    num_classes = int(attrs["num_classes"])
+    idx_t, bit_t, msk_t = _hsig_paths(num_classes)
+    lab = label.reshape(-1).astype(jnp.int32)
+    node = idx_t[lab]                      # [B, L]
+    bit = bit_t[lab]
+    msk = msk_t[lab]
+    wn = w[node]                           # [B, L, D]
+    logits = jnp.einsum("bld,bd->bl", wn, x)
+    if bias:
+        logits = logits + bias[0].reshape(-1)[node]
+    z = (1.0 - 2.0 * bit) * logits
+    loss = jnp.sum(jnp.where(msk > 0, jax.nn.softplus(z), 0.0),
+                   axis=1, keepdims=True)
+    pre = jax.nn.sigmoid(logits)           # PreOut parity
+    return {"Out": [loss], "PreOut": [pre]}
+
+
+@register_op("nce", nondiff_inputs=["Label", "SampleWeight",
+                                    "CustomDistProbs", "CustomDistAlias",
+                                    "CustomDistAliasProbs"])
+def _nce(ctx, inputs, attrs):
+    """nce_op.h: binary logistic loss on the true class + k uniform noise
+    samples (sampler 0 = uniform, the default)."""
+    (x,) = inputs["Input"]
+    (w,) = inputs["Weight"]                # [num_total_classes, D]
+    (label,) = inputs["Label"]
+    bias = inputs.get("Bias")
+    num_total = int(attrs["num_total_classes"])
+    k = int(attrs.get("num_neg_samples", 10))
+    b = x.shape[0]
+    lab = label.reshape(b, -1).astype(jnp.int32)
+    num_true = lab.shape[1]
+    neg = jax.random.randint(ctx.rng(), (b, k), 0, num_total)
+    samples = jnp.concatenate([lab, neg], axis=1)       # [B, T+k]
+    ws = w[samples]                                     # [B, T+k, D]
+    logits = jnp.einsum("btd,bd->bt", ws, x)
+    if bias:
+        logits = logits + bias[0].reshape(-1)[samples]
+    p_true = 1.0 / num_true if num_true else 1.0
+    q = 1.0 / num_total                                 # uniform sampler prob
+    lt = logits[:, :num_true]
+    ln = logits[:, num_true:]
+    # P(D=1|x) = σ(logit − log(k·q))
+    shift = jnp.log(jnp.asarray(k * q, jnp.float32))
+    pos = jax.nn.softplus(-(lt - shift))
+    negl = jax.nn.softplus(ln - shift)
+    cost = jnp.sum(pos, 1, keepdims=True) * p_true + jnp.sum(negl, 1, keepdims=True)
+    return {"Cost": [cost],
+            "SampleLogits": [lax.stop_gradient(logits)],
+            "SampleLabels": [lax.stop_gradient(samples.astype(jnp.int64))]}
+
+
+@register_op("sample_logits", nondiff_inputs=["Labels", "CustomizedSamples",
+                                              "CustomizedProbabilities"])
+def _sample_logits(ctx, inputs, attrs):
+    """sample_logits_op.cc: gather logits of [true + uniformly sampled]
+    classes, subtract log(q) (the sampled-softmax correction), optionally
+    mask accidental hits."""
+    (logits,) = inputs["Logits"]           # [B, C]
+    (labels,) = inputs["Labels"]           # [B, T]
+    s = int(attrs.get("num_samples", 10))
+    remove_hits = attrs.get("remove_accidental_hits", True)
+    b, c = logits.shape
+    lab = labels.reshape(b, -1).astype(jnp.int32)
+    t = lab.shape[1]
+    sampled = jax.random.randint(ctx.rng(), (b, s), 0, c)
+    samples = jnp.concatenate([lab, sampled], axis=1)   # [B, T+S]
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    q = jnp.full_like(picked, 1.0 / c)
+    out = picked - jnp.log(q)
+    if remove_hits:
+        hit = (sampled[:, :, None] == lab[:, None, :]).any(-1)  # [B, S]
+        mask = jnp.concatenate([jnp.zeros((b, t), bool), hit], axis=1)
+        out = jnp.where(mask, out - 1e20, out)
+    new_labels = jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+    return {"SampledLogits": [out],
+            "SampledLabels": [new_labels.astype(jnp.int64)],
+            "Samples": [lax.stop_gradient(samples.astype(jnp.int64))],
+            "Probabilities": [lax.stop_gradient(q)]}
+
+
+@register_op("edit_distance", differentiable=False)
+def _edit_distance(ctx, inputs, attrs):
+    """edit_distance_op.h: Levenshtein distance between padded int rows
+    (batch-major redesign of the LoD form; -1 pads terminate a row)."""
+    (hyp,) = inputs["Hyps"]
+    (ref,) = inputs["Refs"]
+    normalized = attrs.get("normalized", True)
+    b, m = hyp.shape
+    n = ref.shape[1]
+    hlen = jnp.sum(hyp >= 0, axis=1)
+    rlen = jnp.sum(ref >= 0, axis=1)
+
+    def one(h, r, hl, rl):
+        row0 = jnp.arange(n + 1, dtype=jnp.float32)
+
+        def outer(row, i):
+            def inner(carry, j):
+                row_prev, row_new = carry
+                cost = jnp.where(h[i] == r[j], 0.0, 1.0)
+                v = jnp.minimum(jnp.minimum(row_new[j] + 1.0,
+                                            row_prev[j + 1] + 1.0),
+                                row_prev[j] + cost)
+                return (row_prev, row_new.at[j + 1].set(v)), None
+
+            init = (row, jnp.zeros(n + 1).at[0].set(i + 1.0))
+            (_, new), _ = lax.scan(inner, init, jnp.arange(n))
+            return new, new
+
+        _, rows = lax.scan(outer, row0, jnp.arange(m))
+        # dp[hl][rl] — select the row at the TRUE hyp length (pads must not
+        # participate: a pad could otherwise "substitute" for an insertion
+        # and understate the distance)
+        table = jnp.concatenate([row0[None], rows], axis=0)   # [m+1, n+1]
+        return table[hl, rl]
+
+    dist = jax.vmap(one)(hyp.astype(jnp.int32), ref.astype(jnp.int32),
+                         hlen, rlen)
+    seq_num = jnp.asarray(b, jnp.int64).reshape(1)
+    if normalized:
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return {"Out": [dist.reshape(b, 1)], "SequenceNum": [seq_num]}
+
+
+@register_op("ctc_align", differentiable=False)
+def _ctc_align(ctx, inputs, attrs):
+    """ctc_align_op.h: collapse repeats then strip blanks; padded output
+    (-1 fill) keeps static shapes."""
+    (x,) = inputs["Input"]
+    blank = int(attrs.get("blank", 0))
+    b, t = x.shape
+    xi = x.astype(jnp.int32)
+    prev = jnp.concatenate([jnp.full((b, 1), -2, jnp.int32), xi[:, :-1]], 1)
+    keep = (xi != prev) & (xi != blank)
+    pos = jnp.cumsum(keep, axis=1) - 1
+    out = jnp.full((b, t), -1, jnp.int32)
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    out = out.at[bidx, jnp.where(keep, pos, t - 1)].set(
+        jnp.where(keep, xi, -1), mode="drop")
+    # ensure padding stays -1 where nothing was written
+    return one(out.astype(x.dtype))
+
+
+@register_op("proximal_adagrad", differentiable=False)
+def _proximal_adagrad(ctx, inputs, attrs):
+    """proximal_adagrad_op.cc: adagrad step + l1/l2 proximal projection."""
+    (p,) = inputs["Param"]
+    (m,) = inputs["Moment"]
+    (g,) = inputs["Grad"]
+    (lr,) = inputs["LearningRate"]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    m_out = m + g * g
+    lr_t = lr.reshape(()) / jnp.sqrt(m_out)
+    prox = p - lr_t * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - lr_t * l1, 0.0)
+    p_out = prox / (1.0 + lr_t * l2)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("cvm")
+def _cvm(ctx, inputs, attrs):
+    """cvm_op.cc: CTR show/click feature transform — with use_cvm keep all
+    (log show, log click-rate ratio); else strip the 2 lead columns."""
+    (x,) = inputs["X"]
+    use_cvm = attrs.get("use_cvm", True)
+    show = jnp.log(jnp.maximum(x[:, 0:1], 0.0) + 1.0)
+    ctr = jnp.log(jnp.maximum(x[:, 1:2], 0.0) + 1.0) - show
+    rest = x[:, 2:]
+    if use_cvm:
+        return {"Y": [jnp.concatenate([show, ctr, rest], axis=1)]}
+    return {"Y": [rest]}
+
+
+@register_op("data_norm")
+def _data_norm(ctx, inputs, attrs):
+    """data_norm_op.cc: normalize by accumulated batch statistics."""
+    (x,) = inputs["X"]
+    (size,) = inputs["BatchSize"]
+    (bsum,) = inputs["BatchSum"]
+    (bsq,) = inputs["BatchSquareSum"]
+    eps = attrs.get("epsilon", 1e-4)
+    means = bsum / size
+    scales = jnp.sqrt(size / (bsq - means * bsum + eps * size))
+    return {"Y": [(x - means) * scales], "Means": [means], "Scales": [scales]}
+
+
+# ---------------------------------------------------------------------------
+# tensor-array aliases (reference write_to_array/read_from_array op names)
+# ---------------------------------------------------------------------------
+
+@register_op("write_to_array", nondiff_inputs=["I", "Length"])
+def _write_to_array(ctx, inputs, attrs):
+    from .control_flow_ops import _array_write
+    return _array_write(ctx, inputs, attrs)
+
+
+@register_op("read_from_array", nondiff_inputs=["I"])
+def _read_from_array(ctx, inputs, attrs):
+    from .control_flow_ops import _array_read
+    return _array_read(ctx, inputs, attrs)
+
+
+@register_op("lod_array_length", differentiable=False)
+def _lod_array_length(ctx, inputs, attrs):
+    from .control_flow_ops import _array_length
+    return _array_length(ctx, inputs, attrs)
+
+
+@register_op("max_sequence_len", differentiable=False)
+def _max_sequence_len(ctx, inputs, attrs):
+    """max_sequence_len_op.cc over the padded+mask representation: the
+    longest row length from a [B] length vector."""
+    (lens,) = inputs["RankTable"]
+    return one(jnp.max(lens).reshape(1).astype(jnp.int64))
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, inputs, attrs):
+    """tensor_array_to_tensor_op.cc: stack/concat the [max_len, ...] buffer
+    along `axis` (the array is already dense here)."""
+    (arr,) = inputs["X"]
+    axis = int(attrs.get("axis", 0))
+    use_stack = attrs.get("use_stack", False)
+    if use_stack:
+        return {"Out": [arr], "OutIndex": [jnp.full((arr.shape[0],), 1,
+                                                    jnp.int64)]}
+    parts = [arr[i] for i in range(arr.shape[0])]
+    return {"Out": [jnp.concatenate(parts, axis=axis)],
+            "OutIndex": [jnp.asarray([p.shape[axis] for p in parts],
+                                     jnp.int64)]}
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx, inputs, attrs):
+    """lod_reset_op.h: in the padded+mask redesign LoD is metadata-only —
+    values pass through."""
+    (x,) = inputs["X"]
+    return one(x)

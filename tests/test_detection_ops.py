@@ -1,0 +1,139 @@
+"""Detection family numeric checks (operators/detection/ parity, padded
+static-shape redesigns)."""
+import numpy as np
+
+from op_test_base import OpTest
+
+
+class _T(OpTest):
+    pass
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    t = _T(); t.op_type = "multiclass_nms"
+    # 3 boxes: two heavily overlapping, one distinct
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     dtype="float32")
+    scores = np.array([[[0.9, 0.8, 0.7]]], dtype="float32")  # one fg class 0?
+    # use 2 classes with class 0 as background
+    scores = np.concatenate([np.zeros_like(scores), scores], axis=1)
+    out = t.run_op({"BBoxes": boxes, "Scores": scores},
+                   attrs={"nms_threshold": 0.5, "score_threshold": 0.1,
+                          "keep_top_k": 3, "background_label": 0})
+    res = out["Out"][0]                      # [keep_top_k, 6]
+    kept = res[res[:, 0] >= 0]
+    assert kept.shape[0] == 2                # overlap suppressed
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], rtol=1e-6)
+
+
+def test_anchor_generator_centers():
+    t = _T(); t.op_type = "anchor_generator"
+    x = np.zeros((1, 8, 2, 2), "float32")
+    out = t.run_op({"Input": x},
+                   attrs={"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+                          "stride": [16.0, 16.0], "offset": 0.5},
+                   output_slots=("Anchors", "Variances"))
+    an = out["Anchors"]
+    assert an.shape == (2, 2, 1, 4)
+    # first anchor centered at (8, 8) with 32x32 extent
+    np.testing.assert_allclose(an[0, 0, 0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+
+
+def test_box_clip():
+    t = _T(); t.op_type = "box_clip"
+    boxes = np.array([[[-5.0, -5.0, 30.0, 40.0]]], dtype="float32")
+    im_info = np.array([[20.0, 25.0, 1.0]], dtype="float32")
+    out = t.run_op({"Input": boxes, "ImInfo": im_info},
+                   output_slots=("Output",))
+    np.testing.assert_allclose(out["Output"][0, 0], [0, 0, 24, 19])
+
+
+def test_bipartite_match_greedy():
+    t = _T(); t.op_type = "bipartite_match"
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.2, 0.8, 0.4]], dtype="float32")
+    out = t.run_op({"DistMat": dist},
+                   output_slots=("ColToRowMatchIndices", "ColToRowMatchDist"))
+    idx = out["ColToRowMatchIndices"][0]
+    np.testing.assert_array_equal(idx, [0, 1, -1])
+
+
+def test_target_assign():
+    t = _T(); t.op_type = "target_assign"
+    gt = np.arange(2 * 3 * 4, dtype="float32").reshape(1, 6, 4)[:, :2]
+    match = np.array([[1, -1, 0]], dtype="int32")
+    out = t.run_op({"X": gt, "MatchIndices": match},
+                   attrs={"mismatch_value": 0},
+                   output_slots=("Out", "OutWeight"))
+    np.testing.assert_allclose(out["Out"][0, 0], gt[0, 1])
+    np.testing.assert_allclose(out["Out"][0, 1], np.zeros(4))
+    np.testing.assert_allclose(out["OutWeight"][0].ravel(), [1, 0, 1])
+
+
+def test_sigmoid_focal_loss():
+    t = _T(); t.op_type = "sigmoid_focal_loss"
+    x = np.random.RandomState(0).randn(4, 3).astype("float32")
+    lab = np.array([[0], [1], [3], [2]], dtype="int32")
+    fg = np.array([3], dtype="int32")
+    out = t.run_op({"X": x, "Label": lab, "FgNum": fg},
+                   attrs={"gamma": 2.0, "alpha": 0.25})
+    o = out["Out"]
+    # reference formula
+    tm = (lab == (np.arange(3)[None] + 1)).astype("float32")
+    p = 1 / (1 + np.exp(-x))
+    ce = np.maximum(x, 0) - x * tm + np.log1p(np.exp(-np.abs(x)))
+    w = tm * 0.25 * (1 - p) ** 2 + (1 - tm) * 0.75 * p ** 2
+    np.testing.assert_allclose(o, w * ce / 3.0, rtol=1e-4, atol=1e-6)
+
+
+def test_roi_pool():
+    t = _T(); t.op_type = "roi_pool"
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], dtype="float32")
+    out = t.run_op({"X": x, "ROIs": rois},
+                   attrs={"pooled_height": 2, "pooled_width": 2,
+                          "spatial_scale": 1.0})
+    np.testing.assert_allclose(out["Out"][0, 0], [[5, 7], [13, 15]])
+
+
+def test_density_prior_box_shape():
+    t = _T(); t.op_type = "density_prior_box"
+    x = np.zeros((1, 4, 2, 2), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+    out = t.run_op({"Input": x, "Image": img},
+                   attrs={"fixed_sizes": [16.0], "fixed_ratios": [1.0],
+                          "densities": [2]},
+                   output_slots=("Boxes", "Variances"))
+    assert out["Boxes"].shape == (2, 2, 4, 4)   # density² priors per pixel
+    assert (out["Boxes"] <= 1.5).all()
+
+
+def test_mine_hard_examples():
+    t = _T(); t.op_type = "mine_hard_examples"
+    loss = np.array([[0.1, 0.9, 0.5, 0.3]], dtype="float32")
+    match = np.array([[0, -1, -1, -1]], dtype="int32")   # 1 pos, 3 neg
+    out = t.run_op({"ClsLoss": loss, "MatchIndices": match},
+                   attrs={"neg_pos_ratio": 2.0},
+                   output_slots=("NegIndices", "UpdatedMatchIndices"))
+    # keep top-2 hardest negatives: positions 1 (0.9) and 2 (0.5)
+    np.testing.assert_array_equal(out["NegIndices"][0], [0, 1, 1, 0])
+
+
+def test_generate_proposals_shapes():
+    t = _T(); t.op_type = "generate_proposals"
+    rng = np.random.RandomState(0)
+    h = w = 4; a = 3; n = 2
+    scores = rng.rand(n, a, h, w).astype("float32")
+    deltas = (rng.randn(n, 4 * a, h, w) * 0.1).astype("float32")
+    im_info = np.array([[64, 64, 1.0]] * n, dtype="float32")
+    anchors = np.abs(rng.randn(h, w, a, 4)).astype("float32")
+    anchors[..., 2:] += anchors[..., :2] + 4.0
+    out = t.run_op({"Scores": scores, "BboxDeltas": deltas,
+                    "ImInfo": im_info, "Anchors": anchors},
+                   attrs={"pre_nms_topN": 24, "post_nms_topN": 8,
+                          "nms_thresh": 0.7},
+                   output_slots=("RpnRois", "RpnRoiProbs"))
+    assert out["RpnRois"].shape == (n, 8, 4)
+    assert out["RpnRoiProbs"].shape == (n, 8)
+    rois = out["RpnRois"]
+    assert (rois[..., 0] >= 0).all() and (rois[..., 2] <= 63).all()

@@ -1,0 +1,312 @@
+"""Numeric checks for the misc op families (OpTest contract, SURVEY §4.1)."""
+import numpy as np
+import pytest
+
+from op_test_base import OpTest
+
+
+class _T(OpTest):
+    pass
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype("float32")
+
+
+def test_hinge_loss():
+    t = _T(); t.op_type = "hinge_loss"
+    x = _r((4, 1)); y = (np.random.RandomState(1).rand(4, 1) > 0.5).astype("float32")
+    out = t.run_op({"Logits": x, "Labels": y}, output_slots=("Loss",))
+    np.testing.assert_allclose(out["Loss"],
+                               np.maximum(1 - x * (2 * y - 1), 0), rtol=1e-6)
+
+
+def test_rank_loss():
+    t = _T(); t.op_type = "rank_loss"
+    l, r = _r((5, 1), 1), _r((5, 1), 2)
+    lab = (np.random.RandomState(3).rand(5, 1) > 0.5).astype("float32")
+    out = t.run_op({"Label": lab, "Left": l, "Right": r})
+    np.testing.assert_allclose(out["Out"],
+                               np.log1p(np.exp(l - r)) - lab * (l - r),
+                               rtol=1e-5)
+
+
+def test_modified_huber_loss():
+    t = _T(); t.op_type = "modified_huber_loss"
+    x = _r((8, 1), 4)
+    y = (np.random.RandomState(5).rand(8, 1) > 0.5).astype("float32")
+    out = t.run_op({"X": x, "Y": y}, output_slots=("IntermediateVal", "Out"))
+    v = x * (2 * y - 1)
+    ref = np.where(v < -1, -4 * v, np.where(v < 1, (1 - v) ** 2, 0.0))
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-5)
+
+
+def test_bpr_loss():
+    t = _T(); t.op_type = "bpr_loss"
+    x = _r((4, 6), 7)
+    lab = np.random.RandomState(8).randint(0, 6, (4, 1)).astype("int64")
+    out = t.run_op({"X": x, "Label": lab}, output_slots=("Y",))
+    ref = np.zeros((4, 1), "float32")
+    for i in range(4):
+        p = x[i, lab[i, 0]]
+        ref[i, 0] = sum(np.log1p(np.exp(x[i, j] - p))
+                        for j in range(6) if j != lab[i, 0]) / 5
+    np.testing.assert_allclose(out["Y"], ref, rtol=1e-5)
+
+
+def test_squared_l2_distance():
+    t = _T(); t.op_type = "squared_l2_distance"
+    x, y = _r((3, 5), 1), _r((3, 5), 2)
+    out = t.run_op({"X": x, "Y": y}, output_slots=("sub_result", "Out"))
+    np.testing.assert_allclose(out["Out"],
+                               ((x - y) ** 2).sum(1, keepdims=True), rtol=1e-5)
+
+
+def test_label_smooth():
+    t = _T(); t.op_type = "label_smooth"
+    x = np.eye(4, dtype="float32")
+    out = t.run_op({"X": x}, attrs={"epsilon": 0.1})
+    np.testing.assert_allclose(out["Out"], 0.9 * x + 0.1 / 4, rtol=1e-6)
+
+
+def test_selu_and_grad():
+    t = _T(); t.op_type = "selu"
+    x = _r((6,), 3)
+    out = t.run_op({"X": x})
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    ref = scale * np.where(x > 0, x, alpha * (np.exp(x) - 1))
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-5)
+    t.check_grad({"X": x}, {}, "X", "Out")
+
+
+def test_norm():
+    t = _T(); t.op_type = "norm"
+    x = _r((3, 4), 2)
+    out = t.run_op({"X": x}, attrs={"axis": 1}, output_slots=("Out", "Norm"))
+    nrm = np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(out["Out"], x / nrm, rtol=1e-5)
+
+
+def test_multiplex():
+    t = _T(); t.op_type = "multiplex"
+    xs = [_r((4, 3), i) for i in range(3)]
+    ids = np.array([[2], [0], [1], [2]], dtype="int32")
+    out = t.run_op({"Ids": ids, "X": xs})
+    ref = np.stack([xs[ids[i, 0]][i] for i in range(4)])
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-6)
+
+
+def test_reverse_crop_pad():
+    t = _T(); t.op_type = "reverse"
+    x = _r((3, 4), 1)
+    out = t.run_op({"X": x}, attrs={"axis": [1]})
+    np.testing.assert_allclose(out["Out"], x[:, ::-1], rtol=1e-6)
+
+    t2 = _T(); t2.op_type = "crop"
+    out = t2.run_op({"X": x}, attrs={"offsets": [1, 1], "shape": [2, 2]})
+    np.testing.assert_allclose(out["Out"], x[1:3, 1:3], rtol=1e-6)
+
+    t3 = _T(); t3.op_type = "pad_constant_like"
+    y = _r((2, 2), 2)
+    out = t3.run_op({"X": x, "Y": y}, attrs={"pad_value": 0.5})
+    ref = np.full((3, 4), 0.5, "float32"); ref[:2, :2] = y
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-6)
+
+
+def test_space_to_depth_pixel_shuffle_roundtrip():
+    t = _T(); t.op_type = "space_to_depth"
+    x = _r((2, 3, 4, 4), 5)
+    out = t.run_op({"X": x}, attrs={"blocksize": 2})
+    assert out["Out"].shape == (2, 12, 2, 2)
+
+    t2 = _T(); t2.op_type = "pixel_shuffle"
+    y = _r((2, 8, 3, 3), 6)
+    out2 = t2.run_op({"X": y}, attrs={"upscale_factor": 2})
+    assert out2["Out"].shape == (2, 2, 6, 6)
+    # matches the torch/paddle pixel_shuffle reference
+    ref = y.reshape(2, 2, 2, 2, 3, 3).transpose(0, 1, 4, 2, 5, 3).reshape(2, 2, 6, 6)
+    np.testing.assert_allclose(out2["Out"], ref, rtol=1e-6)
+
+
+def test_shuffle_channel():
+    t = _T(); t.op_type = "shuffle_channel"
+    x = np.arange(2 * 6 * 1 * 1, dtype="float32").reshape(2, 6, 1, 1)
+    out = t.run_op({"X": x}, attrs={"group": 2})
+    ref = x.reshape(2, 2, 3, 1, 1).transpose(0, 2, 1, 3, 4).reshape(2, 6, 1, 1)
+    np.testing.assert_allclose(out["Out"], ref)
+
+
+def test_affine_channel():
+    t = _T(); t.op_type = "affine_channel"
+    x = _r((2, 3, 2, 2), 1)
+    s, b = _r((3,), 2), _r((3,), 3)
+    out = t.run_op({"X": x, "Scale": s, "Bias": b})
+    np.testing.assert_allclose(
+        out["Out"], x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1),
+        rtol=1e-5)
+
+
+def test_lrn():
+    t = _T(); t.op_type = "lrn"
+    x = _r((1, 6, 2, 2), 4)
+    out = t.run_op({"X": x}, attrs={"n": 5}, output_slots=("Out", "MidOut"))
+    # numpy reference
+    sq = x ** 2
+    pad = np.pad(sq, ((0, 0), (2, 2), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + 6] for i in range(5))
+    mid = 2.0 + 1e-4 * acc
+    np.testing.assert_allclose(out["Out"], x / mid ** 0.75, rtol=1e-5)
+
+
+def test_add_position_encoding():
+    t = _T(); t.op_type = "add_position_encoding"
+    x = np.zeros((1, 4, 6), "float32")
+    out = t.run_op({"X": x}, attrs={"alpha": 1.0, "beta": 1.0})
+    o = out["Out"]
+    pos = np.arange(4)[:, None]
+    i = np.arange(3)[None, :]
+    ang = pos / np.power(10000.0, 2.0 * i / 6)
+    ref = np.concatenate([np.sin(ang), np.cos(ang)], 1)[None]
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    t = _T(); t.op_type = "bilinear_tensor_product"
+    x, y = _r((3, 4), 1), _r((3, 5), 2)
+    w = _r((2, 4, 5), 3)
+    out = t.run_op({"X": x, "Y": y, "Weight": w})
+    ref = np.einsum("bi,kij,bj->bk", x, w, y)
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_row_conv():
+    t = _T(); t.op_type = "row_conv"
+    x = _r((2, 5, 3), 1)
+    w = _r((2, 3), 2)
+    out = t.run_op({"X": x, "Filter": w})
+    ref = np.zeros_like(x)
+    for ti in range(5):
+        for i in range(2):
+            if ti + i < 5:
+                ref[:, ti] += x[:, ti + i] * w[i]
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-5)
+
+
+def test_grid_sampler_identity():
+    t = _T(); t.op_type = "grid_sampler"
+    x = _r((1, 2, 4, 4), 3)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype("float32")
+    out = t.run_op({"X": x, "Grid": grid}, output_slots=("Output",))
+    np.testing.assert_allclose(out["Output"], x, rtol=1e-4, atol=1e-5)
+
+
+def test_interp_nearest_and_bilinear():
+    t = _T(); t.op_type = "nearest_interp"
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = t.run_op({"X": x}, attrs={"out_h": 2, "out_w": 2,
+                                    "align_corners": False})
+    np.testing.assert_allclose(out["Out"], x[:, :, ::2, ::2])
+
+    t2 = _T(); t2.op_type = "bilinear_interp"
+    out2 = t2.run_op({"X": x}, attrs={"out_h": 8, "out_w": 8,
+                                      "align_corners": False})
+    assert out2["Out"].shape == (1, 1, 8, 8)
+
+
+def test_max_pool2d_with_index_and_unpool():
+    t = _T(); t.op_type = "max_pool2d_with_index"
+    x = _r((1, 1, 4, 4), 9)
+    out = t.run_op({"X": x}, attrs={"ksize": [2, 2], "strides": [2, 2],
+                                    "paddings": [0, 0]},
+                   output_slots=("Out", "Mask"))
+    ref = x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5).reshape(
+        1, 1, 2, 2, 4).max(-1)
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-6)
+
+    t2 = _T(); t2.op_type = "unpool"
+    out2 = t2.run_op({"X": out["Out"], "Indices": out["Mask"]},
+                     attrs={"unpooled_size": [4, 4]})
+    up = out2["Out"]
+    # every max value lands back at its argmax position, zeros elsewhere
+    assert up.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(np.sort(up[up != 0]),
+                               np.sort(out["Out"].ravel()), rtol=1e-6)
+
+
+def test_pool3d():
+    t = _T(); t.op_type = "pool3d"
+    x = _r((1, 2, 4, 4, 4), 2)
+    out = t.run_op({"X": x}, attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                                    "paddings": [0, 0, 0],
+                                    "pooling_type": "max"})
+    assert out["Out"].shape == (1, 2, 2, 2, 2)
+    np.testing.assert_allclose(
+        out["Out"][0, 0, 0, 0, 0], x[0, 0, :2, :2, :2].max(), rtol=1e-6)
+
+
+def test_v2_aliases_emit_xshape():
+    t = _T(); t.op_type = "reshape2"
+    x = _r((2, 6), 1)
+    out = t.run_op({"X": x}, attrs={"shape": [3, 4]},
+                   output_slots=("Out", "XShape"))
+    np.testing.assert_allclose(out["Out"], x.reshape(3, 4))
+    assert out["XShape"].shape == (0, 2, 6)
+
+    t2 = _T(); t2.op_type = "transpose2"
+    out2 = t2.run_op({"X": x}, attrs={"axis": [1, 0]},
+                     output_slots=("Out", "XShape"))
+    np.testing.assert_allclose(out2["Out"], x.T)
+
+    t3 = _T(); t3.op_type = "unsqueeze2"
+    out3 = t3.run_op({"X": x}, attrs={"axes": [0]},
+                     output_slots=("Out", "XShape"))
+    assert out3["Out"].shape == (1, 2, 6)
+
+
+def test_cross_entropy2():
+    t = _T(); t.op_type = "cross_entropy2"
+    p = np.random.RandomState(2).dirichlet(np.ones(5), 4).astype("float32")
+    lab = np.random.RandomState(3).randint(0, 5, (4, 1)).astype("int64")
+    out = t.run_op({"X": p, "Label": lab},
+                   output_slots=("Y", "MatchX", "XShape"))
+    ref = -np.log([p[i, lab[i, 0]] for i in range(4)]).astype("float32")
+    np.testing.assert_allclose(out["Y"].ravel(), ref, rtol=1e-5)
+
+
+def test_mean_iou():
+    t = _T(); t.op_type = "mean_iou"
+    pred = np.array([[0, 1], [1, 1]], dtype="int32")
+    lab = np.array([[0, 1], [0, 1]], dtype="int32")
+    out = t.run_op({"Predictions": pred, "Labels": lab},
+                   attrs={"num_classes": 2},
+                   output_slots=("OutMeanIou", "OutWrong", "OutCorrect"))
+    # class0: inter 1, union 2 → 0.5 ; class1: inter 2, union 3 → 2/3
+    np.testing.assert_allclose(out["OutMeanIou"], [(0.5 + 2 / 3) / 2],
+                               rtol=1e-5)
+
+
+def test_temporal_shift():
+    t = _T(); t.op_type = "temporal_shift"
+    x = _r((4, 4, 2, 2), 6)   # N*T=4 with T=2
+    out = t.run_op({"X": x}, attrs={"seg_num": 2, "shift_ratio": 0.25})
+    assert out["Out"].shape == x.shape
+    v = x.reshape(2, 2, 4, 2, 2)
+    # first quarter shifted forward: out[:,0,0] = v[:,1,0]
+    np.testing.assert_allclose(out["Out"].reshape(2, 2, 4, 2, 2)[:, 0, 0],
+                               v[:, 1, 0], rtol=1e-6)
+
+
+def test_sampling_id_and_batch_size_like():
+    t = _T(); t.op_type = "sampling_id"
+    p = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], dtype="float32")
+    out = t.run_op({"X": p})
+    np.testing.assert_array_equal(out["Out"].astype(int), [1, 0])
+
+    t2 = _T(); t2.op_type = "uniform_random_batch_size_like"
+    ref = np.zeros((5, 3), "float32")
+    out2 = t2.run_op({"Input": ref}, attrs={"shape": [1, 7], "min": 0.0,
+                                            "max": 1.0})
+    assert out2["Out"].shape == (5, 7)
+    assert (out2["Out"] >= 0).all() and (out2["Out"] <= 1).all()

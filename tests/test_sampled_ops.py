@@ -1,0 +1,224 @@
+"""Sampled/tree classifiers, distributions, and batch-3 misc ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from op_test_base import OpTest
+
+
+class _T(OpTest):
+    pass
+
+
+def _r(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+def test_hierarchical_sigmoid_matches_bruteforce():
+    t = _T(); t.op_type = "hierarchical_sigmoid"
+    num_classes, d, b = 6, 4, 3
+    x = _r((b, d), 1)
+    w = _r((num_classes - 1, d), 2) * 0.3
+    bias = _r((num_classes - 1,), 3) * 0.1
+    lab = np.array([[0], [3], [5]], dtype="int64")
+    out = t.run_op({"X": x, "W": w, "Label": lab, "Bias": bias},
+                   attrs={"num_classes": num_classes},
+                   output_slots=("Out", "PreOut"))
+    # brute force: complete-tree code walk
+    import math
+    ref = np.zeros((b, 1), "float32")
+    for i in range(b):
+        code = int(lab[i, 0]) + num_classes
+        length = int(math.floor(math.log2(code)))
+        s = 0.0
+        for dpt in range(length):
+            shift = length - dpt - 1
+            node = (code >> (shift + 1)) - 1
+            bit = (code >> shift) & 1
+            z = (1 - 2 * bit) * (x[i] @ w[node] + bias[node])
+            s += np.log1p(np.exp(z))
+        ref[i, 0] = s
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_hsigmoid_layer_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1], dtype="int64")
+        cost = layers.hsigmoid(x, y, num_classes=10)
+        loss = layers.reduce_mean(cost)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 8).astype("float32"),
+            "y": rng.randint(0, 10, (16, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_nce_layer_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1], dtype="int64")
+        cost = layers.nce(x, y, num_total_classes=20, num_neg_samples=5)
+        loss = layers.reduce_mean(cost)
+        fluid.optimizer.SGD(0.2).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 8).astype("float32"),
+            "y": rng.randint(0, 20, (16, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_sampled_softmax_layer_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1], dtype="int64")
+        logits = layers.fc(x, 50)
+        loss = layers.reduce_mean(
+            layers.sampled_softmax_with_cross_entropy(logits, y,
+                                                      num_samples=10))
+        fluid.optimizer.SGD(0.2).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 8).astype("float32"),
+            "y": rng.randint(0, 50, (16, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(8)]
+    assert np.isfinite(losses).all()
+
+
+def test_edit_distance():
+    t = _T(); t.op_type = "edit_distance"
+    hyp = np.array([[1, 2, 3, -1], [4, 5, -1, -1]], dtype="int64")
+    ref = np.array([[1, 3, 3, -1], [4, 5, 6, -1]], dtype="int64")
+    out = t.run_op({"Hyps": hyp, "Refs": ref}, attrs={"normalized": False},
+                   output_slots=("Out", "SequenceNum"))
+    # row0: one substitution; row1: one insertion
+    np.testing.assert_allclose(out["Out"].ravel(), [1.0, 1.0])
+
+
+def test_ctc_align():
+    t = _T(); t.op_type = "ctc_align"
+    x = np.array([[0, 1, 1, 0, 2, 2, 0, 3]], dtype="int32")
+    out = t.run_op({"Input": x}, attrs={"blank": 0})
+    o = out["Out"][0]
+    got = o[o >= 0]
+    np.testing.assert_array_equal(got, [1, 2, 3])
+
+
+def test_cvm():
+    t = _T(); t.op_type = "cvm"
+    x = np.array([[3.0, 1.0, 7.0, 8.0]], dtype="float32")
+    out = t.run_op({"X": x}, attrs={"use_cvm": True}, output_slots=("Y",))
+    show = np.log(4.0)
+    ctr = np.log(2.0) - show
+    np.testing.assert_allclose(out["Y"], [[show, ctr, 7.0, 8.0]], rtol=1e-5)
+    out2 = t.run_op({"X": x}, attrs={"use_cvm": False}, output_slots=("Y",))
+    np.testing.assert_allclose(out2["Y"], [[7.0, 8.0]])
+
+
+def test_proximal_adagrad():
+    t = _T(); t.op_type = "proximal_adagrad"
+    p = np.ones((3,), "float32")
+    m = np.ones((3,), "float32")
+    g = np.full((3,), 0.5, "float32")
+    lr = np.array([0.1], "float32")
+    out = t.run_op({"Param": p, "Moment": m, "Grad": g, "LearningRate": lr},
+                   attrs={"l1": 0.0, "l2": 0.0},
+                   output_slots=("ParamOut", "MomentOut"))
+    m_ref = m + g * g
+    p_ref = p - 0.1 / np.sqrt(m_ref) * g
+    np.testing.assert_allclose(out["ParamOut"], p_ref, rtol=1e-5)
+
+
+def test_distributions_normal():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.distributions.Normal(0.0, 1.0)
+        q = layers.distributions.Normal(1.0, 2.0)
+        ent = p.entropy()
+        kl = p.kl_divergence(q)
+        lp = p.log_prob(layers.fill_constant([1], "float32", 0.0))
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            e, k, l = exe.run(main, feed={}, fetch_list=[ent, kl, lp])
+    np.testing.assert_allclose(e, 0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+    # KL(N(0,1)||N(1,2)) = ln2 + (1+1)/8 − 1/2
+    np.testing.assert_allclose(k, np.log(2.0) + 0.25 - 0.5, rtol=1e-5)
+    np.testing.assert_allclose(l, -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+
+def test_distributions_uniform_categorical():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        u = layers.distributions.Uniform(0.0, 2.0)
+        ue = u.entropy()
+        us = u.sample([64])
+        logits = layers.fill_constant([4], "float32", 0.0)
+        c = layers.distributions.Categorical(logits)
+        ce = c.entropy()
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            e, s, cent = exe.run(main, feed={}, fetch_list=[ue, us, ce])
+    np.testing.assert_allclose(e, np.log(2.0), rtol=1e-5)
+    assert (s >= 0).all() and (s <= 2).all()
+    np.testing.assert_allclose(cent, np.log(4.0), rtol=1e-4)
+
+
+def test_array_alias_ops():
+    t = _T(); t.op_type = "lod_reset"
+    x = _r((3, 2), 5)
+    out = t.run_op({"X": x}, attrs={"target_lod": [0, 1, 3]})
+    np.testing.assert_allclose(out["Out"], x)
+
+    t2 = _T(); t2.op_type = "max_sequence_len"
+    lens = np.array([3, 7, 2], dtype="int64")
+    out2 = t2.run_op({"RankTable": lens})
+    assert int(out2["Out"][0]) == 7
+
+    t3 = _T(); t3.op_type = "tensor_array_to_tensor"
+    arr = _r((3, 2, 2), 6)
+    out3 = t3.run_op({"X": arr}, attrs={"axis": 0, "use_stack": False},
+                     output_slots=("Out", "OutIndex"))
+    np.testing.assert_allclose(out3["Out"], arr.reshape(6, 2))
+
+
+def test_data_norm():
+    t = _T(); t.op_type = "data_norm"
+    x = _r((4, 3), 7)
+    size = np.full((3,), 10.0, "float32")
+    bsum = np.array([10.0, 20.0, 0.0], "float32")
+    bsq = np.array([20.0, 50.0, 10.0], "float32")
+    out = t.run_op({"X": x, "BatchSize": size, "BatchSum": bsum,
+                    "BatchSquareSum": bsq},
+                   output_slots=("Y", "Means", "Scales"))
+    means = bsum / size
+    scales = np.sqrt(size / (bsq - means * bsum + 1e-4 * size))
+    np.testing.assert_allclose(out["Y"], (x - means) * scales, rtol=1e-4)
+
+
+def test_edit_distance_short_hyp_long_ref():
+    """Pads must not substitute for insertions (review regression case)."""
+    t = _T(); t.op_type = "edit_distance"
+    hyp = np.array([[1, -1, -1]], dtype="int64")
+    ref = np.array([[2, 3, 4]], dtype="int64")
+    out = t.run_op({"Hyps": hyp, "Refs": ref}, attrs={"normalized": False},
+                   output_slots=("Out", "SequenceNum"))
+    np.testing.assert_allclose(out["Out"].ravel(), [3.0])
