@@ -89,12 +89,27 @@ class CompiledProgram:
         self.exec_strategy = exec_strategy or ExecutionStrategy()
         return self
 
-    def with_mesh(self, mesh: Mesh, data_axis: Optional[str] = "dp"):
+    def with_mesh(self, mesh: Mesh, data_axis: Optional[str] = "dp",
+                  strategy=None):
         """TPU-native extension: run over an arbitrary (dp, mp, pp, sp) mesh.
         Parameters carrying `shard_spec` are placed accordingly (Megatron-style
-        TP); everything else is replicated."""
+        TP); everything else is replicated. `strategy` (a fleet
+        DistributedStrategy) wires sharding_degree (ZeRO optimizer-state
+        sharding over the data axis) and recompute (remat)."""
         self._mesh = mesh
         self._data_axis = data_axis if data_axis in mesh.axis_names else None
+        self._zero_shard = False  # re-derived per call, never sticky
+        if strategy is not None:
+            if getattr(strategy, "sharding_degree", 1) > 1:
+                self._zero_shard = True
+            if getattr(strategy, "recompute", False):
+                bs = self.build_strategy or BuildStrategy()
+                bs.remat = True
+                self.build_strategy = bs
+            if getattr(strategy, "gradient_merge_steps", 1) > 1:
+                raise NotImplementedError(
+                    "gradient_merge_steps on DistributedStrategy is not "
+                    "wired; use fluid.optimizer.GradientMergeOptimizer")
         return self
 
     def with_inference_optimize(self, config=None):
@@ -106,6 +121,21 @@ class CompiledProgram:
         var = self._program.global_block()._find_var_recursive(name)
         spec = getattr(var, "shard_spec", None) if var is not None else None
         if spec is None:
+            # ZeRO-1 (DistributedStrategy.sharding_degree): optimizer
+            # accumulators shard dim 0 over the data axis — GSPMD inserts
+            # the gathers, the reference's sharding pass
+            # (fleet meta sharding) becomes a sharding annotation.
+            # Accumulators are tagged at creation (_add_accumulator) —
+            # robust against each optimizer's naming scheme.
+            if (getattr(self, "_zero_shard", False)
+                    and self._data_axis is not None and var is not None
+                    and getattr(var, "is_optimizer_state", False)
+                    and var.shape and len(var.shape) >= 1
+                    and var.shape[0] is not None and var.shape[0] > 0
+                    and var.shape[0] % self._mesh.shape[self._data_axis] == 0):
+                return NamedSharding(
+                    self._mesh, P(self._data_axis,
+                                  *([None] * (len(var.shape) - 1))))
             return NamedSharding(self._mesh, P())
         spec = P(*spec) if not isinstance(spec, P) else spec
         return NamedSharding(self._mesh, spec)
@@ -119,11 +149,12 @@ class CompiledProgram:
         block = self._program.global_block()
         mesh = self._mesh
         amp = getattr(self._program, "_amp", None)
+        remat = bool(self.build_strategy and self.build_strategy.remat)
 
         def step(state, feed, key):
             env = dict(state)
             env.update(feed)
-            ctx = ExecContext(key, mesh=mesh, amp=amp)
+            ctx = ExecContext(key, mesh=mesh, amp=amp, remat=remat)
             _run_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in out_state_names if n in env}
@@ -180,7 +211,10 @@ class CompiledProgram:
             if v.persistable and scope.has_var(v.name))
         out_state_names = sorted({v.name for v in program.list_vars() if v.persistable})
         feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype)) for n, v in feed_vals.items()))
-        key_sig = (program._version, feed_sig, tuple(fetch_names), tuple(state_names))
+        key_sig = (program._version, feed_sig, tuple(fetch_names),
+                   tuple(state_names),
+                   bool(self.build_strategy and self.build_strategy.remat),
+                   getattr(self, "_zero_shard", False))
         fn = self._cache.get(key_sig)
         if fn is None:
             fn = self._build(sorted(feed_vals), fetch_names, state_names, out_state_names)

@@ -87,11 +87,16 @@ def _make_key(seed: int):
 class ExecContext:
     """Per-trace context handed to op implementations."""
 
-    def __init__(self, key, is_test: bool = False, mesh=None, amp=None):
+    def __init__(self, key, is_test: bool = False, mesh=None, amp=None,
+                 remat: bool = False):
         self._key = key
         self.is_test = is_test
         self.mesh = mesh
         self.amp = amp  # {'dtype', 'white_list', 'black_list'} or None
+        # BuildStrategy.remat: op-level jax.checkpoint — recompute op
+        # internals in the backward instead of saving residuals (trades
+        # FLOPs for HBM; the win is on elementwise-heavy ops)
+        self.remat = remat
         self.tape: List[TapeEntry] = []
 
     def rng(self):
@@ -226,6 +231,8 @@ def _run_op(op, env: Dict[str, object], ctx: ExecContext):
             return tuple(flat_out)
 
         flat_in_vals = [v for s in in_slots for v in in_vals[s]]
+        if ctx.remat:
+            fn = jax.checkpoint(fn)
         flat_out_vals, vjp_fn = jax.vjp(fn, *flat_in_vals)
 
         out_names = []

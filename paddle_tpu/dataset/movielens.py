@@ -1,0 +1,55 @@
+"""MovieLens-1M readers (reference python/paddle/dataset/movielens.py:
+(user_id, gender, age, job, movie_id, categories, title_ids, rating))."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+MAX_USER_ID = 6040
+MAX_MOVIE_ID = 3952
+AGE_CLASSES = 7
+JOB_CLASSES = 21
+CATEGORY_CLASSES = 18
+TITLE_VOCAB = 5175
+
+
+def max_user_id():
+    return MAX_USER_ID
+
+
+def max_movie_id():
+    return MAX_MOVIE_ID
+
+
+def max_job_id():
+    return JOB_CLASSES - 1
+
+
+def _synthetic_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            user = int(rng.randint(1, MAX_USER_ID + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, AGE_CLASSES))
+            job = int(rng.randint(0, JOB_CLASSES))
+            movie = int(rng.randint(1, MAX_MOVIE_ID + 1))
+            cats = rng.randint(0, CATEGORY_CLASSES,
+                               rng.randint(1, 4)).astype("int64")
+            title = rng.randint(0, TITLE_VOCAB,
+                                rng.randint(2, 8)).astype("int64")
+            # preference structure: users and movies share latent parity
+            rating = float((user + movie) % 5 + 1)
+            yield [user], [gender], [age], [job], [movie], cats, title, \
+                [rating]
+
+    return reader
+
+
+def train(synthetic: bool = False):
+    return _synthetic_reader(1024, 0)
+
+
+def test(synthetic: bool = False):
+    return _synthetic_reader(256, 1)

@@ -1,0 +1,47 @@
+"""UCI housing readers (reference python/paddle/dataset/uci_housing.py:
+13 features, feature-normalized, 506 rows 80/20 split)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+FEATURE_NUM = 13
+
+
+def _load():
+    if not common.synthetic_enabled():
+        try:
+            path = common.download("", "uci_housing", save_name="housing.data")
+            data = np.loadtxt(path).astype("float32")
+        except IOError:
+            data = None
+    else:
+        data = None
+    if data is None:
+        rng = np.random.RandomState(7)
+        x = rng.randn(506, FEATURE_NUM).astype("float32")
+        w = rng.randn(FEATURE_NUM, 1).astype("float32")
+        y = x @ w + rng.randn(506, 1).astype("float32") * 0.1 + 22.0
+        data = np.concatenate([x, y], axis=1)
+    feats = data[:, :-1]
+    mn, mx = feats.min(0), feats.max(0)
+    feats = (feats - feats.mean(0)) / np.maximum(mx - mn, 1e-6)
+    return np.concatenate([feats, data[:, -1:]], axis=1)
+
+
+def _reader(lo, hi):
+    def reader():
+        data = _load()
+        for row in data[int(len(data) * lo):int(len(data) * hi)]:
+            yield row[:-1], row[-1:]
+
+    return reader
+
+
+def train():
+    return _reader(0.0, 0.8)
+
+
+def test():
+    return _reader(0.8, 1.0)

@@ -1,0 +1,42 @@
+"""WMT16 en-de readers (reference python/paddle/dataset/wmt16.py:
+BPE-tokenized pairs with <s>/<e>/<unk>; reader yields (src_ids, trg_ids,
+trg_next_ids))."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+_SRC_VOCAB = 2000
+_TRG_VOCAB = 2000
+BOS, EOS, UNK = 0, 1, 2
+
+
+def _synthetic_reader(n, seed, src_vocab, trg_vocab):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            L = int(rng.randint(4, 24))
+            src = rng.randint(3, src_vocab, L).astype("int64")
+            # a deterministic "translation": reversible affine token map
+            trg_core = ((src * 7 + 3) % (trg_vocab - 3) + 3).astype("int64")
+            trg = np.concatenate([[BOS], trg_core]).astype("int64")
+            trg_next = np.concatenate([trg_core, [EOS]]).astype("int64")
+            yield src, trg, trg_next
+
+    return reader
+
+
+def train(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+          src_lang="en", synthetic: bool = False):
+    return _synthetic_reader(512, 0, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+         src_lang="en", synthetic: bool = False):
+    return _synthetic_reader(128, 1, src_dict_size, trg_dict_size)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {i: f"{lang}{i}" for i in range(dict_size)}
+    return d if reverse else {v: k for k, v in d.items()}

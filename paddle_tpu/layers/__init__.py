@@ -22,6 +22,7 @@ from .sequence import (  # noqa: F401
     sequence_unpad,
 )
 from .control_flow import (  # noqa: F401
+    ConditionalBlock,
     DynamicRNN,
     IfElse,
     StaticRNN,

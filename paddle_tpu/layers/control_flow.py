@@ -126,6 +126,44 @@ class While:
         return False
 
 
+class ConditionalBlock:
+    """``with ConditionalBlock(cond):`` — body ops run only when `cond` is
+    True; parent vars written inside keep their old value otherwise
+    (reference layers/control_flow.py ConditionalBlock →
+    conditional_block_op.cc, lowered to lax.cond)."""
+
+    def __init__(self, cond: Variable, is_scalar_condition: bool = True,
+                 name=None):
+        self.helper = LayerHelper("conditional_block", name=name)
+        self.cond_var = cond
+        self._parent = None
+        self._block = None
+
+    def block(self):
+        return self
+
+    def __enter__(self):
+        prog = self.helper.main_program
+        self._parent = prog.current_block()
+        self._block = prog.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        prog = self.helper.main_program
+        prog.rollback()
+        if exc_type is not None:
+            return False
+        reads = _external_reads(self._block, self._parent)
+        writes = _parent_writes(self._block, self._parent)
+        carried = list(dict.fromkeys(reads + writes))
+        self._parent.append_op(
+            type="conditional_block",
+            inputs={"Cond": [self.cond_var.name], "X": carried},
+            outputs={"Out": carried},
+            attrs={"sub_block": self._block, "var_names": carried})
+        return False
+
+
 # ---------------------------------------------------------------------------
 # Switch
 # ---------------------------------------------------------------------------

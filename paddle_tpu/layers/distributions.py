@@ -194,14 +194,17 @@ class MultivariateNormalDiag(Distribution):
             tensor_layers.fill_constant([1], "float32", c))
 
     def kl_divergence(self, other: "MultivariateNormalDiag"):
+        # covariance convention (matches entropy and the reference):
+        # KL = ½ Σ_i [ σp_i/σq_i + Δμ_i²/σq_i − 1 + ln σq_i − ln σp_i ]
         sp, sq = self._diag(), other._diag()
-        var_ratio = ops_layers.elementwise_div(sp, sq)
-        var_ratio = ops_layers.elementwise_mul(var_ratio, var_ratio)
+        ratio = ops_layers.elementwise_div(sp, sq)
         d = ops_layers.elementwise_sub(self.loc, other.loc)
-        t = ops_layers.elementwise_div(ops_layers.elementwise_mul(d, d),
-                                       ops_layers.elementwise_mul(sq, sq))
+        maha = ops_layers.elementwise_div(
+            ops_layers.elementwise_mul(d, d), sq)
         inner = ops_layers.elementwise_sub(
-            ops_layers.elementwise_add(var_ratio, t),
+            ops_layers.elementwise_add(ratio, maha),
             tensor_layers.fill_constant([1], "float32", 1.0))
-        inner = ops_layers.elementwise_sub(inner, ops_layers.log(var_ratio))
+        inner = ops_layers.elementwise_add(
+            inner, ops_layers.elementwise_sub(
+                ops_layers.log(sq), ops_layers.log(sp)))
         return ops_layers.scale(reduce_layers.reduce_sum(inner), scale=0.5)

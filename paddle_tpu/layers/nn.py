@@ -566,6 +566,10 @@ def sampled_softmax_with_cross_entropy(logits: Variable, label: Variable,
     """Sampled softmax CE (reference layers/nn.py
     sampled_softmax_with_cross_entropy → sample_logits_op.cc + softmax CE
     over [true + sampled] classes)."""
+    if use_customized_samples:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: use_customized_samples is "
+            "not supported — only the uniform sampler is implemented")
     helper = LayerHelper("sampled_softmax_with_cross_entropy", name=name)
     sampled_logits = helper.create_variable_for_type_inference(logits.dtype)
     sampled_label = helper.create_variable_for_type_inference(

@@ -366,10 +366,11 @@ def _psroi_pool(ctx, inputs, attrs):
                 mx = (xs >= xs0) & (xs < xs1)
                 mask = (my[:, None] & mx[None, :]).astype(x.dtype)
                 cnt = jnp.maximum(mask.sum(), 1.0)
-                for co in range(oc):
-                    ch = (co * ph + by) * pw + bx
-                    out = out.at[co, by, bx].set(
-                        (img[ch] * mask).sum() / cnt)
+                # all oc position-sensitive channels of this bin in one
+                # strided gather (keeps the trace O(ph·pw), not O(oc·ph·pw))
+                chans = (jnp.arange(oc) * ph + by) * pw + bx
+                vals = (img[chans] * mask[None]).sum((1, 2)) / cnt
+                out = out.at[:, by, bx].set(vals)
         return out
 
     return {"Out": [jax.vmap(one_roi)(rois.astype(jnp.float32))]}

@@ -68,6 +68,9 @@ class Optimizer:
             dtype=dtype or dtype_str(param.dtype),
             name=f"{param.name}_{self._name}_{name}",
             initializer=ConstantInitializer(fill_value))
+        # marks the var for ZeRO optimizer-state sharding
+        # (compiler._state_sharding) — robust against accumulator naming
+        acc.is_optimizer_state = True
         self._accumulators[key] = acc
         return acc
 
@@ -90,12 +93,16 @@ class Optimizer:
 
     def apply_gradients(self, params_grads) -> List:
         prog = default_main_program()
-        block = prog.global_block()
+        # update ops go to the CURRENT block so predicated optimizers
+        # (GradientMergeOptimizer's conditional_block) contain them;
+        # accumulator VARS still live in the global block (persistable)
+        block = prog.current_block()
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         params_grads = append_regularization_ops(params_grads, self.regularization)
         self._create_global_learning_rate()
-        self._create_accumulators(block, [p for p, g in params_grads])
+        self._create_accumulators(prog.global_block(),
+                                  [p for p, g in params_grads])
         ops = []
         for pg in params_grads:
             ops.append(self._append_optimize_op(block, pg))
@@ -708,6 +715,74 @@ class PipelineOptimizer:
 
     def apply_gradients(self, *a, **kw):
         return self._opt.apply_gradients(*a, **kw)
+
+
+class GradientMergeOptimizer:
+    """Accumulate gradients for k steps, apply the inner optimizer once per
+    k with the averaged gradient (DistributedStrategy.gradient_merge
+    capability; newer-reference GradientMergeOptimizer semantics).
+
+    TPU-native lowering: per-param accumulator vars + a step counter; the
+    inner optimizer's update ops run inside a `conditional_block` guarded by
+    (step % k == 0), so XLA compiles the whole thing into one predicated
+    step — no host-side control flow."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self._opt = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import control_flow as cf  # noqa: F401 (While import)
+        from .layers import tensor as tensor_layers
+        from .layers import ops as ops_layers
+
+        if self._k == 1:
+            return self._opt.minimize(loss, startup_program, parameter_list,
+                                      no_grad_set)
+        program = loss.block.program
+        params_grads = self._opt.backward(loss, startup_program,
+                                          parameter_list, no_grad_set)
+        helper = LayerHelper("gradient_merge")
+        counter = helper.create_global_variable(
+            [1], "int64", name="gradient_merge_step",
+            initializer=ConstantInitializer(0.0))
+        block = program.global_block()
+        one_v = tensor_layers.fill_constant([1], "int64", 1)
+        k_v = tensor_layers.fill_constant([1], "int64", self._k)
+        new_count = ops_layers.elementwise_add(counter, one_v)
+        new_count = ops_layers.elementwise_mod(new_count, k_v)
+        tensor_layers.assign(new_count, counter)
+        apply_now = ops_layers.equal(
+            new_count, tensor_layers.fill_constant([1], "int64", 0))
+
+        merged = []
+        for p, g in params_grads:
+            acc = helper.create_global_variable(
+                list(p.shape), p.dtype, name=f"{p.name}@GradientMerge",
+                initializer=ConstantInitializer(0.0))
+            acc_new = ops_layers.elementwise_add(acc, g)
+            tensor_layers.assign(acc_new, acc)
+            merged.append((p, acc))
+
+        # predicated apply: inner optimizer ops + accumulator reset run in a
+        # sub-block gated on (step % k == 0)
+        with cf.ConditionalBlock(apply_now):
+            eff = []
+            for p, acc in merged:
+                g_eff = ops_layers.scale(acc, scale=1.0 / self._k) \
+                    if self._avg else acc
+                eff.append((p, g_eff))
+            optimize_ops = self._opt.apply_gradients(eff)
+            for p, acc in merged:
+                tensor_layers.assign(ops_layers.scale(acc, scale=0.0), acc)
+        return optimize_ops, params_grads
+
+    def backward(self, *a, **kw):
+        return self._opt.backward(*a, **kw)
 
 
 class ModelAverage(Optimizer):

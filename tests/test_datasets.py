@@ -1,0 +1,87 @@
+"""Canned dataset readers + book-style end-to-end smokes
+(reference tests/book/test_fit_a_line.py, test_recognize_digits.py shapes;
+readers run synthetic in this no-egress environment)."""
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("PADDLE_TPU_SYNTHETIC_DATA", "1")
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset, layers
+from paddle_tpu import reader as rd
+
+
+def test_reader_shapes():
+    img, lab = next(dataset.mnist.train()())
+    assert img.shape == (784,) and 0 <= lab < 10
+    assert img.min() >= -1.0 and img.max() <= 1.0
+
+    x, y = next(dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+
+    row, lab = next(dataset.cifar.train10()())
+    assert row.shape == (3072,) and 0 <= lab < 10
+
+    ids, lab = next(dataset.imdb.train()())
+    assert ids.ndim == 1 and lab in (0, 1)
+
+    src, trg, nxt = next(dataset.wmt16.train()())
+    assert len(trg) == len(nxt)
+    assert trg[0] == dataset.wmt16.BOS and nxt[-1] == dataset.wmt16.EOS
+
+    sample = next(dataset.movielens.train()())
+    assert len(sample) == 8
+
+
+def test_fit_a_line_book():
+    """reference book/test_fit_a_line.py: linear regression on uci_housing
+    converges."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+
+    batched = rd.batch(dataset.uci_housing.train(), batch_size=32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(4):
+            for batch in batched():
+                xs = np.stack([b[0] for b in batch])
+                ys = np.stack([b[1] for b in batch])
+                if xs.shape[0] != 32:
+                    continue
+                losses.append(float(exe.run(
+                    main, feed={"x": xs, "y": ys}, fetch_list=[loss])[0]))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+
+
+def test_recognize_digits_book():
+    """reference book/test_recognize_digits.py: LeNet on mnist reader, loss
+    decreases and accuracy beats chance on the synthetic digits."""
+    from paddle_tpu.models import lenet
+
+    main, startup, feeds, loss, acc = lenet.build_train_program(lr=0.01)
+    batched = rd.batch(dataset.mnist.train(), batch_size=64)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        accs, losses = [], []
+        for _ in range(3):
+            for batch in batched():
+                xs = np.stack([b[0] for b in batch]).reshape(-1, 1, 28, 28)
+                ys = np.asarray([[b[1]] for b in batch], "int64")
+                if xs.shape[0] != 64:
+                    continue
+                l, a = exe.run(main, feed={"img": xs, "label": ys},
+                               fetch_list=[loss, acc])
+                losses.append(float(l))
+                accs.append(float(a))
+    assert losses[-1] < losses[0]
+    assert np.mean(accs[-5:]) > 0.5   # well above 10% chance

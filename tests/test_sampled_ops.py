@@ -222,3 +222,20 @@ def test_edit_distance_short_hyp_long_ref():
     out = t.run_op({"Hyps": hyp, "Refs": ref}, attrs={"normalized": False},
                    output_slots=("Out", "SequenceNum"))
     np.testing.assert_allclose(out["Out"].ravel(), [3.0])
+
+
+def test_mvn_diag_kl_covariance_convention():
+    """KL uses the covariance-matrix convention consistently with entropy
+    (review regression: p=MVN(0,[[4]]), q=MVN(0,[[1]]) → 0.5(4−1−ln4))."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.distributions.MultivariateNormalDiag(
+            np.zeros(1, "float32"), np.array([[4.0]], "float32"))
+        q = layers.distributions.MultivariateNormalDiag(
+            np.zeros(1, "float32"), np.array([[1.0]], "float32"))
+        kl = p.kl_divergence(q)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (k,) = exe.run(main, feed={}, fetch_list=[kl])
+    np.testing.assert_allclose(k, 0.5 * (4 - 1 - np.log(4.0)), rtol=1e-5)

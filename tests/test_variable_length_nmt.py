@@ -1,0 +1,169 @@
+"""Variable-length sequence story (SURVEY §7 hard part #1, VERDICT r1 weak
+#8): bucketing reader + padding-invariant Transformer-NMT training across
+bucket shapes."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as rd
+from paddle_tpu.models import transformer_nmt as nmt
+
+
+def test_bucket_by_sequence_length_groups_and_pads():
+    samples = [[1] * L for L in (3, 5, 9, 4, 15, 2, 8)]
+
+    def src():
+        return iter(samples)
+
+    bucketed = rd.bucket_by_sequence_length(src, [4, 8, 16], batch_sizes=2,
+                                            pad_value=0)
+    batches = list(bucketed())
+    shapes = sorted(b.shape for b, lens in batches)
+    # lengths 3,4,2 → bucket 4; 5,8 → bucket 8; 9,15 → bucket 16
+    assert (2, 4) in shapes and (2, 8) in shapes and (2, 16) in shapes
+    for b, lens in batches:
+        for row, L in zip(b, lens):
+            assert row[:L].sum() == L          # ones kept
+            assert row[L:].sum() == 0          # zero padding
+
+
+def test_bucket_multi_field_samples():
+    def src():
+        yield ([1, 2, 3], [7, 8])
+        yield ([4, 5], [9, 9, 9])
+
+    bucketed = rd.bucket_by_sequence_length(src, [4], batch_sizes=2,
+                                            pad_value=-1)
+    ((f0, f1), lens), = list(bucketed())
+    assert f0.shape == (2, 4) and f1.shape == (2, 4)
+    np.testing.assert_array_equal(lens, [3, 2])
+    assert (f0[0, 3:] == -1).all()
+
+
+def _masks(src_ids, tgt_ids, pad=0):
+    b, ts = src_ids.shape
+    tt = tgt_ids.shape[1]
+    src_keep = (src_ids != pad).astype("float32")
+    src_mask = ((src_keep - 1.0) * 1e4).reshape(b, 1, 1, ts)
+    tgt_keep = (tgt_ids != pad).astype("float32")
+    causal = np.tril(np.ones((tt, tt), "float32"))
+    m = np.minimum(causal[None], tgt_keep[:, None, :])
+    tgt_mask = ((m - 1.0) * 1e4).reshape(b, 1, tt, tt)
+    return src_mask, tgt_mask
+
+
+def _feed_for(src, tgt):
+    lbl = np.concatenate([tgt[:, 1:], np.zeros((tgt.shape[0], 1), "int64")],
+                         axis=1)[..., None]
+    sm, tm = _masks(src, tgt)
+    return {"src_ids": src, "tgt_ids": tgt, "lbl_ids": lbl,
+            "src_mask": sm, "tgt_mask": tm}
+
+
+def test_nmt_padding_invariance_and_bucketed_training():
+    """The padded+mask representation preserves the reference's LoD
+    semantics: extra padding must not change the loss; training runs
+    across several bucket shapes (one compile per bucket)."""
+    cfg = nmt.TransformerConfig(src_vocab=64, tgt_vocab=64, d_model=16,
+                                n_heads=2, d_ff=32, n_enc=1, n_dec=1,
+                                dropout=0.0, max_len=16)
+
+    rng = np.random.RandomState(0)
+    src8 = rng.randint(1, 64, (2, 8)).astype("int64")
+    tgt8 = rng.randint(1, 64, (2, 8)).astype("int64")
+    # same content padded out to 12
+    src12 = np.zeros((2, 12), "int64"); src12[:, :8] = src8
+    tgt12 = np.zeros((2, 12), "int64"); tgt12[:, :8] = tgt8
+
+    losses = {}
+    for L, (s, t) in {8: (src8, tgt8), 12: (src12, tgt12)}.items():
+        main, startup, feeds, loss = nmt.build_train_program(
+            cfg, src_len=L, tgt_len=L, is_test=True)
+        with fluid.scope_guard(fluid.Scope()):
+            main.random_seed = 5
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            losses[L] = float(exe.run(main, feed=_feed_for(s, t),
+                                      fetch_list=[loss])[0])
+    # same tokens, different padding → same masked loss... up to the fresh
+    # random init (programs share seeds via startup.random_seed)
+    # so instead run both through the SAME params: rebuild with seed
+    # equality is enforced by seeding below.
+    # (init differs → only check finiteness here; strict invariance next)
+    assert np.isfinite(list(losses.values())).all()
+
+    # strict padding invariance under SHARED params: evaluate the 12-padded
+    # feed twice from identically-seeded fresh params (the train program
+    # steps its optimizer each run, so both evals start from init), once
+    # with junk tokens in the padding — the mask must make them irrelevant
+    main, startup, feeds, loss = nmt.build_train_program(
+        cfg, src_len=12, tgt_len=12, is_test=True)
+    startup.random_seed = 11
+
+    def eval_once(src):
+        feed = _feed_for(src, tgt12)
+        feed["src_mask"], feed["tgt_mask"] = _masks(src12, tgt12)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            return float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+
+    l_zero = eval_once(src12)
+    junk_src = src12.copy(); junk_src[:, 8:] = 63
+    l_junk = eval_once(junk_src)
+    np.testing.assert_allclose(l_zero, l_junk, rtol=1e-5)
+
+    # bucketed TRAINING loop: batches at two bucket shapes through two
+    # compiled programs, loss decreases within each bucket
+    progs = {}
+    for L in (8, 16):
+        main, startup, feeds, loss = nmt.build_train_program(
+            cfg, src_len=L, tgt_len=L)
+        progs[L] = (main, startup, loss)
+
+    def gen():
+        rng2 = np.random.RandomState(1)
+        for _ in range(8):
+            L = int(rng2.choice([5, 7, 11, 14]))
+            pair = (rng2.randint(1, 64, L).astype("int64"),
+                    rng2.randint(1, 64, L).astype("int64"))
+            yield pair
+
+    bucketed = rd.bucket_by_sequence_length(
+        gen, [8, 16], batch_sizes=2, pad_value=0)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        for L in progs:
+            exe.run(progs[L][1])
+        curves = {8: [], 16: []}
+        for _ in range(3):      # epochs over the same tiny stream
+            for (srcs, tgts), lens in bucketed():
+                L = srcs.shape[1]
+                main, _, loss = progs[L]
+                out = exe.run(main, feed=_feed_for(srcs, tgts),
+                              fetch_list=[loss])
+                curves[L].append(float(out[0]))
+    for L, c in curves.items():
+        assert len(c) >= 2, f"bucket {L} never ran"
+        assert c[-1] < c[0], (L, c)
+
+
+def test_bucket_scalar_and_cross_length_fields():
+    """Review regressions: scalar second fields stack unpadded; a field
+    longer than the bucketed field's bound pads to the next boundary."""
+    def src():
+        yield (np.array([1, 2, 3]), 1)          # scalar label
+        yield (np.array([4, 5]), 0)
+
+    bucketed = rd.bucket_by_sequence_length(src, [4], batch_sizes=2)
+    ((ids, labs), lens), = list(bucketed())
+    assert ids.shape == (2, 4) and labs.shape == (2,)
+
+    def nmt_pairs():
+        yield (np.array([1, 2]), np.array([5, 6, 7, 8, 9, 10]))
+        yield (np.array([3]), np.array([6, 7]))
+
+    bucketed = rd.bucket_by_sequence_length(nmt_pairs, [4, 8], batch_sizes=2)
+    ((srcs, tgts), lens), = list(bucketed())
+    assert srcs.shape == (2, 4)      # bucketed by src
+    assert tgts.shape == (2, 8)      # tgt overflows → next boundary
