@@ -1,0 +1,5 @@
+"""Model-compression toolkit (reference python/paddle/fluid/contrib/slim/:
+quantization QAT + post-training, magnitude pruning, distillation losses).
+NAS (simulated-annealing search over closed-source infra) is a documented
+non-goal; the search-space utilities live in .nas."""
+from . import distillation, nas, prune, quantization  # noqa: F401

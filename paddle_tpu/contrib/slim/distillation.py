@@ -1,0 +1,48 @@
+"""Distillation losses (reference contrib/slim/distillation/distiller.py:
+FSPDistiller, L2Distiller, SoftLabelDistiller — graph-building helpers)."""
+from __future__ import annotations
+
+from ...layers import nn as nn_layers
+from ...layers import ops as ops_layers
+from ...layers import reduce as reduce_layers
+
+
+def l2_distill_loss(teacher_var, student_var):
+    """L2Distiller.distiller_loss parity."""
+    d = ops_layers.elementwise_sub(teacher_var, student_var)
+    return reduce_layers.reduce_mean(ops_layers.elementwise_mul(d, d))
+
+
+def soft_label_distill_loss(teacher_logits, student_logits,
+                            teacher_temperature: float = 2.0,
+                            student_temperature: float = 2.0):
+    """SoftLabelDistiller parity: CE(softmax(t/Tt), log_softmax(s/Ts))."""
+    t = nn_layers.softmax(ops_layers.scale(
+        teacher_logits, scale=1.0 / teacher_temperature))
+    s = nn_layers.softmax(ops_layers.scale(
+        student_logits, scale=1.0 / student_temperature))
+    logp = ops_layers.log(ops_layers.elementwise_add(
+        s, ops_layers.scale(s, scale=0.0, bias=1e-10)))
+    prod = ops_layers.elementwise_mul(t, logp)
+    return ops_layers.scale(
+        reduce_layers.reduce_mean(reduce_layers.reduce_sum(prod, dim=-1)),
+        scale=-1.0)
+
+
+def fsp_loss(t_feat_a, t_feat_b, s_feat_a, s_feat_b):
+    """FSPDistiller parity: match flow-of-solution-procedure matrices
+    G = A·Bᵀ/(H·W) between teacher and student feature pairs ([N,C,H,W])."""
+    def fsp_matrix(a, b):
+        from ..  import __name__ as _  # keep import-light
+        from ...layers import tensor as tensor_layers
+        n, c1 = a.shape[0], a.shape[1]
+        c2 = b.shape[1]
+        hw = a.shape[2] * a.shape[3]
+        af = tensor_layers.reshape(a, [n, c1, hw])
+        bf = tensor_layers.reshape(b, [n, c2, hw])
+        g = nn_layers.matmul(af, bf, transpose_y=True, alpha=1.0 / hw)
+        return g
+
+    d = ops_layers.elementwise_sub(fsp_matrix(t_feat_a, t_feat_b),
+                                   fsp_matrix(s_feat_a, s_feat_b))
+    return reduce_layers.reduce_mean(ops_layers.elementwise_mul(d, d))

@@ -1,0 +1,53 @@
+"""Light NAS search (reference contrib/slim/nas/ — simulated-annealing
+search over a token-encoded architecture space; the reference's
+compute-cluster controller/worker split is a non-goal, the SEARCH itself is
+here)."""
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, List, Sequence
+
+
+class SearchSpace:
+    """Token-vector search space: tokens[i] ∈ [0, range_table[i])."""
+
+    def __init__(self, range_table: Sequence[int]):
+        self.range_table = list(range_table)
+
+    def random_tokens(self, rng: random.Random) -> List[int]:
+        return [rng.randrange(r) for r in self.range_table]
+
+    def mutate(self, tokens: Sequence[int], rng: random.Random) -> List[int]:
+        out = list(tokens)
+        i = rng.randrange(len(out))
+        out[i] = rng.randrange(self.range_table[i])
+        return out
+
+
+class SAController:
+    """Simulated-annealing controller (reference sa_nas SAController):
+    accept worse candidates with prob exp(−Δ/T), geometric cooling."""
+
+    def __init__(self, space: SearchSpace, reward_fn: Callable,
+                 init_temperature: float = 1.0, reduce_rate: float = 0.9,
+                 seed: int = 0):
+        self.space = space
+        self.reward_fn = reward_fn
+        self.T = init_temperature
+        self.reduce_rate = reduce_rate
+        self.rng = random.Random(seed)
+
+    def search(self, steps: int = 20):
+        best = cur = self.space.random_tokens(self.rng)
+        best_r = cur_r = self.reward_fn(cur)
+        for _ in range(steps):
+            cand = self.space.mutate(cur, self.rng)
+            r = self.reward_fn(cand)
+            if r > cur_r or self.rng.random() < math.exp(
+                    min((r - cur_r) / max(self.T, 1e-9), 0.0)):
+                cur, cur_r = cand, r
+            if r > best_r:
+                best, best_r = cand, r
+            self.T *= self.reduce_rate
+        return best, best_r

@@ -1,0 +1,49 @@
+"""Magnitude pruning (reference contrib/slim/prune/pruner.py Pruner /
+SensitivePruneStrategy capability, redesigned functional).
+
+`magnitude_prune(scope, params, ratio)` zeroes the smallest-|w| entries and
+returns {name: mask}; `apply_masks` re-applies masks after optimizer steps
+(the reference strategy's mask-maintenance loop)."""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def magnitude_prune(scope, param_names: Sequence[str], ratio: float,
+                    structured_axis=None) -> Dict[str, np.ndarray]:
+    masks = {}
+    for name in param_names:
+        w = np.asarray(scope.find_var(name))
+        if structured_axis is None:
+            k = int(w.size * ratio)
+            thresh = np.partition(np.abs(w).ravel(), k)[k] if k > 0 else -1.0
+            mask = (np.abs(w) > thresh).astype(w.dtype)
+        else:
+            norms = np.sqrt((w ** 2).sum(
+                axis=tuple(i for i in range(w.ndim) if i != structured_axis)))
+            k = int(norms.size * ratio)
+            thresh = np.partition(norms, k)[k] if k > 0 else -1.0
+            keep = norms > thresh
+            shape = [1] * w.ndim
+            shape[structured_axis] = -1
+            mask = np.broadcast_to(keep.reshape(shape), w.shape).astype(w.dtype)
+        masks[name] = mask
+        scope.set_var(name, w * mask)
+    return masks
+
+
+def apply_masks(scope, masks: Dict[str, np.ndarray]):
+    for name, mask in masks.items():
+        w = np.asarray(scope.find_var(name))
+        scope.set_var(name, w * mask)
+
+
+def sparsity(scope, param_names: Sequence[str]) -> float:
+    total = nz = 0
+    for name in param_names:
+        w = np.asarray(scope.find_var(name))
+        total += w.size
+        nz += int((w != 0).sum())
+    return 1.0 - nz / max(total, 1)

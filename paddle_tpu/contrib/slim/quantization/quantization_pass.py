@@ -1,0 +1,205 @@
+"""QAT / post-training quantization program rewrites.
+
+Reference analog: ``python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py`` (QuantizationTransformPass — insert fake quant/dequant
+around quantizable ops; QuantizationFreezePass; AddQuantDequantPass) and
+``contrib/quantize/quantize_transpiler.py``.
+
+TPU-native: the rewrite edits the op list in place (no ir::Graph clone):
+for each quantizable op, weight inputs get abs-max (or channel-wise)
+quant-dequant and activation inputs get moving-average abs-max quant-dequant;
+all fake-quant ops backprop with the straight-through estimator
+(ops/quant_ops.py), so `minimize` after the pass trains quantization-aware.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ....core.program import Operator, Program
+from ....initializer import ConstantInitializer
+from ....layer_helper import LayerHelper
+
+QUANTIZABLE_OPS = {"mul", "matmul", "conv2d", "depthwise_conv2d"}
+_WEIGHT_SLOTS = {"mul": "Y", "matmul": "Y", "conv2d": "Filter",
+                 "depthwise_conv2d": "Filter"}
+_ACT_SLOTS = {"mul": "X", "matmul": "X", "conv2d": "Input",
+              "depthwise_conv2d": "Input"}
+
+
+class QuantizationTransformPass:
+    """Insert simulated-quant ops for QAT (reference
+    QuantizationTransformPass.apply)."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 moving_rate: float = 0.9, quantizable_ops=None):
+        if activation_quantize_type not in ("moving_average_abs_max",
+                                            "range_abs_max", "abs_max"):
+            raise ValueError(activation_quantize_type)
+        if weight_quantize_type not in ("abs_max",
+                                        "channel_wise_abs_max"):
+            raise ValueError(weight_quantize_type)
+        self.wbits = weight_bits
+        self.abits = activation_bits
+        self.act_type = activation_quantize_type
+        self.w_type = weight_quantize_type
+        self.moving_rate = moving_rate
+        self.quantizable = set(quantizable_ops or QUANTIZABLE_OPS)
+
+    def _insert_quant(self, block, idx, var_name, bits, kind, helper):
+        """Insert a fake-quant op before ops[idx]; returns new var name and
+        number of ops inserted."""
+        v = block._find_var_recursive(var_name)
+        out = block.create_var(
+            name=f"{var_name}.quantized", shape=getattr(v, "shape", None),
+            dtype=getattr(v, "dtype", "float32"), persistable=False)
+        scale_out = block.create_var(
+            name=f"{var_name}.quant_scale.tmp", shape=[1], dtype="float32",
+            persistable=False, stop_gradient=True)
+        if kind == "abs_max":
+            op = Operator(block, "fake_quantize_abs_max",
+                          {"X": [var_name]},
+                          {"Out": [out.name], "OutScale": [scale_out.name]},
+                          {"bit_length": bits})
+        elif kind == "channel_wise_abs_max":
+            scale_out.shape = None
+            op = Operator(block, "fake_channel_wise_quantize_abs_max",
+                          {"X": [var_name]},
+                          {"Out": [out.name], "OutScale": [scale_out.name]},
+                          {"bit_length": bits})
+        else:  # moving_average_abs_max / range_abs_max: stateful scale var
+            state = helper.create_global_variable(
+                [1], "float32", name=f"{var_name}.quant_scale",
+                initializer=ConstantInitializer(0.001))
+            op_type = ("fake_quantize_moving_average_abs_max"
+                       if kind == "moving_average_abs_max"
+                       else "fake_quantize_range_abs_max")
+            op = Operator(block, op_type,
+                          {"X": [var_name], "InScale": [state.name]},
+                          {"Out": [out.name], "OutScale": [state.name]},
+                          {"bit_length": bits,
+                           "moving_rate": self.moving_rate})
+        block.ops.insert(idx, op)
+        return out.name
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block()
+        helper = LayerHelper("quantization")
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self.quantizable:
+                i += 1
+                continue
+            inserted = 0
+            wslot = _WEIGHT_SLOTS[op.type]
+            aslot = _ACT_SLOTS[op.type]
+            for slot, bits, kind in ((wslot, self.wbits, self.w_type),
+                                     (aslot, self.abits, self.act_type)):
+                names = op.inputs.get(slot, [])
+                if not names:
+                    continue
+                name = names[0]
+                if name.endswith(".quantized"):
+                    continue
+                v = block._find_var_recursive(name)
+                if slot == wslot and not (v is not None and v.persistable):
+                    # weight slot fed by an activation (e.g. matmul(a, b)):
+                    # still quantize, but as an activation
+                    kind = self.act_type
+                    bits = self.abits
+                new = self._insert_quant(block, i + inserted, name, bits,
+                                         kind, helper)
+                op.inputs[slot] = [new] + names[1:]
+                inserted += 1
+            i += inserted + 1
+        program._bump_version()
+        return program
+
+
+class AddQuantDequantPass(QuantizationTransformPass):
+    """Reference AddQuantDequantPass: activation-only quant-dequant for ops
+    outside the matmul/conv family (elementwise_add, pool2d)."""
+
+    def __init__(self, quantizable_ops=("elementwise_add", "pool2d"),
+                 activation_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(activation_bits=activation_bits,
+                         moving_rate=moving_rate,
+                         quantizable_ops=quantizable_ops)
+        self._acts_only = set(quantizable_ops)
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block()
+        helper = LayerHelper("quant_dequant")
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self._acts_only:
+                i += 1
+                continue
+            inserted = 0
+            for slot in sorted(op.inputs):
+                names = op.inputs.get(slot, [])
+                if not names or names[0].endswith(".quantized"):
+                    continue
+                v = block._find_var_recursive(names[0])
+                if v is None or v.persistable or getattr(v, "is_data", False):
+                    continue
+                new = self._insert_quant(block, i + inserted, names[0],
+                                         self.abits,
+                                         "moving_average_abs_max", helper)
+                op.inputs[slot] = [new] + names[1:]
+                inserted += 1
+            i += inserted + 1
+        program._bump_version()
+        return program
+
+
+def post_training_quantize(program: Program, executor, feeds: List[Dict],
+                           scope=None, weight_bits: int = 8,
+                           activation_bits: int = 8):
+    """Post-training quantization (reference PostTrainingQuantization):
+    run calibration feeds through the FP program collecting abs-max
+    activation ranges, then rewrite with fixed-scale quant-dequant ops.
+
+    Returns {var_name: scale} calibration table; `program` is rewritten in
+    place with abs_max fake-quant (scales baked by calibration via the
+    range_abs_max ops' max tracking)."""
+    from ....core.scope import _scope
+
+    scope = scope or _scope()
+    # 1) collect activation ranges: fetch every quantizable input
+    block = program.global_block()
+    act_names = []
+    for op in block.ops:
+        if op.type in QUANTIZABLE_OPS:
+            aslot = _ACT_SLOTS[op.type]
+            ns = op.inputs.get(aslot, [])
+            if ns:
+                act_names.append(ns[0])
+    act_names = list(dict.fromkeys(act_names))
+    ranges = {n: 0.0 for n in act_names}
+    for feed in feeds:
+        outs = executor.run(program, feed=feed, fetch_list=act_names)
+        for n, v in zip(act_names, outs):
+            ranges[n] = max(ranges[n], float(np.max(np.abs(v))))
+
+    # 2) QAT-style rewrite with range_abs_max, scales seeded from calibration.
+    # The rewrite runs under program_guard(program, patch_startup) so the
+    # new scale state vars land in `program` with init ops we can execute.
+    from ....core.program import Program, program_guard
+
+    patch_startup = Program()
+    pass_ = QuantizationTransformPass(
+        weight_bits=weight_bits, activation_bits=activation_bits,
+        activation_quantize_type="range_abs_max")
+    with program_guard(program, patch_startup):
+        pass_.apply(program)
+    executor.run(patch_startup, scope=scope)
+    for n, r in ranges.items():
+        scope.set_var(f"{n}.quant_scale",
+                      np.asarray([max(r, 1e-8)], np.float32))
+    return ranges

@@ -98,14 +98,13 @@ class CompiledProgram:
         sharding over the data axis) and recompute (remat)."""
         self._mesh = mesh
         self._data_axis = data_axis if data_axis in mesh.axis_names else None
-        self._zero_shard = False  # re-derived per call, never sticky
-        if strategy is not None:
+        self._zero_shard = False       # re-derived per call, never sticky
+        self._strategy_remat = False   # ditto; build_strategy.remat is the
+        if strategy is not None:       # user's own knob and is left alone
             if getattr(strategy, "sharding_degree", 1) > 1:
                 self._zero_shard = True
             if getattr(strategy, "recompute", False):
-                bs = self.build_strategy or BuildStrategy()
-                bs.remat = True
-                self.build_strategy = bs
+                self._strategy_remat = True
             if getattr(strategy, "gradient_merge_steps", 1) > 1:
                 raise NotImplementedError(
                     "gradient_merge_steps on DistributedStrategy is not "
@@ -149,7 +148,8 @@ class CompiledProgram:
         block = self._program.global_block()
         mesh = self._mesh
         amp = getattr(self._program, "_amp", None)
-        remat = bool(self.build_strategy and self.build_strategy.remat)
+        remat = bool((self.build_strategy and self.build_strategy.remat)
+                     or getattr(self, "_strategy_remat", False))
 
         def step(state, feed, key):
             env = dict(state)
@@ -213,7 +213,8 @@ class CompiledProgram:
         feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype)) for n, v in feed_vals.items()))
         key_sig = (program._version, feed_sig, tuple(fetch_names),
                    tuple(state_names),
-                   bool(self.build_strategy and self.build_strategy.remat),
+                   bool((self.build_strategy and self.build_strategy.remat)
+                        or getattr(self, "_strategy_remat", False)),
                    getattr(self, "_zero_shard", False))
         fn = self._cache.get(key_sig)
         if fn is None:

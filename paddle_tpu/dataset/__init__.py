@@ -7,5 +7,4 @@ InMemoryDataset / QueueDataset — re-exported from .factory). Without
 network egress the canned readers fall back to deterministic synthetic data
 with the real shapes/vocab sizes."""
 from . import cifar, common, imdb, mnist, movielens, uci_housing, wmt16  # noqa: F401
-from .factory import *  # noqa: F401,F403
 from .factory import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401

@@ -37,7 +37,9 @@ def _reader_creator(archive, sub_name, classes, n_synth, seed,
                         for row, lab in zip(data, labs):
                             yield row, int(lab)
                 return
-            except IOError:
+            except Exception:
+                # corrupt/partial cache (tarfile.ReadError, bad pickle,
+                # directory members) falls back like a cache miss
                 pass
         imgs, labels = _synthetic(n_synth, classes, seed)
         for row, lab in zip(imgs, labels):

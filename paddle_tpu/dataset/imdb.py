@@ -30,9 +30,13 @@ def _synthetic_reader(n, seed):
     return reader
 
 
-def train(word_idx=None, synthetic: bool = False):
+# NOTE: real-archive parsing is not implemented for imdb in this
+# no-egress environment — the readers are synthetic-only (deterministic,
+# polarity-correlated); mnist/cifar/uci_housing DO honor a pre-seeded cache.
+
+def train(word_idx=None):
     return _synthetic_reader(512, 0)
 
 
-def test(word_idx=None, synthetic: bool = False):
+def test(word_idx=None):
     return _synthetic_reader(128, 1)

@@ -47,7 +47,7 @@ def _reader(image_file, label_file, n_synth, seed, synthetic):
                 images, labels = _parse_idx(
                     common.download("", "mnist", save_name=image_file),
                     common.download("", "mnist", save_name=label_file))
-            except IOError:
+            except Exception:  # cache miss or corrupt files → synthetic
                 images, labels = _synthetic(n_synth, seed)
         for img, lab in zip(images, labels):
             yield img, int(lab)

@@ -47,9 +47,11 @@ def _synthetic_reader(n, seed):
     return reader
 
 
-def train(synthetic: bool = False):
+# NOTE: synthetic-only in this no-egress environment (see imdb.py note).
+
+def train():
     return _synthetic_reader(1024, 0)
 
 
-def test(synthetic: bool = False):
+def test():
     return _synthetic_reader(256, 1)

@@ -27,13 +27,15 @@ def _synthetic_reader(n, seed, src_vocab, trg_vocab):
     return reader
 
 
+# NOTE: synthetic-only in this no-egress environment (see imdb.py note).
+
 def train(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
-          src_lang="en", synthetic: bool = False):
+          src_lang="en"):
     return _synthetic_reader(512, 0, src_dict_size, trg_dict_size)
 
 
 def test(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
-         src_lang="en", synthetic: bool = False):
+         src_lang="en"):
     return _synthetic_reader(128, 1, src_dict_size, trg_dict_size)
 
 

@@ -22,6 +22,7 @@ from . import (  # noqa: F401
     nn_ops,
     optimizer_ops,
     pipeline_ops,
+    quant_ops,
     reduce_ops,
     rnn_ops,
     sampled_ops,

@@ -1,0 +1,121 @@
+"""Simulated-quantization (QAT) ops.
+
+Reference analogs: ``paddle/fluid/operators/fake_quantize_op.cc`` (
+fake_quantize_abs_max, fake_quantize_range_abs_max,
+fake_quantize_moving_average_abs_max, fake_channel_wise_quantize_abs_max,
+moving_average_abs_max_scale) and ``fake_dequantize_op.cc``.
+
+TPU-native: quant-dequant round trips stay in float (the MXU runs bf16;
+int8 inference is simulated), and every fake-quant op uses the
+straight-through estimator via the registry's grad_fn hook — the cotangent
+passes through the rounding untouched (the reference achieves the same by
+registering the ops gradient-free and letting QAT graphs wire grads around
+them)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _quant_dequant(x, scale, bits):
+    bnt = (1 << (bits - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) / bnt * s
+
+
+def _ste_grad(attrs):
+    """Straight-through estimator: dX = dOut (rounding treated as id)."""
+    def grad(ctx, inputs, attrs2, outputs, out_cots):
+        g = out_cots["Out"][0]
+        return {"X": [g]}
+    return grad
+
+
+@register_op("fake_quantize_abs_max", grad_fn=_ste_grad,
+             nondiff_inputs=[])
+def _fake_quantize_abs_max(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_quant_dequant(x, scale, bits)],
+            "OutScale": [lax.stop_gradient(scale.reshape(1))]}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", grad_fn=_ste_grad)
+def _fake_cw_quantize_abs_max(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    bits = int(attrs.get("bit_length", 8))
+    axes = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=axes)          # per out-channel (dim 0)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    return {"Out": [_quant_dequant(x, scale.reshape(shape), bits)],
+            "OutScale": [lax.stop_gradient(scale)]}
+
+
+@register_op("fake_quantize_moving_average_abs_max", grad_fn=_ste_grad,
+             nondiff_inputs=["InScale", "InAccum", "InState"])
+def _fake_quantize_ma_abs_max(ctx, inputs, attrs):
+    """activation quant: scale tracked by moving average of |x|max."""
+    (x,) = inputs["X"]
+    (in_scale,) = inputs["InScale"]
+    bits = int(attrs.get("bit_length", 8))
+    momentum = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = in_scale.reshape(())
+        new_scale = in_scale
+    else:
+        scale = momentum * in_scale.reshape(()) + (1.0 - momentum) * cur
+        new_scale = scale.reshape(1)
+    return {"Out": [_quant_dequant(x, scale, bits)],
+            "OutScale": [lax.stop_gradient(jnp.reshape(new_scale, (1,)))]}
+
+
+@register_op("fake_quantize_range_abs_max", grad_fn=_ste_grad,
+             nondiff_inputs=["InScale", "Iter"])
+def _fake_quantize_range_abs_max(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (in_scale,) = inputs["InScale"]
+    bits = int(attrs.get("bit_length", 8))
+    cur = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = in_scale.reshape(())
+    else:
+        scale = jnp.maximum(in_scale.reshape(()), cur)
+    return {"Out": [_quant_dequant(x, scale, bits)],
+            "OutScale": [lax.stop_gradient(scale.reshape(1))]}
+
+
+@register_op("moving_average_abs_max_scale", differentiable=False)
+def _moving_average_abs_max_scale(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (in_scale,) = inputs["InScale"]
+    momentum = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    scale = momentum * in_scale.reshape(()) + (1.0 - momentum) * cur
+    return {"Out": [x], "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    (scale,) = inputs["Scale"]
+    bnt = (1 << (int(attrs.get("max_range_bits", 8)) - 1)) - 1
+    max_range = attrs.get("max_range", float(bnt))
+    return {"Out": [x * scale.reshape(()) / max_range]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs")
+def _fake_cw_dequantize_max_abs(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    scales = inputs["Scales"]
+    quant_bits = attrs.get("quant_bits", [8])
+    out = x
+    for s, b in zip(scales, quant_bits):
+        shape = (-1,) + (1,) * (x.ndim - 1) if s.ndim == 1 and s.shape[0] == x.shape[0] \
+            else (1,) * x.ndim
+        out = out * s.reshape(shape) / float((1 << (int(b) - 1)) - 1)
+    return {"Out": [out]}

@@ -727,6 +727,8 @@ class GradientMergeOptimizer:
     (step % k == 0), so XLA compiles the whole thing into one predicated
     step — no host-side control flow."""
 
+    _uid = 0
+
     def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
         if k_steps < 1:
             raise ValueError("k_steps must be >= 1")
@@ -747,10 +749,13 @@ class GradientMergeOptimizer:
         params_grads = self._opt.backward(loss, startup_program,
                                           parameter_list, no_grad_set)
         helper = LayerHelper("gradient_merge")
+        # unique per instance: two merged optimizers in one program (e.g.
+        # GAN D/G) must not share a counter
+        GradientMergeOptimizer._uid += 1
         counter = helper.create_global_variable(
-            [1], "int64", name="gradient_merge_step",
+            [1], "int64",
+            name=f"gradient_merge_step_{GradientMergeOptimizer._uid}",
             initializer=ConstantInitializer(0.0))
-        block = program.global_block()
         one_v = tensor_layers.fill_constant([1], "int64", 1)
         k_v = tensor_layers.fill_constant([1], "int64", self._k)
         new_count = ops_layers.elementwise_add(counter, one_v)

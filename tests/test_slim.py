@@ -1,0 +1,175 @@
+"""contrib/slim: QAT rewrite, post-training quant, pruning, distillation
+(reference contrib/slim/quantization/quantization_pass.py + slim tests)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from op_test_base import OpTest
+
+
+def test_fake_quantize_abs_max_roundtrip_and_ste():
+    t = OpTest(); t.op_type = "fake_quantize_abs_max"
+    x = np.array([[-2.0, 0.5, 1.0, 0.124]], dtype="float32")
+    out = t.run_op({"X": x}, attrs={"bit_length": 8},
+                   output_slots=("Out", "OutScale"))
+    scale = 2.0
+    ref = np.round(np.clip(x / scale, -1, 1) * 127) / 127 * scale
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-6)
+    np.testing.assert_allclose(out["OutScale"], [2.0])
+    # STE: ANALYTIC gradient of sum(out) wrt x is exactly 1 everywhere
+    # (finite differences see the rounding staircase, so compare directly)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [4])
+        block = main.global_block()
+        o = block.create_var(name="q_out", dtype="float32")
+        sc = block.create_var(name="q_scale", dtype="float32",
+                              stop_gradient=True)
+        block.append_op("fake_quantize_abs_max", {"X": ["x"]},
+                        {"Out": ["q_out"], "OutScale": ["q_scale"]},
+                        {"bit_length": 8})
+        loss = layers.reduce_sum(block.var("q_out"))
+        (gx,) = fluid.gradients([loss], [xv])
+        exe = fluid.Executor(fluid.CPUPlace())
+        (gv,) = exe.run(main, feed={"x": x}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, np.ones_like(x))
+
+
+def test_channel_wise_quant():
+    t = OpTest(); t.op_type = "fake_channel_wise_quantize_abs_max"
+    w = np.stack([np.full((4,), 1.0, "float32"),
+                  np.full((4,), 4.0, "float32")])
+    out = t.run_op({"X": w}, attrs={"bit_length": 8},
+                   output_slots=("Out", "OutScale"))
+    np.testing.assert_allclose(out["OutScale"], [1.0, 4.0])
+    np.testing.assert_allclose(out["Out"], w, rtol=1e-2)
+
+
+def _qat_program(quant=True):
+    from paddle_tpu.contrib.slim.quantization import QuantizationTransformPass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 4
+        x = layers.data("x", [8])
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, 16, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        if quant:
+            QuantizationTransformPass().apply(main)
+        fluid.optimizer.Adam(0.02).minimize(loss)
+    return main, startup, loss
+
+
+def test_qat_trains_and_quantizes():
+    main, startup, loss = _qat_program(quant=True)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("fake_quantize_abs_max") == 2          # two weights
+    assert types.count("fake_quantize_moving_average_abs_max") == 2
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(32, 8).astype("float32"),
+            "y": rng.randint(0, 4, (32, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(10)]
+        # activation scale state got tracked
+        scales = [np.asarray(fluid.global_scope().find_var(n))
+                  for n in fluid.global_scope().var_names()
+                  if n.endswith(".quant_scale")]
+    assert losses[-1] < losses[0], losses
+    assert scales and all(s > 0 for s in scales)
+
+
+def test_qat_close_to_fp_on_eval():
+    """8-bit QAT loss starts near the FP32 loss (same seed init)."""
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(32, 8).astype("float32"),
+            "y": rng.randint(0, 4, (32, 1)).astype("int64")}
+    vals = {}
+    for quant in (False, True):
+        main, startup, loss = _qat_program(quant)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            vals[quant] = float(exe.run(main, feed=feed,
+                                        fetch_list=[loss])[0])
+    np.testing.assert_allclose(vals[False], vals[True], rtol=0.05)
+
+
+def test_post_training_quantize():
+    from paddle_tpu.contrib.slim.quantization import post_training_quantize
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        h = layers.fc(x, 4, act="relu")
+        out = layers.fc(h, 2)
+    rng = np.random.RandomState(1)
+    feeds = [{"x": rng.rand(8, 8).astype("float32")} for _ in range(3)]
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fp = exe.run(main, feed=feeds[0], fetch_list=[out])[0]
+        ranges = post_training_quantize(main, exe, feeds)
+        q = exe.run(main, feed=feeds[0], fetch_list=[out])[0]
+    assert ranges and all(r > 0 for r in ranges.values())
+    np.testing.assert_allclose(fp, q, rtol=0.1, atol=0.05)
+
+
+def test_magnitude_prune_and_masks():
+    from paddle_tpu.contrib.slim import prune
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        h = layers.fc(x, 16, param_attr=fluid.ParamAttr(name="pw"))
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        scope = fluid.global_scope()
+        masks = prune.magnitude_prune(scope, ["pw"], ratio=0.5)
+        s = prune.sparsity(scope, ["pw"])
+        assert 0.4 <= s <= 0.6, s
+        # masks survive a fake "update"
+        scope.set_var("pw", np.asarray(scope.find_var("pw")) + 1.0)
+        prune.apply_masks(scope, masks)
+        w = np.asarray(scope.find_var("pw"))
+        assert ((w == 0) == (masks["pw"] == 0)).all()
+
+
+def test_distill_losses():
+    from paddle_tpu.contrib.slim import distillation as ds
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        t = layers.data("t", [4])
+        s = layers.data("s", [4])
+        l2 = ds.l2_distill_loss(t, s)
+        soft = ds.soft_label_distill_loss(t, s)
+    rng = np.random.RandomState(0)
+    tv = rng.rand(3, 4).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        l2v, softv = exe.run(main, feed={"t": tv, "s": tv},
+                             fetch_list=[l2, soft])
+        l2d, _ = exe.run(main, feed={"t": tv, "s": tv * 0.1},
+                         fetch_list=[l2, soft])
+    np.testing.assert_allclose(l2v, 0.0, atol=1e-7)
+    assert l2d > 0
+    assert np.isfinite(softv)
+
+
+def test_nas_sa_controller():
+    from paddle_tpu.contrib.slim.nas import SAController, SearchSpace
+
+    space = SearchSpace([4, 4, 4])
+    target = [3, 2, 1]
+    ctrl = SAController(space, lambda tk: -sum(abs(a - b) for a, b in
+                                               zip(tk, target)),
+                        seed=0)
+    best, best_r = ctrl.search(steps=60)
+    assert best_r >= -2          # close to the optimum (0)
