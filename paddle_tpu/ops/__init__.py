@@ -28,5 +28,6 @@ from . import (  # noqa: F401
     sampled_ops,
     sequence_ops,
     tensor_ops,
+    vision_ops,
 )
 from .eager import call as eager_call  # noqa: F401

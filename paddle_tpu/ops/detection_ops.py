@@ -573,3 +573,457 @@ def _generate_proposals(ctx, inputs, attrs):
 
     rois, rscores = jax.vmap(per_image)(scores, deltas, im_info)
     return {"RpnRois": [rois], "RpnRoiProbs": [rscores]}
+
+
+# ---------------------------------------------------------------------------
+# Round-2 detection family: RPN/RetinaNet target assignment, FPN routing,
+# YOLOv3 loss, mAP metric. References: rpn_target_assign_op.cc,
+# retinanet_detection_output_op.cc, collect_fpn_proposals_op.cc,
+# distribute_fpn_proposals_op.cc, generate_proposal_labels_op.cc,
+# yolov3_loss_op.cc, detection_map_op.cc. All static-shape: samplers emit
+# fixed-size index/target tensors padded with -1 / zeros, the XLA-friendly
+# stand-in for the reference's dynamic LoD row counts.
+# ---------------------------------------------------------------------------
+
+
+def _iou_matrix(a, b):
+    """Pairwise IoU [Na, Nb] for corner-format boxes."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+def _encode_deltas(anchors, gt):
+    """Box → regression-delta encoding shared by RPN/RetinaNet assign."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + gw * 0.5
+    gcy = gt[:, 1] + gh * 0.5
+    return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      jnp.log(jnp.maximum(gw / aw, 1e-10)),
+                      jnp.log(jnp.maximum(gh / ah, 1e-10))], axis=-1)
+
+
+def _topk_mask(score, mask, k):
+    """Boolean mask selecting (up to) the k highest-`score` entries of `mask`."""
+    s = jnp.where(mask, score, -jnp.inf)
+    order = jnp.argsort(-s)
+    rank = jnp.zeros(s.shape[0], jnp.int32).at[order].set(jnp.arange(s.shape[0]))
+    return mask & (rank < k)
+
+
+@register_op("rpn_target_assign", differentiable=False)
+def _rpn_target_assign(ctx, inputs, attrs):
+    """rpn_target_assign_op.cc: label anchors as fg (IoU>pos_thr or per-gt
+    argmax) / bg (IoU<neg_thr), subsample to a fixed batch, emit per-anchor
+    labels [-1 ignore / 0 bg / 1 fg] and bbox regression targets (dense
+    [N, A, ...] — static-shape form of the reference's gathered LoD rows)."""
+    (anchors,) = inputs["Anchor"]          # [A, 4]
+    (gt_boxes,) = inputs["GtBoxes"]        # [N, G, 4] (zero rows padded)
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+
+    def per_image(gt, key):
+        valid_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+        iou = jnp.where(valid_gt[None, :], _iou_matrix(anchors, gt), -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # per-gt argmax anchors are always fg; .max (logical-or) so a
+        # padding gt whose argmax ties to the same anchor can't clear it
+        gt_best_anchor = jnp.argmax(iou, axis=0)                   # [G]
+        forced = jnp.zeros(anchors.shape[0], bool)
+        forced = forced.at[gt_best_anchor].max(valid_gt)
+        fg = forced | (best_iou >= pos_thr)
+        bg = (best_iou < neg_thr) & (best_iou >= 0) & ~fg
+        # subsample with random tie-break scores
+        kf, kb = jax.random.split(key)
+        n_fg = int(batch * fg_frac)
+        fg = _topk_mask(jax.random.uniform(kf, (anchors.shape[0],)), fg, n_fg)
+        n_bg = batch - n_fg
+        bg = _topk_mask(jax.random.uniform(kb, (anchors.shape[0],)), bg, n_bg)
+        labels = jnp.where(fg, 1, jnp.where(bg, 0, -1)).astype(jnp.int32)
+        tgt = _encode_deltas(anchors, gt[best_gt])
+        tgt = jnp.where(fg[:, None], tgt, 0.0)
+        # gather indices (reference ScoreIndex/LocationIndex contract):
+        # sampled-anchor positions, valid entries first, padded with 0 —
+        # mask padding via TargetLabel (padded rows have label -1 there)
+        prio = jnp.where(fg, 2.0, jnp.where(bg, 1.0, 0.0))
+        _, score_idx = jax.lax.top_k(prio, batch)
+        score_idx = jnp.where((fg | bg)[score_idx], score_idx, 0).astype(jnp.int32)
+        _, loc_idx = jax.lax.top_k(jnp.where(fg, 1.0, 0.0), n_fg)
+        loc_idx = jnp.where(fg[loc_idx], loc_idx, 0).astype(jnp.int32)
+        return labels, tgt, score_idx, loc_idx
+
+    n = gt_boxes.shape[0]
+    n_fg = int(batch * fg_frac)
+    keys = jax.random.split(ctx.rng(), n)
+    labels, targets, score_idx, loc_idx = jax.vmap(per_image)(gt_boxes, keys)
+    return {"ScoreIndex": [score_idx], "LocationIndex": [loc_idx],
+            "TargetLabel": [labels], "TargetBBox": [targets],
+            "BBoxInsideWeight": [(labels == 1).astype(jnp.float32)]}
+
+
+@register_op("retinanet_target_assign", differentiable=False)
+def _retinanet_target_assign(ctx, inputs, attrs):
+    """retinanet_target_assign (rpn_target_assign_op.cc:~500): like RPN
+    assign but no subsampling (focal loss owns the imbalance) and class
+    labels come from GtLabels."""
+    (anchors,) = inputs["Anchor"]
+    (gt_boxes,) = inputs["GtBoxes"]        # [N, G, 4]
+    (gt_labels,) = inputs["GtLabels"]      # [N, G]
+    pos_thr = attrs.get("positive_overlap", 0.5)
+    neg_thr = attrs.get("negative_overlap", 0.4)
+
+    def per_image(gt, gl):
+        valid_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+        iou = jnp.where(valid_gt[None, :], _iou_matrix(anchors, gt), -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        gt_best_anchor = jnp.argmax(iou, axis=0)
+        forced = jnp.zeros(anchors.shape[0], bool).at[gt_best_anchor].max(valid_gt)
+        fg = forced | (best_iou >= pos_thr)
+        bg = (best_iou < neg_thr) & (best_iou >= 0) & ~fg
+        cls = jnp.where(fg, gl[best_gt].astype(jnp.int32), jnp.where(bg, 0, -1))
+        tgt = jnp.where(fg[:, None], _encode_deltas(anchors, gt[best_gt]), 0.0)
+        return cls, tgt, fg
+
+    labels, targets, fg = jax.vmap(per_image)(gt_boxes, gt_labels)
+    fg_num = jnp.maximum(jnp.sum(fg, axis=1), 1).astype(jnp.int32)
+    return {"TargetLabel": [labels], "TargetBBox": [targets],
+            "BBoxInsideWeight": [fg.astype(jnp.float32)], "ForegroundNumber": [fg_num]}
+
+
+@register_op("retinanet_detection_output", differentiable=False)
+def _retinanet_detection_output(ctx, inputs, attrs):
+    """retinanet_detection_output_op.cc: decode per-FPN-level (score, delta,
+    anchor) triples, take per-level top-k, merge, class-wise NMS → padded
+    [N, keep_top_k, 6] (label, score, x1, y1, x2, y2)."""
+    scores_l = inputs["Scores"]            # list of [N, A_l, C]
+    deltas_l = inputs["BBoxes"]            # list of [N, A_l, 4]
+    anchors_l = inputs["Anchors"]          # list of [A_l, 4]
+    (im_info,) = inputs["ImInfo"]          # [N, 3]
+    score_thr = attrs.get("score_threshold", 0.05)
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_thr = attrs.get("nms_threshold", 0.3)
+
+    def decode(anchors, deltas):
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+    def per_image(scs, dls, info):
+        # scs/dls: tuples with one [A_l, C] / [A_l, 4] entry per FPN level
+        boxes_all, scores_all = [], []
+        for sc, dl, anc in zip(scs, dls, anchors_l):
+            k = min(nms_top_k, sc.shape[0])
+            flat = jnp.max(sc, axis=1)                   # best class per anchor
+            _, idx = jax.lax.top_k(flat, k)
+            boxes_all.append(decode(anc[idx], dl[idx]))
+            scores_all.append(sc[idx])
+        boxes = jnp.concatenate(boxes_all)               # [M, 4]
+        scores = jnp.concatenate(scores_all)             # [M, C]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 1], 0, info[0] - 1),
+                           jnp.clip(boxes[:, 2], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 3], 0, info[0] - 1)], -1)
+        outs = []
+        C = scores.shape[1]
+        for c in range(C):
+            keep = _nms_single(boxes, scores[:, c], nms_thr, score_thr, keep_top_k)
+            s = jnp.where(keep, scores[:, c], -1.0)
+            outs.append(jnp.concatenate(
+                [jnp.full((s.shape[0], 1), float(c)), s[:, None], boxes], -1))
+        det = jnp.concatenate(outs)                      # [C*M, 6]
+        _, top = jax.lax.top_k(det[:, 1], keep_top_k)
+        return det[top]
+
+    # vmap over the batch axis of every level tensor at once (the levels
+    # stay a python tuple; anchors are per-level constants closed over)
+    det = jax.vmap(per_image)(tuple(scores_l), tuple(deltas_l), im_info)
+    return one(det)
+
+
+@register_op("collect_fpn_proposals", differentiable=False)
+def _collect_fpn_proposals(ctx, inputs, attrs):
+    """collect_fpn_proposals_op.cc: concat per-level (rois, scores), keep
+    global post_nms_topN by score. Padded [N, topN, 4]."""
+    rois_l = inputs["MultiLevelRois"]      # list of [N, R_l, 4]
+    scores_l = inputs["MultiLevelScores"]  # list of [N, R_l]
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    rois = jnp.concatenate(rois_l, axis=1)
+    scores = jnp.concatenate(scores_l, axis=1)
+    k = min(post_n, scores.shape[1])
+    top_s, idx = jax.lax.top_k(scores, k)
+    out = jnp.take_along_axis(rois, idx[..., None], axis=1)
+    return {"FpnRois": [out], "RoisNum": [jnp.sum(top_s > -jnp.inf, 1).astype(jnp.int32)]}
+
+
+@register_op("distribute_fpn_proposals", differentiable=False)
+def _distribute_fpn_proposals(ctx, inputs, attrs):
+    """distribute_fpn_proposals_op.cc: route each RoI to FPN level
+    lvl = floor(refer_level + log2(sqrt(area)/refer_scale)); emit per-level
+    roi tensors (same static shape, non-members zeroed + mask) and the
+    restore index."""
+    (rois,) = inputs["FpnRois"]            # [R, 4]
+    min_l = int(attrs.get("min_level", 2))
+    max_l = int(attrs.get("max_level", 5))
+    refer_l = int(attrs.get("refer_level", 4))
+    refer_s = float(attrs.get("refer_scale", 224))
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(refer_l + jnp.log2(scale / refer_s + 1e-8))
+    lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+    outs, masks = [], []
+    for l in range(min_l, max_l + 1):
+        m = lvl == l
+        outs.append(jnp.where(m[:, None], rois, 0.0))
+        masks.append(m)
+    # restore index against OUR uncompacted layout: original row i lives at
+    # row (lvl_i - min_level) * R + i of concat(MultiFpnRois), so
+    # gather(concat(MultiFpnRois), RestoreIndex) == FpnRois
+    r = rois.shape[0]
+    restore = ((lvl - min_l) * r + jnp.arange(r, dtype=jnp.int32)).astype(jnp.int32)
+    return {"MultiFpnRois": outs,
+            "MultiLevelMask": [jnp.stack(masks)],
+            "RestoreIndex": [restore]}
+
+
+@register_op("generate_proposal_labels", differentiable=False)
+def _generate_proposal_labels(ctx, inputs, attrs):
+    """generate_proposal_labels_op.cc: sample a fixed-size batch of RoIs per
+    image against GT (fg if IoU>=fg_thr, bg if lo<=IoU<hi), emit class labels
+    + encoded bbox targets, fg-padded with background."""
+    (rois,) = inputs["RpnRois"]            # [N, R, 4]
+    (gt_boxes,) = inputs["GtBoxes"]        # [N, G, 4]
+    (gt_classes,) = inputs["GtClasses"]    # [N, G]
+    batch = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thr = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    num_classes = int(attrs.get("class_nums", 81))
+
+    def per_image(r, gt, gc, key):
+        valid_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+        # reference appends gt boxes to the candidate set
+        cand = jnp.concatenate([r, gt])
+        iou = jnp.where(valid_gt[None, :], _iou_matrix(cand, gt), -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        fg = best_iou >= fg_thr
+        bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+        kf, kb = jax.random.split(key)
+        n_fg = int(batch * fg_frac)
+        fg = _topk_mask(jax.random.uniform(kf, fg.shape), fg, n_fg)
+        bg = _topk_mask(jax.random.uniform(kb, bg.shape), bg, batch - n_fg)
+        sel = fg | bg
+        # deterministic static gather: fg first then bg, padded w/ zeros
+        prio = jnp.where(fg, 2.0, jnp.where(bg, 1.0, 0.0))
+        _, idx = jax.lax.top_k(prio, batch)
+        picked = sel[idx]
+        out_rois = jnp.where(picked[:, None], cand[idx], 0.0)
+        cls = jnp.where(fg[idx], gc[best_gt[idx]].astype(jnp.int32),
+                        jnp.where(bg[idx], 0, -1))
+        tgt = _encode_deltas(cand[idx], gt[best_gt[idx]])
+        tgt = jnp.where(fg[idx][:, None], tgt, 0.0)
+        # per-class one-hot expanded targets like bbox_head expects
+        w = jax.nn.one_hot(jnp.maximum(cls, 0), num_classes, dtype=jnp.float32)
+        w = w * fg[idx][:, None].astype(jnp.float32)
+        return out_rois, cls, tgt, w
+
+    n = rois.shape[0]
+    keys = jax.random.split(ctx.rng(), n)
+    out_rois, labels, targets, weights = jax.vmap(per_image)(
+        rois, gt_boxes, gt_classes, keys)
+    return {"Rois": [out_rois], "LabelsInt32": [labels],
+            "BboxTargets": [targets], "BboxInsideWeights": [weights],
+            "BboxOutsideWeights": [weights]}
+
+
+@register_op("yolov3_loss")
+def _yolov3_loss(ctx, inputs, attrs):
+    """yolov3_loss_op.cc: single-scale YOLOv3 loss — BCE on objectness &
+    class probs, MSE-style (x,y via BCE, w,h via L1) on coordinates for
+    responsible anchors. GTBox is [N, B, 4] in (cx, cy, w, h) normalized
+    coords, zero rows = padding."""
+    (x,) = inputs["X"]                     # [N, A*(5+C), H, W]
+    (gt_box,) = inputs["GTBox"]            # [N, B, 4]
+    (gt_label,) = inputs["GTLabel"]        # [N, B]
+    anchors = attrs["anchors"]             # flat [w0,h0,w1,h1,...] (pixels)
+    mask = attrs.get("anchor_mask", list(range(len(anchors) // 2)))
+    class_num = int(attrs["class_num"])
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    downsample = int(attrs.get("downsample_ratio", 32))
+
+    n, _, h, w = x.shape
+    na = len(mask)
+    input_size = downsample * h
+    pred = x.reshape(n, na, 5 + class_num, h, w)
+    px = jax.nn.sigmoid(pred[:, :, 0])
+    py = jax.nn.sigmoid(pred[:, :, 1])
+    pw = pred[:, :, 2]
+    ph = pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]                  # [N, A, C, H, W]
+
+    aw = jnp.asarray([anchors[2 * m] for m in mask], jnp.float32)
+    ah = jnp.asarray([anchors[2 * m + 1] for m in mask], jnp.float32)
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32)
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32)
+
+    gx, gy = jnp.meshgrid(jnp.arange(w, dtype=jnp.float32),
+                          jnp.arange(h, dtype=jnp.float32))
+    # decoded predicted boxes (normalized) for the ignore-mask IoU test
+    bx = (px + gx[None, None]) / w
+    by = (py + gy[None, None]) / h
+    bw = jnp.exp(jnp.clip(pw, -10, 10)) * aw[None, :, None, None] / input_size
+    bh = jnp.exp(jnp.clip(ph, -10, 10)) * ah[None, :, None, None] / input_size
+
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)   # [N, B]
+
+    def wh_iou(w1, h1, w2, h2):
+        inter = jnp.minimum(w1, w2) * jnp.minimum(h1, h2)
+        return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+    # responsible anchor per gt: best wh-IoU over ALL anchors, must be in mask
+    g_w = gt_box[..., 2] * input_size
+    g_h = gt_box[..., 3] * input_size
+    an_iou = wh_iou(g_w[..., None], g_h[..., None],
+                    all_aw[None, None, :], all_ah[None, None, :])  # [N,B,Atot]
+    best_a = jnp.argmax(an_iou, axis=-1)                           # [N, B]
+    mask_arr = jnp.asarray(mask, jnp.int32)
+    in_mask = (best_a[..., None] == mask_arr[None, None, :])       # [N,B,A]
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # scatter gt info onto the [A, H, W] grid — one vectorized scatter per
+    # target tensor over the flattened [B, A] (gt, anchor) pairs; inactive
+    # pairs get an out-of-range anchor index and mode='drop' discards them
+    def per_image(vld, inm, gix, gjy, gb, gl):
+        on = vld[:, None] & inm                              # [B, A]
+        ai = jnp.broadcast_to(jnp.arange(na)[None, :], on.shape)
+        a_sel = jnp.where(on, ai, na).reshape(-1)            # na == dropped
+        gj_f = jnp.broadcast_to(gjy[:, None], on.shape).reshape(-1)
+        gi_f = jnp.broadcast_to(gix[:, None], on.shape).reshape(-1)
+        sel = (a_sel, gj_f, gi_f)
+
+        def scat(vals):
+            v = jnp.broadcast_to(vals, on.shape).reshape(-1)
+            return jnp.zeros((na, h, w)).at[sel].set(v, mode="drop")
+
+        obj = scat(1.0)
+        tx = scat(gb[:, None, 0] * w - gix[:, None])
+        ty = scat(gb[:, None, 1] * h - gjy[:, None])
+        tw = scat(jnp.log(jnp.maximum(
+            gb[:, None, 2] * input_size / aw[None, :], 1e-9)))
+        th = scat(jnp.log(jnp.maximum(
+            gb[:, None, 3] * input_size / ah[None, :], 1e-9)))
+        tscale = scat(2.0 - (gb[:, None, 2] * gb[:, None, 3]))
+        cls_f = jnp.broadcast_to(
+            jnp.clip(gl, 0, class_num - 1)[:, None], on.shape).reshape(-1)
+        tcls = jnp.zeros((na, class_num, h, w)).at[
+            (a_sel, cls_f, gj_f, gi_f)].set(1.0, mode="drop")
+        return obj, tx, ty, tw, th, tscale, tcls
+
+    obj, tx, ty, tw_t, th_t, tscale, tcls = jax.vmap(per_image)(
+        valid, in_mask, gi, gj, gt_box, gt_label)
+
+    # ignore mask: predicted boxes with IoU > thresh vs any gt are not negatives
+    def box_iou_vs_gt(bxi, byi, bwi, bhi, gb, vld):
+        p = jnp.stack([bxi - bwi / 2, byi - bhi / 2,
+                       bxi + bwi / 2, byi + bhi / 2], -1).reshape(-1, 4)
+        g = jnp.stack([gb[:, 0] - gb[:, 2] / 2, gb[:, 1] - gb[:, 3] / 2,
+                       gb[:, 0] + gb[:, 2] / 2, gb[:, 1] + gb[:, 3] / 2], -1)
+        iou = jnp.where(vld[None, :], _iou_matrix(p, g), 0.0)
+        return jnp.max(iou, axis=1).reshape(bxi.shape)
+
+    best_iou = jax.vmap(box_iou_vs_gt)(bx, by, bw, bh, gt_box, valid)
+    noobj = (obj == 0) & (best_iou <= ignore_thresh)
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    loss_xy = tscale * obj * (bce(pred[:, :, 0], tx) + bce(pred[:, :, 1], ty))
+    loss_wh = tscale * obj * (jnp.abs(pw - tw_t) + jnp.abs(ph - th_t))
+    loss_obj = obj * bce(pobj, 1.0) + noobj * bce(pobj, 0.0)
+    loss_cls = obj[:, :, None] * bce(pcls, tcls)
+    loss = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3)) +
+            loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return {"Loss": [loss]}
+
+
+@register_op("detection_map", differentiable=False)
+def _detection_map(ctx, inputs, attrs):
+    """detection_map_op.cc: mAP over padded detections [N, D, 6]
+    (label, score, box) vs gt [N, G, 5] (label, box). 'integral' or '11point'
+    average precision, single-batch (no accumulated state)."""
+    (dets,) = inputs["DetectRes"]
+    (gts,) = inputs["Label"]
+    iou_thr = attrs.get("overlap_threshold", 0.5)
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs.get("class_num", 21))
+
+    N, D, _ = dets.shape
+    G = gts.shape[1]
+    aps = []
+    gt_valid = gts[..., 3] > gts[..., 1]      # non-degenerate box
+    for c in range(1, class_num):
+        c_det = dets[..., 0] == c
+        c_gt = gt_valid & (gts[..., 0] == c)
+        npos = jnp.sum(c_gt)
+
+        def per_image(det, dmask, gt, gmask):
+            iou = _iou_matrix(det[:, 2:6], gt[:, 1:5])
+            iou = jnp.where(gmask[None, :], iou, -1.0)
+            order = jnp.argsort(-det[:, 1])
+
+            def body(used, i):
+                d = order[i]
+                best = jnp.argmax(jnp.where(used, -1.0, iou[d]))
+                ok = dmask[d] & (iou[d, best] >= iou_thr) & ~used[best]
+                return used.at[best].set(used[best] | ok), \
+                    jnp.where(dmask[d], jnp.where(ok, 1.0, -1.0), 0.0)
+
+            used0 = jnp.zeros(G, bool)
+            _, tp_fp = jax.lax.scan(body, used0, jnp.arange(D))
+            return det[order, 1], tp_fp        # scores sorted desc, ±1 flags
+
+        scores, flags = jax.vmap(per_image)(dets, c_det, gts, c_gt)
+        scores = scores.reshape(-1)
+        flags = flags.reshape(-1)
+        order = jnp.argsort(-scores)
+        f = flags[order]
+        tp = jnp.cumsum(f == 1.0)
+        fp = jnp.cumsum(f == -1.0)
+        recall = tp / jnp.maximum(npos, 1)
+        precision = tp / jnp.maximum(tp + fp, 1)
+        if ap_type == "11point":
+            pts = [jnp.max(jnp.where(recall >= t, precision, 0.0))
+                   for t in jnp.linspace(0, 1, 11)]
+            ap = jnp.mean(jnp.stack(pts))
+        else:
+            dr = jnp.diff(recall, prepend=0.0)
+            ap = jnp.sum(precision * dr)
+        aps.append(jnp.where(npos > 0, ap, jnp.nan))
+    aps = jnp.stack(aps)
+    m_ap = jnp.nanmean(aps)
+    return {"MAP": [jnp.where(jnp.isnan(m_ap), 0.0, m_ap)]}

@@ -66,35 +66,40 @@ def _depthwise_conv2d(ctx, inputs, attrs):
     return _conv2d(ctx, inputs, attrs)
 
 
-@register_op("conv2d_transpose")
-def _conv2d_transpose(ctx, inputs, attrs):
-    """conv2d_transpose_op.cc semantics: out = (i-1)*s - 2p + d*(k-1) + 1.
-    Expressed as a fractionally-strided conv (lhs_dilation) with the kernel
-    spatially flipped — the gradient-of-conv formulation XLA lowers well."""
-    (x,) = inputs["Input"]
-    (w,) = inputs["Filter"]  # paddle layout: [C_in, C_out/groups, H, W]
-    sh, sw = _pair(attrs.get("strides", [1, 1]))
-    ph, pw = _pair(attrs.get("paddings", [0, 0]))
-    dh, dw = _pair(attrs.get("dilations", [1, 1]))
-    groups = int(attrs.get("groups", 1))
-    kh, kw = w.shape[2], w.shape[3]
-    # flip spatially; swap in/out channel dims → OIHW with O = C_out
-    wt = jnp.flip(w, axis=(2, 3))
+def conv_transpose_nd(x, w, strides, pads, dils, groups):
+    """Shared N-d transposed-conv core (conv_transpose_op.cc semantics:
+    out = (i-1)*s - 2p + d*(k-1) + 1). Expressed as a fractionally-strided
+    conv (lhs_dilation) with the kernel spatially flipped — the
+    gradient-of-conv formulation XLA lowers well. `w` is paddle layout
+    [C_in, C_out/groups, *k]."""
+    nd = len(strides)
+    ks = w.shape[2:]
+    wt = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
     if groups > 1:
         cin, cog = w.shape[0], w.shape[1]
-        wt = wt.reshape(groups, cin // groups, cog, kh, kw)
-        wt = jnp.swapaxes(wt, 1, 2).reshape(groups * cog, cin // groups, kh, kw)
+        wt = wt.reshape(groups, cin // groups, cog, *ks)
+        wt = jnp.swapaxes(wt, 1, 2).reshape(groups * cog, cin // groups, *ks)
     else:
         wt = jnp.swapaxes(wt, 0, 1)
-    eff_kh = dh * (kh - 1) + 1
-    eff_kw = dw * (kw - 1) + 1
-    padding = [(eff_kh - 1 - ph, eff_kh - 1 - ph), (eff_kw - 1 - pw, eff_kw - 1 - pw)]
-    out = lax.conv_general_dilated(
-        x, wt, window_strides=(1, 1), padding=padding,
-        lhs_dilation=(sh, sw), rhs_dilation=(dh, dw),
-        feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    return one(out)
+    pad = [(d * (k - 1) - p, d * (k - 1) - p)
+           for k, p, d in zip(ks, pads, dils)]
+    dn = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+          3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    return lax.conv_general_dilated(
+        x, wt, window_strides=(1,) * nd, padding=pad,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dils),
+        feature_group_count=groups, dimension_numbers=dn)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    (w,) = inputs["Filter"]
+    return one(conv_transpose_nd(
+        x, w, _pair(attrs.get("strides", [1, 1])),
+        _pair(attrs.get("paddings", [0, 0])),
+        _pair(attrs.get("dilations", [1, 1])),
+        int(attrs.get("groups", 1))))
 
 
 @register_op("conv3d")

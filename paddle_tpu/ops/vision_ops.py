@@ -1,0 +1,241 @@
+"""Vision extras round 2 — transposed 3D/depthwise convs, deformable conv,
+unfold (im2col), indexed 3D max-pool, random_crop, FSP matrix.
+
+References: conv_transpose_op.cc (conv3d_transpose / depthwise variants),
+deformable_conv_op.cc, unfold_op.cc, pool_with_index_op.cc
+(max_pool3d_with_index), random_crop_op.cc, fsp_op.cc. Redesigned on
+lax.conv_general_dilated / reduce_window / gather — no im2col scratch
+buffers, XLA owns the tiling.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import one
+
+
+def _tup(v, n=2):
+    v = list(v) if isinstance(v, (list, tuple)) else [v]
+    if len(v) == 1:
+        v = v * n
+    return tuple(int(x) for x in v[:n])
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, inputs, attrs):
+    """conv_transpose_op.cc 3-D case — shares the fractionally-strided
+    formulation with conv2d_transpose via nn_ops.conv_transpose_nd."""
+    from .nn_ops import conv_transpose_nd
+    (x,) = inputs["Input"]
+    (w,) = inputs["Filter"]        # [C_in, C_out/groups, D, H, W]
+    return one(conv_transpose_nd(
+        x, w, _tup(attrs.get("strides", [1, 1, 1]), 3),
+        _tup(attrs.get("paddings", [0, 0, 0]), 3),
+        _tup(attrs.get("dilations", [1, 1, 1]), 3),
+        int(attrs.get("groups", 1))))
+
+
+@register_op("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, inputs, attrs):
+    from .nn_ops import _conv2d_transpose
+    attrs = dict(attrs)
+    (x,) = inputs["Input"]
+    attrs["groups"] = x.shape[1]
+    return _conv2d_transpose(ctx, inputs, attrs)
+
+
+@register_op("unfold")
+def _unfold(ctx, inputs, attrs):
+    """unfold_op.cc (im2col as an op): [N, C, H, W] →
+    [N, C*kh*kw, L] where L = out_h*out_w. Built from
+    lax.conv_general_dilated_patches (XLA extracts patches natively)."""
+    (x,) = inputs["X"]
+    kh, kw = _tup(attrs["kernel_sizes"])
+    sh, sw = _tup(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    if len(pads) == 2:
+        pads = [pads[0], pads[1], pads[0], pads[1]]
+    dh, dw = _tup(attrs.get("dilations", [1, 1]))
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        [(pads[0], pads[2]), (pads[1], pads[3])],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [N, C*kh*kw, OH, OW]
+    n, ckk = patches.shape[0], patches.shape[1]
+    return {"Y": [patches.reshape(n, ckk, -1)]}
+
+
+@register_op("deformable_conv")
+def _deformable_conv(ctx, inputs, attrs):
+    """deformable_conv_op.cc (DCNv2): sample the input at offset-shifted
+    kernel taps with bilinear interpolation × modulation mask, then a 1-step
+    matmul against the filter. Gather-based; offsets stay differentiable."""
+    (x,) = inputs["Input"]          # [N, C, H, W]
+    (offset,) = inputs["Offset"]    # [N, 2*dg*kh*kw, OH, OW]
+    (w,) = inputs["Filter"]         # [Cout, C/groups, kh, kw]
+    mask = (inputs.get("Mask") or [None])[0]   # [N, dg*kh*kw, OH, OW]
+    sh, sw = _tup(attrs.get("strides", [1, 1]))
+    ph, pw = _tup(attrs.get("paddings", [0, 0]))
+    dh, dw = _tup(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    n, c, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wd + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    # base sampling grid per tap: [kh*kw, OH, OW]
+    oy = jnp.arange(oh) * sh - ph
+    ox = jnp.arange(ow) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[None, :, None] + ky.repeat(kw)[:, None, None]   # [K, OH, 1]
+    base_x = ox[None, None, :] + jnp.tile(kx, kh)[:, None, None]
+
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+    py = base_y[None, None] + off[:, :, :, 0]                   # [N, dg, K, OH, OW]
+    px = base_x[None, None] + off[:, :, :, 1]
+
+    def bilinear(img, yy, xx):
+        """img [C, H, W]; yy/xx [...] → [C, ...]"""
+        y0 = jnp.floor(yy); x0 = jnp.floor(xx)
+        wy = yy - y0; wx = xx - x0
+        vals = 0.0
+        for (yi, wyi) in ((y0, 1 - wy), (y0 + 1, wy)):
+            for (xi, wxi) in ((x0, 1 - wx), (x0 + 1, wx)):
+                inb = (yi >= 0) & (yi < img.shape[1]) & (xi >= 0) & (xi < img.shape[2])
+                yc = jnp.clip(yi, 0, img.shape[1] - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, img.shape[2] - 1).astype(jnp.int32)
+                v = img[:, yc, xc]
+                vals = vals + v * (wyi * wxi * inb)[None]
+        return vals
+
+    cg = c // dg                     # channels per deformable group
+
+    def per_image(img, yy, xx, mk):
+        # sample: for each dg, channels [dg*cg:(dg+1)*cg] share offsets
+        cols = []
+        for g in range(dg):
+            sub = img[g * cg:(g + 1) * cg]                    # [cg, H, W]
+            s = bilinear(sub, yy[g], xx[g])                   # [cg, K, OH, OW]
+            if mk is not None:
+                s = s * mk[g][None]
+            cols.append(s)
+        return jnp.concatenate(cols)                          # [C, K, OH, OW]
+
+    mk = mask.reshape(n, dg, kh * kw, oh, ow) if mask is not None else None
+    cols = jax.vmap(per_image)(x, py, px,
+                               mk if mk is not None else jnp.ones((n, dg, kh * kw, oh, ow), x.dtype))
+    # cols: [N, C, K, OH, OW] → grouped matmul with w [Cout, C/groups * K]
+    cpg = c // groups
+    opg = cout // groups
+    wg = w.reshape(groups, opg, cpg * kh * kw)
+    cols = cols.reshape(n, groups, cpg * kh * kw, oh * ow)
+    out = jnp.einsum("gok,ngkl->ngol", wg, cols)
+    return {"Output": [out.reshape(n, cout, oh, ow)]}
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, inputs, attrs):
+    """pool_with_index_op.cc 3-D: max pool + flat argmax index per window."""
+    (x,) = inputs["X"]
+    ks = _tup(attrs["ksize"], 3)
+    st = _tup(attrs.get("strides", ks), 3)
+    pd = _tup(attrs.get("paddings", [0, 0, 0]), 3)
+    n, c, d, h, w = x.shape
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    dims = (1, 1) + ks
+    strides = (1, 1) + st
+    out = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+    # indices: -inf-pad manually (patches pads with 0, which would win over
+    # negative inputs), argmax the within-window offset, then reconstruct
+    # the flat d*h*w index arithmetically — integer-exact at any size, and
+    # outside the grad tape (the max itself carries the gradient)
+    xs = lax.stop_gradient(x).reshape(n * c, 1, d, h, w)
+    # finite lowest value, not -inf: patches lowers to a one-hot conv and
+    # 0 * -inf = nan would poison every padded window
+    xs = jnp.pad(xs, ((0, 0), (0, 0)) + tuple((p, p) for p in pd),
+                 constant_values=float(jnp.finfo(x.dtype).min))
+    xp = lax.conv_general_dilated_patches(
+        xs, ks, st, ((0, 0), (0, 0), (0, 0)),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    k = jnp.argmax(xp, axis=1)                       # [N*C, OD, OH, OW]
+    kd, kh, kw = ks
+    kd_i = k // (kh * kw)
+    kh_i = (k // kw) % kh
+    kw_i = k % kw
+    od, ohh, oww = out.shape[2:]
+    oz = jnp.arange(od)[:, None, None] * st[0] - pd[0]
+    oy = jnp.arange(ohh)[None, :, None] * st[1] - pd[1]
+    ox = jnp.arange(oww)[None, None, :] * st[2] - pd[2]
+    idx = ((oz + kd_i) * h + (oy + kh_i)) * w + (ox + kw_i)
+    return {"Out": [out], "Mask": [idx.reshape(out.shape).astype(jnp.int32)]}
+
+
+@register_op("random_crop", differentiable=False)
+def _random_crop(ctx, inputs, attrs):
+    """random_crop_op.cc: crop a random window of `shape` from the trailing
+    dims of X (per batch element)."""
+    (x,) = inputs["X"]
+    shape = [int(s) for s in attrs["shape"]]
+    nd = len(shape)
+    lead = x.shape[:x.ndim - nd]
+    maxs = [x.shape[x.ndim - nd + i] - shape[i] for i in range(nd)]
+    key = ctx.rng()
+    nbatch = 1
+    for s in lead:
+        nbatch *= s
+    keys = jax.random.split(key, nbatch * nd).reshape(nbatch, nd, 2)
+    xb = x.reshape((nbatch,) + x.shape[x.ndim - nd:])
+
+    def crop_one(img, ks):
+        starts = [jax.random.randint(ks[i], (), 0, maxs[i] + 1) for i in range(nd)]
+        return lax.dynamic_slice(img, starts, shape)
+
+    out = jax.vmap(crop_one)(xb, keys)
+    return one(out.reshape(lead + tuple(shape)))
+
+
+@register_op("fsp")
+def _fsp(ctx, inputs, attrs):
+    """fsp_op.cc (flow-of-solution-procedure matrix for distillation):
+    G[i,j] = mean_hw X[:,i,h,w] * Y[:,j,h,w] → [N, Cx, Cy]."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    hw = x.shape[2] * x.shape[3]
+    out = jnp.einsum("nchw,ndhw->ncd", x, y) / hw
+    return one(out)
+
+
+@register_op("similarity_focus", differentiable=False)
+def _similarity_focus(ctx, inputs, attrs):
+    """similarity_focus_op.cc: build a 0/1 focus mask selecting, for each
+    (axis, index) slice, the per-channel max positions across the indexed
+    slice of X [N, C, H, W]."""
+    (x,) = inputs["X"]
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    n, c, h, w = x.shape
+    out = jnp.zeros_like(x)
+    for ind in indexes:
+        if axis == 1:
+            sl = x[:, ind]                          # [N, H, W]
+            flat = sl.reshape(n, -1)
+            pos = jnp.argmax(flat, axis=1)
+            hy, wx = pos // w, pos % w
+            mask = jnp.zeros((n, h, w), x.dtype).at[jnp.arange(n), hy, wx].set(1.0)
+            out = jnp.maximum(out, mask[:, None, :, :])
+        elif axis == 2:
+            sl = x[:, :, ind]                       # [N, C, W]
+            pos = jnp.argmax(sl, axis=2)            # [N, C]
+            mask = jax.nn.one_hot(pos, w, dtype=x.dtype)   # [N, C, W]
+            out = jnp.maximum(out, mask[:, :, None, :])
+        else:
+            sl = x[:, :, :, ind]
+            pos = jnp.argmax(sl, axis=2)
+            mask = jax.nn.one_hot(pos, h, dtype=x.dtype)
+            out = jnp.maximum(out, mask[:, :, :, None])
+    return one(out)

@@ -137,3 +137,154 @@ def test_generate_proposals_shapes():
     assert out["RpnRoiProbs"].shape == (n, 8)
     rois = out["RpnRois"]
     assert (rois[..., 0] >= 0).all() and (rois[..., 2] <= 63).all()
+
+
+# --- round-2 detection family ---------------------------------------------
+
+
+def test_rpn_target_assign_labels():
+    t = _T(); t.op_type = "rpn_target_assign"
+    anchors = np.array([[0, 0, 10, 10], [100, 100, 110, 110],
+                        [0, 0, 9, 11], [200, 200, 210, 210]], "float32")
+    gt = np.array([[[0, 0, 10, 10]]], "float32")          # matches anchor 0
+    out = t.run_op({"Anchor": anchors, "GtBoxes": gt},
+                   attrs={"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+                          "rpn_positive_overlap": 0.7,
+                          "rpn_negative_overlap": 0.3},
+                   output_slots=("TargetLabel", "TargetBBox"))
+    labels = out["TargetLabel"][0]
+    assert labels[0] == 1                                 # IoU 1.0 anchor is fg
+    assert (labels == 0).sum() >= 1                       # far anchors are bg
+    # fg target deltas for a perfect match are ~0
+    np.testing.assert_allclose(out["TargetBBox"][0][0], 0.0, atol=1e-5)
+
+
+def test_retinanet_target_assign_classes():
+    t = _T(); t.op_type = "retinanet_target_assign"
+    anchors = np.array([[0, 0, 10, 10], [100, 100, 110, 110]], "float32")
+    gt = np.array([[[0, 0, 10, 10]]], "float32")
+    gl = np.array([[7]], "int32")
+    out = t.run_op({"Anchor": anchors, "GtBoxes": gt, "GtLabels": gl},
+                   output_slots=("TargetLabel", "ForegroundNumber"))
+    assert out["TargetLabel"][0][0] == 7
+    assert out["TargetLabel"][0][1] == 0
+    assert out["ForegroundNumber"][0] == 1
+
+
+def test_distribute_fpn_proposals_levels():
+    t = _T(); t.op_type = "distribute_fpn_proposals"
+    # small roi -> low level, large roi -> high level
+    rois = np.array([[0, 0, 20, 20], [0, 0, 500, 500]], "float32")
+    out = t.run_op({"FpnRois": rois},
+                   attrs={"min_level": 2, "max_level": 5,
+                          "refer_level": 4, "refer_scale": 224},
+                   output_slots=("MultiFpnRois", "RestoreIndex"),
+                   multi_output_counts={"MultiFpnRois": 4})
+    lvls = out["MultiFpnRois"]
+    assert np.allclose(lvls[0][0], rois[0])               # level 2 gets small
+    assert np.allclose(lvls[3][1], rois[1])               # level 5 gets large
+    # restore contract: gather(concat(MultiFpnRois), RestoreIndex) == input
+    cat = np.concatenate(lvls)
+    np.testing.assert_allclose(cat[out["RestoreIndex"]], rois)
+
+
+def test_collect_fpn_proposals_topk():
+    t = _T(); t.op_type = "collect_fpn_proposals"
+    r1 = np.array([[[0, 0, 1, 1], [2, 2, 3, 3]]], "float32")
+    r2 = np.array([[[4, 4, 5, 5]]], "float32")
+    s1 = np.array([[0.9, 0.1]], "float32")
+    s2 = np.array([[0.5]], "float32")
+    out = t.run_op({"MultiLevelRois": [r1, r2], "MultiLevelScores": [s1, s2]},
+                   attrs={"post_nms_topN": 2}, output_slots=("FpnRois",))
+    top = out["FpnRois"][0]
+    np.testing.assert_allclose(top[0], [0, 0, 1, 1])      # score 0.9
+    np.testing.assert_allclose(top[1], [4, 4, 5, 5])      # score 0.5
+
+
+def test_generate_proposal_labels_shapes():
+    t = _T(); t.op_type = "generate_proposal_labels"
+    rois = np.array([[[0, 0, 10, 10], [50, 50, 60, 60], [0, 0, 9, 10]]],
+                    "float32")
+    gt = np.array([[[0, 0, 10, 10]]], "float32")
+    gc = np.array([[3]], "int32")
+    out = t.run_op({"RpnRois": rois, "GtBoxes": gt, "GtClasses": gc},
+                   attrs={"batch_size_per_im": 4, "fg_fraction": 0.5,
+                          "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                          "bg_thresh_lo": 0.0, "class_nums": 5},
+                   output_slots=("Rois", "LabelsInt32", "BboxTargets"))
+    labels = out["LabelsInt32"][0]
+    assert labels.shape == (4,)
+    assert (labels == 3).sum() >= 1                       # fg got the gt class
+
+
+def test_yolov3_loss_perfect_prediction_low():
+    t = _T(); t.op_type = "yolov3_loss"
+    rng = np.random.RandomState(0)
+    n, na, c, h, w = 1, 1, 2, 4, 4
+    x = rng.randn(n, na * (5 + c), h, w).astype("float32") * 0.1
+    gt_box = np.array([[[0.4, 0.4, 0.25, 0.25]]], "float32")  # cx,cy,w,h
+    gt_label = np.array([[1]], "int32")
+    attrs = {"anchors": [32, 32], "anchor_mask": [0], "class_num": c,
+             "ignore_thresh": 0.7, "downsample_ratio": 32}
+    out = t.run_op({"X": x, "GTBox": gt_box, "GTLabel": gt_label},
+                   attrs=attrs, output_slots=("Loss",))
+    loss_rand = float(out["Loss"][0])
+    assert np.isfinite(loss_rand) and loss_rand > 0
+    # craft logits matching the gt: loss must drop sharply
+    x2 = np.full_like(x, -12.0)                            # sigmoid ~ 0
+    gi, gj = int(0.4 * w), int(0.4 * h)
+    xv = x2.reshape(n, na, 5 + c, h, w)
+    input_size = 32 * h
+    tx = 0.4 * w - gi; ty = 0.4 * h - gj
+    xv[0, 0, 0, gj, gi] = np.log(tx / (1 - tx))
+    xv[0, 0, 1, gj, gi] = np.log(ty / (1 - ty))
+    xv[0, 0, 2, gj, gi] = np.log(0.25 * input_size / 32)
+    xv[0, 0, 3, gj, gi] = np.log(0.25 * input_size / 32)
+    xv[0, 0, 4, gj, gi] = 12.0                             # objectness
+    xv[0, 0, 5 + 1, gj, gi] = 12.0                         # class 1
+    out2 = t.run_op({"X": xv.reshape(x.shape), "GTBox": gt_box,
+                     "GTLabel": gt_label}, attrs=attrs, output_slots=("Loss",))
+    # sigmoid-BCE on the soft x/y offsets has an irreducible entropy floor,
+    # so "perfect" is ~0.17x the random loss, not ~0
+    assert float(out2["Loss"][0]) < 0.2 * loss_rand
+
+
+def test_detection_map_perfect_and_miss():
+    t = _T(); t.op_type = "detection_map"
+    # one gt of class 1, one perfect detection
+    dets = np.array([[[1, 0.9, 0, 0, 10, 10]]], "float32")
+    gts = np.array([[[1, 0, 0, 10, 10]]], "float32")
+    out = t.run_op({"DetectRes": dets, "Label": gts},
+                   attrs={"class_num": 2, "ap_type": "integral"},
+                   output_slots=("MAP",))
+    np.testing.assert_allclose(float(out["MAP"]), 1.0, atol=1e-5)
+    # detection far away -> AP 0
+    dets2 = np.array([[[1, 0.9, 50, 50, 60, 60]]], "float32")
+    out2 = t.run_op({"DetectRes": dets2, "Label": gts},
+                    attrs={"class_num": 2, "ap_type": "integral"},
+                    output_slots=("MAP",))
+    np.testing.assert_allclose(float(out2["MAP"]), 0.0, atol=1e-5)
+
+
+def test_retinanet_detection_output_batched():
+    t = _T(); t.op_type = "retinanet_detection_output"
+    # batch of 2 images, 2 FPN levels with DIFFERENT anchor counts
+    a1 = np.array([[0, 0, 18, 18], [40, 40, 58, 58], [80, 80, 98, 98]], "float32")
+    a2 = np.array([[10, 10, 28, 28]], "float32")
+    s1 = np.full((2, 3, 2), 0.01, "float32")
+    s2 = np.full((2, 1, 2), 0.01, "float32")
+    s1[0, 1, 1] = 0.95          # image 0: class 1 at level-1 anchor 1
+    s2[1, 0, 0] = 0.9           # image 1: class 0 at level-2 anchor 0
+    d1 = np.zeros((2, 3, 4), "float32")
+    d2 = np.zeros((2, 1, 4), "float32")
+    imi = np.array([[200, 200, 1], [200, 200, 1]], "float32")
+    out = t.run_op({"Scores": [s1, s2], "BBoxes": [d1, d2],
+                    "Anchors": [a1, a2], "ImInfo": imi},
+                   attrs={"score_threshold": 0.5, "nms_top_k": 4,
+                          "keep_top_k": 3, "nms_threshold": 0.3})
+    det = out["Out"]
+    assert det.shape == (2, 3, 6)                         # batch-major
+    assert det[0, 0, 0] == 1.0 and det[0, 0, 1] > 0.9     # img0 class 1
+    assert det[1, 0, 0] == 0.0 and det[1, 0, 1] > 0.85    # img1 class 0
+    # img0 top box decodes against the level-1 anchor it came from
+    np.testing.assert_allclose(det[0, 0, 2:], [40, 40, 58, 58], atol=1.0)

@@ -1,0 +1,111 @@
+"""Vision-extras numeric checks (conv_transpose_op.cc 3-D,
+deformable_conv_op.cc, unfold_op.cc, pool_with_index_op.cc, random_crop_op.cc,
+fsp_op.cc parity)."""
+import numpy as np
+
+from op_test_base import OpTest
+
+
+class _T(OpTest):
+    pass
+
+
+def test_conv3d_transpose_identity_kernel():
+    t = _T(); t.op_type = "conv3d_transpose"
+    x = np.random.RandomState(0).randn(1, 2, 3, 3, 3).astype("float32")
+    # 1x1x1 identity kernel, stride 1: output == input (per channel sum)
+    w = np.zeros((2, 2, 1, 1, 1), "float32")
+    w[0, 0] = 1.0; w[1, 1] = 1.0
+    out = t.run_op({"Input": x, "Filter": w},
+                   attrs={"strides": [1, 1, 1]}, output_slots=("Out",))
+    np.testing.assert_allclose(out["Out"], x, rtol=1e-5)
+
+
+def test_conv3d_transpose_upsamples():
+    t = _T(); t.op_type = "conv3d_transpose"
+    x = np.ones((1, 1, 2, 2, 2), "float32")
+    w = np.ones((1, 1, 2, 2, 2), "float32")
+    out = t.run_op({"Input": x, "Filter": w},
+                   attrs={"strides": [2, 2, 2]}, output_slots=("Out",))
+    # out size = (i-1)*s + k = 4
+    assert out["Out"].shape == (1, 1, 4, 4, 4)
+    np.testing.assert_allclose(out["Out"].sum(), x.sum() * 8, rtol=1e-5)
+
+
+def test_unfold_matches_manual_patches():
+    t = _T(); t.op_type = "unfold"
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = t.run_op({"X": x}, attrs={"kernel_sizes": [2, 2], "strides": [2, 2]},
+                   output_slots=("Y",))
+    y = out["Y"]                       # [1, 4, 4] — C*kh*kw=4, L=4
+    assert y.shape == (1, 4, 4)
+    # first patch (top-left 2x2) flattened across the channel axis
+    np.testing.assert_allclose(y[0, :, 0], [0, 1, 4, 5])
+
+
+def test_deformable_conv_zero_offset_equals_conv2d():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 6, 6).astype("float32")
+    w = rng.randn(3, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 1 * 9, 4, 4), "float32")
+    mask = np.ones((2, 9, 4, 4), "float32")
+    t = _T(); t.op_type = "deformable_conv"
+    out = t.run_op({"Input": x, "Offset": off, "Filter": w, "Mask": mask},
+                   attrs={"strides": [1, 1], "paddings": [0, 0],
+                          "deformable_groups": 1, "groups": 1},
+                   output_slots=("Output",))
+    t2 = _T(); t2.op_type = "conv2d"
+    ref = t2.run_op({"Input": x, "Filter": w},
+                    attrs={"strides": [1, 1], "paddings": [0, 0]})
+    np.testing.assert_allclose(out["Output"], ref["Out"], rtol=1e-4, atol=1e-4)
+
+
+def test_max_pool3d_with_index():
+    t = _T(); t.op_type = "max_pool3d_with_index"
+    x = np.arange(8, dtype="float32").reshape(1, 1, 2, 2, 2)
+    out = t.run_op({"X": x}, attrs={"ksize": [2, 2, 2]},
+                   output_slots=("Out", "Mask"))
+    np.testing.assert_allclose(out["Out"].ravel(), [7.0])
+    assert int(out["Mask"].ravel()[0]) == 7
+
+
+def test_random_crop_shape_and_content():
+    t = _T(); t.op_type = "random_crop"
+    x = np.arange(2 * 5 * 5, dtype="float32").reshape(2, 5, 5)
+    out = t.run_op({"X": x}, attrs={"shape": [3, 3]})
+    y = out["Out"]
+    assert y.shape == (2, 3, 3)
+    # every cropped value must exist in the source image
+    for b in range(2):
+        assert np.isin(y[b], x[b]).all()
+
+
+def test_fsp_matrix():
+    t = _T(); t.op_type = "fsp"
+    x = np.random.RandomState(0).randn(2, 3, 4, 4).astype("float32")
+    y = np.random.RandomState(1).randn(2, 5, 4, 4).astype("float32")
+    out = t.run_op({"X": x, "Y": y})
+    ref = np.einsum("nchw,ndhw->ncd", x, y) / 16
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_similarity_focus_channel_axis():
+    t = _T(); t.op_type = "similarity_focus"
+    x = np.zeros((1, 2, 3, 3), "float32")
+    x[0, 0, 1, 2] = 5.0        # max of slice 0 at (1, 2)
+    out = t.run_op({"X": x}, attrs={"axis": 1, "indexes": [0]})
+    y = out["Out"]
+    assert y[0, 0, 1, 2] == 1.0 and y[0, 1, 1, 2] == 1.0
+    assert y.sum() == 2.0      # one position broadcast across channels
+
+
+def test_max_pool3d_with_index_negative_inputs_and_padding():
+    t = _T(); t.op_type = "max_pool3d_with_index"
+    x = -np.arange(1, 9, dtype="float32").reshape(1, 1, 2, 2, 2)
+    out = t.run_op({"X": x}, attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                                    "paddings": [1, 1, 1]},
+                   output_slots=("Out", "Mask"))
+    # each 2x2x2 window sees exactly one real (negative) element; padding
+    # must never win the argmax
+    np.testing.assert_allclose(np.sort(out["Out"].ravel()), -np.arange(8, 0, -1))
+    assert sorted(out["Mask"].ravel().tolist()) == list(range(8))
