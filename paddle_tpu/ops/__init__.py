@@ -15,7 +15,9 @@ from . import (  # noqa: F401
     control_flow_ops,
     crf_ops,
     detection_ops,
+    framework_ops,
     fused_ops,
+    fusion_ops,
     math_ops,
     metric_ops,
     misc_ops,

@@ -1,0 +1,266 @@
+"""Framework/runtime ops round 2 — program-level IO, buffer coalescing,
+model averaging, LoD workflow machinery.
+
+References: save_op.cc, load_op.cc, save_combine_op.cc, load_combine_op.cc,
+coalesce_tensor_op.cc, average_accumulates_op.cc, sync_batch_norm_op.cu,
+lod_rank_table_op.cc, lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc,
+split_lod_tensor_op.cc, merge_lod_tensor_op.cc,
+reorder_lod_tensor_by_rank_op.cc, shrink_rnn_memory_op.cc,
+rnn_memory_helper_op.cc, controlflow/get_places_op.cc, fake_init_op.cc,
+delete_var_op.cc.
+
+LoD redesign note: everywhere the reference threads LoD metadata, this
+framework threads a padded tensor + integer ``Length [B]``; the "rank
+table" becomes an explicit [B, 2] (index, length) tensor sorted by length,
+which keeps every consumer static-shape for XLA.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..core.dtypes import convert_dtype
+from ..core.registry import register_op
+from .common import one, opt_input
+
+
+# ---------------------------------------------------------------------------
+# program-level IO (save/load as ops, like save_op.cc / load_op.cc — the
+# Python io.py wrappers remain the main path; these exist so transpiled
+# programs carrying save/load ops execute)
+# ---------------------------------------------------------------------------
+
+@register_op("save", differentiable=False)
+def _save(ctx, inputs, attrs):
+    """save_op.cc: stream one var to `file_path`. Ordered io_callback so
+    saves are not reordered/DCE'd by XLA."""
+    (x,) = inputs["X"]
+    path = attrs["file_path"]
+
+    def do_save(arr):
+        import os
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(np.asarray(arr), f)
+
+    io_callback(do_save, None, x, ordered=True)
+    return {}
+
+
+@register_op("load", differentiable=False)
+def _load(ctx, inputs, attrs):
+    """load_op.cc: read a var saved by `save`. The read happens at trace
+    time (the reference's load also runs once, in the startup program);
+    re-tracing re-reads."""
+    with open(attrs["file_path"], "rb") as f:
+        arr = pickle.load(f)
+    return one(jnp.asarray(arr))
+
+
+@register_op("save_combine", differentiable=False)
+def _save_combine(ctx, inputs, attrs):
+    """save_combine_op.cc: all input vars into one bundle file."""
+    xs = inputs["X"]
+    path = attrs["file_path"]
+
+    def do_save(*arrs):
+        import os
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump([np.asarray(a) for a in arrs], f)
+
+    io_callback(do_save, None, *xs, ordered=True)
+    return {}
+
+
+@register_op("load_combine", differentiable=False)
+def _load_combine(ctx, inputs, attrs):
+    with open(attrs["file_path"], "rb") as f:
+        arrs = pickle.load(f)
+    return {"Out": [jnp.asarray(a) for a in arrs]}
+
+
+@register_op("delete_var", differentiable=False)
+def _delete_var(ctx, inputs, attrs):
+    """delete_var_op.cc: explicit free. XLA liveness owns buffers here, so
+    this is a structural no-op kept for program parity."""
+    return {}
+
+
+@register_op("fake_init", differentiable=False)
+def _fake_init(ctx, inputs, attrs):
+    """fake_init_op.cc: declare a var without materializing real contents
+    (pserver-side init). Emits zeros of the declared shape."""
+    shape = tuple(int(s) for s in attrs["shape"])
+    return one(jnp.zeros(shape, convert_dtype(attrs.get("dtype", "float32"))))
+
+
+@register_op("get_places", differentiable=False)
+def _get_places(ctx, inputs, attrs):
+    """controlflow/get_places_op.cc: enumerate devices. Returns the device
+    ordinals as an int32 vector (places are mesh positions here)."""
+    n = int(attrs.get("device_count", 0)) or jax.device_count()
+    return one(jnp.arange(n, dtype=jnp.int32))
+
+
+@register_op("coalesce_tensor", differentiable=False)
+def _coalesce_tensor(ctx, inputs, attrs):
+    """coalesce_tensor_op.cc: pack vars into one contiguous buffer (fused
+    all-reduce / fused optimizer feeding). Returns the flat fused buffer
+    plus per-var views reshaped back."""
+    xs = inputs["Input"]
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    if attrs.get("set_constant", False):
+        flat = jnp.full_like(flat, attrs.get("constant", 0.0))
+    outs, pos = [], 0
+    for x in xs:
+        n = x.size
+        outs.append(flat[pos:pos + n].reshape(x.shape))
+        pos += n
+    return {"Output": outs, "FusedOutput": [flat]}
+
+
+@register_op("average_accumulates", differentiable=False,
+             grad_fn=None)
+def _average_accumulates(ctx, inputs, attrs):
+    """average_accumulates_op.cc (ModelAverage support): maintain windowed
+    parameter sums. sum_1 accumulates current window, sum_2 previous
+    windows, sum_3 scratch; on window overflow sums cascade."""
+    (param,) = inputs["param"]
+    (sum_1,) = inputs["in_sum_1"]
+    (sum_2,) = inputs["in_sum_2"]
+    (sum_3,) = inputs["in_sum_3"]
+    (num_acc,) = inputs["in_num_accumulates"]
+    (old_num,) = inputs["in_old_num_accumulates"]
+    (num_upd,) = inputs["in_num_updates"]
+    avg_win = float(attrs.get("average_window", 0.0))
+    max_avg_win = int(attrs.get("max_average_window", 10000))
+    min_avg_win = int(attrs.get("min_average_window", 10000))
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum_1 = sum_1 + param
+    # reference condition (average_accumulates_op.h): window closes when
+    # num_acc >= min_average_window AND
+    # num_acc >= min(max_average_window, num_updates * average_window)
+    nacc = num_acc.astype(jnp.float32)
+    done = (nacc >= float(min_avg_win)) & (
+        nacc >= jnp.minimum(float(max_avg_win),
+                            avg_win * num_upd.astype(jnp.float32)))
+    # reference cascade: sum_3 = sum_1 + sum_2; sum_1 = sum_2 = 0;
+    # old_num = num_acc (assigned, not accumulated)
+    new_sum_3 = jnp.where(done, sum_1 + sum_2, sum_3)
+    new_sum_2 = jnp.where(done, jnp.zeros_like(sum_2), sum_2)
+    new_sum_1 = jnp.where(done, jnp.zeros_like(sum_1), sum_1)
+    new_old = jnp.where(done, num_acc, old_num)
+    new_acc = jnp.where(done, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": [new_sum_1], "out_sum_2": [new_sum_2],
+            "out_sum_3": [new_sum_3], "out_num_accumulates": [new_acc],
+            "out_old_num_accumulates": [new_old],
+            "out_num_updates": [num_upd]}
+
+
+@register_op("sync_batch_norm", nondiff_inputs=["Mean", "Variance"])
+def _sync_batch_norm(ctx, inputs, attrs):
+    """sync_batch_norm_op.cu capability: under GSPMD data parallelism the
+    plain batch_norm already reduces statistics over the GLOBAL batch (the
+    jnp.mean lowers to a cross-replica reduction when the batch axis is
+    sharded) — so this is the same lowering, kept as its own type for
+    program parity with the sync_batch_norm pass."""
+    from .nn_ops import _batch_norm
+    return _batch_norm(ctx, inputs, attrs)
+
+
+# ---------------------------------------------------------------------------
+# LoD workflow machinery — padded+Length redesign
+# ---------------------------------------------------------------------------
+
+@register_op("lod_rank_table", differentiable=False)
+def _lod_rank_table(ctx, inputs, attrs):
+    """lod_rank_table_op.cc: (index, length) sorted by length desc — the
+    metadata DynamicRNN uses to shrink the batch as sequences end."""
+    length = opt_input(inputs, "Length")
+    (x,) = inputs["X"]
+    b = x.shape[0]
+    if length is None:
+        length = jnp.full((b,), x.shape[1], jnp.int32)
+    order = jnp.argsort(-length, stable=True).astype(jnp.int32)
+    return one(jnp.stack([order, length[order].astype(jnp.int32)], axis=1))
+
+
+@register_op("reorder_lod_tensor_by_rank", nondiff_inputs=["RankTable"])
+def _reorder_lod_tensor_by_rank(ctx, inputs, attrs):
+    """reorder_lod_tensor_by_rank_op.cc: permute batch rows into rank-table
+    order (differentiable gather)."""
+    (x,) = inputs["X"]
+    (table,) = inputs["RankTable"]
+    return one(x[table[:, 0]])
+
+
+@register_op("lod_tensor_to_array", nondiff_inputs=["RankTable"])
+def _lod_tensor_to_array(ctx, inputs, attrs):
+    """lod_tensor_to_array_op.cc: batch-major [B, T, ...] → time-major
+    [T, B, ...] (each t-slice is one "array element"; padding rows carry
+    zeros). The static-shape stand-in for the reference's TensorArray of
+    shrinking batches."""
+    (x,) = inputs["X"]
+    return one(jnp.swapaxes(x, 0, 1))
+
+
+@register_op("array_to_lod_tensor", nondiff_inputs=["RankTable"])
+def _array_to_lod_tensor(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.swapaxes(x, 0, 1))
+
+
+@register_op("split_lod_tensor", nondiff_inputs=["Mask"])
+def _split_lod_tensor(ctx, inputs, attrs):
+    """split_lod_tensor_op.cc (IfElse input routing): route rows by boolean
+    mask. Padded redesign: both branches keep full batch shape with
+    non-member rows zeroed; merge_lod_tensor reassembles exactly."""
+    (x,) = inputs["X"]
+    (mask,) = inputs["Mask"]
+    m = mask.reshape(-1).astype(bool)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    mm = m.reshape(shape)
+    return {"OutTrue": [jnp.where(mm, x, 0)],
+            "OutFalse": [jnp.where(mm, 0, x)]}
+
+
+@register_op("merge_lod_tensor", nondiff_inputs=["Mask"])
+def _merge_lod_tensor(ctx, inputs, attrs):
+    (xt,) = inputs["InTrue"]
+    (xf,) = inputs["InFalse"]
+    (mask,) = inputs["Mask"]
+    m = mask.reshape(-1).astype(bool)
+    mm = m.reshape((-1,) + (1,) * (xt.ndim - 1))
+    return one(jnp.where(mm, xt, xf))
+
+
+@register_op("shrink_rnn_memory", nondiff_inputs=["RankTable", "I"])
+def _shrink_rnn_memory(ctx, inputs, attrs):
+    """shrink_rnn_memory_op.cc: at step i, only sequences longer than i stay
+    active. X arrives in RANK-TABLE order (the output of
+    reorder_lod_tensor_by_rank, as in the reference DynamicRNN program), so
+    row r corresponds to table row r and the mask is table[:, 1] > i.
+    Padded redesign: zero (freeze) the ended rows instead of shrinking the
+    leading dim."""
+    (x,) = inputs["X"]
+    (table,) = inputs["RankTable"]
+    (i,) = inputs["I"]
+    step = i.reshape(()).astype(jnp.int32)
+    active = (table[:, 1] > step).reshape((-1,) + (1,) * (x.ndim - 1))
+    return one(jnp.where(active, x, 0))
+
+
+@register_op("rnn_memory_helper")
+def _rnn_memory_helper(ctx, inputs, attrs):
+    """rnn_memory_helper_op.cc: identity bridge for RNN state plumbing (its
+    grad op fills zeros for missing cotangents — the vjp tape handles that
+    here)."""
+    (x,) = inputs["X"]
+    return one(x)

@@ -1,0 +1,428 @@
+"""Fused / specialized sequence-model ops round 2.
+
+References: lstmp_op.cc, warpctc_op.cc, fused/fusion_lstm_op.cc,
+fused/fusion_gru_op.cc, fused/fused_embedding_seq_pool_op.cc,
+fused/fusion_seqconv_eltadd_relu_op.cc, fused/fusion_seqpool_concat_op.cc,
+fused/fusion_seqpool_cvm_concat_op.cc, fused/fusion_repeated_fc_relu_op.cc,
+fused/fusion_squared_mat_sub_op.cc, fused/fusion_transpose_flatten_concat_op.cc,
+match_matrix_tensor_op.cc, var_conv_2d_op.cc, filter_by_instag_op.cc,
+attention_lstm_op.cc, fc_op.cc.
+
+The reference fuses these by hand (jit/xbyak CPU kernels) because its
+executor dispatches op-by-op; on TPU the win is different — one *traced* op
+keeps the pattern intact for the autodiff tape and lets XLA emit a single
+fused kernel around the MXU gemms. Input projections (x @ Wx) are hoisted
+out of the recurrence as one big [B*T, D] x [D, kH] matmul — the
+MXU-friendly shape — and only the [H, kH] recurrent matmul rides the scan.
+
+Variable-length sequences are padded [B, T, ...] + integer Length [B]
+(masked carries), the framework-wide LoD replacement.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import act_map, length_mask, one, opt_input
+
+_ACTS = act_map()
+
+NEG_INF = -1e30
+
+
+@register_op("fc")
+def _fc(ctx, inputs, attrs):
+    """fc_op.cc — same lowering as fused_fc (gemm + bias + act)."""
+    from .fused_ops import _fused_fc
+    return _fused_fc(ctx, inputs, attrs)
+
+
+@register_op("lstmp", nondiff_inputs=["Length"])
+def _lstmp(ctx, inputs, attrs):
+    """lstmp_op.cc: LSTM with recurrent projection (Sak et al.). Input
+    [B,T,4H] pre-projected, Weight [P,4H] recurrent (P = proj size),
+    ProjWeight [H,P]. The carried state is the projection r, not h.
+    Outputs Projection [B,T,P], Cell [B,T,H]."""
+    (x,) = inputs["Input"]
+    (w,) = inputs["Weight"]
+    (w_proj,) = inputs["ProjWeight"]
+    bias = opt_input(inputs, "Bias")
+    length = opt_input(inputs, "Length")
+    h0 = opt_input(inputs, "H0")   # actually r0 [B,P]
+    c0 = opt_input(inputs, "C0")
+
+    B, T, four_h = x.shape
+    H = four_h // 4
+    P = w_proj.shape[1]
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACTS[attrs.get("proj_activation", "tanh")]
+    cell_clip = float(attrs.get("cell_clip", 0.0) or 0.0)
+    proj_clip = float(attrs.get("proj_clip", 0.0) or 0.0)
+
+    r0 = h0 if h0 is not None else jnp.zeros((B, P), x.dtype)
+    c0 = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+    b = None if bias is None else bias.reshape(-1)[: 4 * H]
+    mask = length_mask(length, B, T, x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    def step(carry, xm):
+        r_prev, c_prev = carry
+        xt, mt = xm
+        gates = xt + r_prev @ w
+        if b is not None:
+            gates = gates + b
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        c_new = gate_act(gf) * c_prev + gate_act(gi) * cand_act(gc)
+        if cell_clip > 0:
+            c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+        h_new = gate_act(go) * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        if proj_clip > 0:
+            r_new = jnp.clip(r_new, -proj_clip, proj_clip)
+        m = mt.reshape(-1, 1).astype(x.dtype)
+        r_new = r_new * m + r_prev * (1 - m)
+        c_new = c_new * m + c_prev * (1 - m)
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = lax.scan(step, (r0, c0), (xs, ms))
+    return {"Projection": [jnp.swapaxes(rs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)],
+            "Hidden": [jnp.swapaxes(rs, 0, 1)]}
+
+
+@register_op("warpctc", nondiff_inputs=["Label", "LogitsLength", "LabelLength"])
+def _warpctc(ctx, inputs, attrs):
+    """warpctc_op.cc capability, reimplemented as the standard log-space CTC
+    forward algorithm under lax.scan (differentiable via the vjp tape — the
+    reference carries a separate WarpCTCGrad buffer instead).
+
+    Logits [B, T, C] unnormalized, Label [B, L] int32 (padded arbitrarily
+    past LabelLength), LogitsLength [B], LabelLength [B]. blank attr.
+    Output Loss [B, 1] = -log p(label | logits).
+    """
+    (logits,) = inputs["Logits"]
+    (label,) = inputs["Label"]
+    logits_len = opt_input(inputs, "LogitsLength")
+    label_len = opt_input(inputs, "LabelLength")
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = attrs.get("norm_by_times", False)
+
+    B, T, C = logits.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+    if logits_len is None:
+        logits_len = jnp.full((B,), T, jnp.int32)
+    if label_len is None:
+        label_len = jnp.full((B,), L, jnp.int32)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    def per_sample(lp_b, lab, t_len, l_len):
+        # extended label: [blank, l0, blank, l1, ..., blank]
+        ext = jnp.full((S,), blank, jnp.int32)
+        ext = ext.at[1::2].set(lab)
+        s_valid = jnp.arange(S) < (2 * l_len + 1)
+        # skip-transition allowed into odd (label) positions whose label
+        # differs from the label two back
+        prev2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+        can_skip = (ext != blank) & (ext != prev2)
+
+        alpha0 = jnp.full((S,), NEG_INF)
+        alpha0 = alpha0.at[0].set(lp_b[0, blank])
+        alpha0 = alpha0.at[1].set(
+            jnp.where(l_len > 0, lp_b[0, ext[1]], NEG_INF))
+
+        def step(alpha, t):
+            a_prev1 = jnp.concatenate([jnp.array([NEG_INF]), alpha[:-1]])
+            a_prev2 = jnp.concatenate([jnp.full((2,), NEG_INF), alpha[:-2]])
+            a_prev2 = jnp.where(can_skip, a_prev2, NEG_INF)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+            new = merged + lp_b[t, ext]
+            new = jnp.where(s_valid, new, NEG_INF)
+            # steps past the sample's logit length carry alpha unchanged
+            return jnp.where(t < t_len, new, alpha), None
+
+        alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+        end1 = alpha[2 * l_len]          # final blank
+        end2 = jnp.where(l_len > 0, alpha[2 * l_len - 1], NEG_INF)
+        ll = jnp.logaddexp(end1, end2)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(t_len.astype(jnp.float32), 1.0)
+        return loss
+
+    loss = jax.vmap(per_sample)(lp, label, logits_len, label_len)
+    return {"Loss": [loss.reshape(B, 1)]}
+
+
+@register_op("fusion_lstm", nondiff_inputs=["Length"])
+def _fusion_lstm(ctx, inputs, attrs):
+    """fusion_lstm_op.cc: fc + dynamic_lstm in one op. X [B,T,D],
+    WeightX [D,4H], WeightH [H,4H], Bias [4H]. The input projection is one
+    [B*T, D] x [D, 4H] gemm (MXU-shaped), only the recurrence scans."""
+    from .rnn_ops import _lstm
+    (x,) = inputs["X"]
+    (wx,) = inputs["WeightX"]
+    (wh,) = inputs["WeightH"]
+    bias = opt_input(inputs, "Bias")
+    projected = jnp.einsum("btd,dh->bth", x, wx)
+    sub = {"Input": [projected], "Weight": [wh]}
+    if bias is not None:
+        sub["Bias"] = [bias]
+    if inputs.get("Length"):
+        sub["Length"] = inputs["Length"]
+    if inputs.get("H0"):
+        sub["H0"] = inputs["H0"]
+    if inputs.get("C0"):
+        sub["C0"] = inputs["C0"]
+    return _lstm(ctx, sub, attrs)
+
+
+@register_op("fusion_gru", nondiff_inputs=["Length"])
+def _fusion_gru(ctx, inputs, attrs):
+    """fusion_gru_op.cc: fc + dynamic_gru in one op."""
+    from .rnn_ops import _gru
+    (x,) = inputs["X"]
+    (wx,) = inputs["WeightX"]
+    (wh,) = inputs["WeightH"]
+    projected = jnp.einsum("btd,dh->bth", x, wx)
+    sub = {"Input": [projected], "Weight": [wh]}
+    for slot in ("Bias", "Length", "H0"):
+        if inputs.get(slot):
+            sub[slot] = inputs[slot]
+    return _gru(ctx, sub, attrs)
+
+
+@register_op("attention_lstm", nondiff_inputs=["Length"])
+def _attention_lstm(ctx, inputs, attrs):
+    """attention_lstm_op.cc: per output step, score every timestep of X
+    against the previous hidden state through a small fc, softmax over time,
+    attend, then one LSTM step on the attended vector.
+
+    X [B,T,D]; AttentionWeight [D+H, 1]; LSTMWeight [D+H, 4H];
+    LSTMBias [4H]. Outputs Hidden [B,T,H], Cell [B,T,H]."""
+    (x,) = inputs["X"]
+    (w_att,) = inputs["AttentionWeight"]
+    (w_lstm,) = inputs["LSTMWeight"]
+    b_att = opt_input(inputs, "AttentionBias")
+    b_lstm = opt_input(inputs, "LSTMBias")
+    length = opt_input(inputs, "Length")
+    B, T, D = x.shape
+    H = w_lstm.shape[1] // 4
+    mask = length_mask(length, B, T, x.dtype)          # [B, T]
+    h0 = opt_input(inputs, "H0")
+    c0 = opt_input(inputs, "C0")
+    h0 = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+    c0 = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+    cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
+
+    def step(carry, t):
+        h_prev, c_prev = carry
+        # attention scores: fc([x_t', h_prev]) for every t'
+        hx = jnp.concatenate(
+            [x, jnp.broadcast_to(h_prev[:, None, :], (B, T, H))], axis=-1)
+        score = jnp.einsum("btd,dk->btk", hx, w_att)[..., 0]   # [B, T]
+        if b_att is not None:
+            score = score + b_att.reshape(-1)[0]
+        score = jnp.where(mask > 0, score, NEG_INF)
+        att = jax.nn.softmax(score, axis=-1)
+        ctx_vec = jnp.einsum("bt,btd->bd", att, x)             # [B, D]
+        gates = jnp.concatenate([ctx_vec, h_prev], -1) @ w_lstm
+        if b_lstm is not None:
+            gates = gates + b_lstm.reshape(-1)
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        c_new = gate_act(gf) * c_prev + gate_act(gi) * cand_act(gc)
+        h_new = gate_act(go) * cell_act(c_new)
+        m = mask[:, t].reshape(-1, 1).astype(x.dtype)
+        h_new = h_new * m + h_prev * (1 - m)
+        c_new = c_new * m + c_prev * (1 - m)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), jnp.arange(T))
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)]}
+
+
+@register_op("fused_embedding_seq_pool", nondiff_inputs=["Ids", "Length"])
+def _fused_embedding_seq_pool(ctx, inputs, attrs):
+    """fused_embedding_seq_pool_op.cc: embedding lookup + sum-pool over the
+    sequence in one op. Ids [B, T] int, W [V, D], Length [B]."""
+    (ids,) = inputs["Ids"]
+    (w,) = inputs["W"]
+    length = opt_input(inputs, "Length")
+    if ids.ndim == 3:   # reference sometimes feeds [B, T, 1]
+        ids = ids[..., 0]
+    B, T = ids.shape
+    emb = w[ids]                                        # [B, T, D]
+    mask = length_mask(length, B, T, emb.dtype)
+    pooled = jnp.einsum("btd,bt->bd", emb, mask)
+    combiner = attrs.get("combiner", "sum")
+    if combiner == "mean":
+        pooled = pooled / jnp.maximum(mask.sum(-1, keepdims=True), 1.0)
+    return one(pooled)
+
+
+@register_op("fusion_seqconv_eltadd_relu", nondiff_inputs=["Length"])
+def _fusion_seqconv_eltadd_relu(ctx, inputs, attrs):
+    """fusion_seqconv_eltadd_relu_op.cc: sequence_conv + bias + relu."""
+    from .sequence_ops import _sequence_conv
+    sub = {"X": inputs["X"], "Filter": inputs["Filter"]}
+    if inputs.get("Length"):
+        sub["Length"] = inputs["Length"]
+    out = _sequence_conv(ctx, sub, {
+        "contextLength": attrs.get("contextLength", 3),
+        "contextStart": attrs.get("contextStart", 0)})["Out"][0]
+    (b,) = inputs["Bias"]
+    return one(jax.nn.relu(out + b.reshape(1, 1, -1)))
+
+
+@register_op("fusion_seqpool_concat", nondiff_inputs=["Length"])
+def _fusion_seqpool_concat(ctx, inputs, attrs):
+    """fusion_seqpool_concat_op.cc: seq-pool each input, concat features."""
+    xs = inputs["X"]
+    lengths = inputs.get("Length") or [None] * len(xs)
+    pooltype = attrs.get("pooltype", "SUM").upper()
+    outs = []
+    for x, ln in zip(xs, lengths):
+        B, T = x.shape[0], x.shape[1]
+        m = length_mask(ln, B, T, x.dtype)
+        if pooltype == "SUM":
+            outs.append(jnp.einsum("btd,bt->bd", x, m))
+        elif pooltype == "AVERAGE":
+            s = jnp.einsum("btd,bt->bd", x, m)
+            outs.append(s / jnp.maximum(m.sum(-1, keepdims=True), 1.0))
+        else:  # MAX/SQRT fall back to max; empty sequences emit pad 0.0
+            mx = jnp.max(jnp.where(m[..., None] > 0, x, NEG_INF), axis=1)
+            empty = m.sum(-1, keepdims=True) == 0
+            outs.append(jnp.where(empty, 0.0, mx))
+    return one(jnp.concatenate(outs, axis=-1))
+
+
+@register_op("fusion_seqpool_cvm_concat", nondiff_inputs=["CVM", "Length"])
+def _fusion_seqpool_cvm_concat(ctx, inputs, attrs):
+    """fusion_seqpool_cvm_concat_op.cc: seqpool + cvm (show/click feature
+    normalization, cvm_op.cc) + concat."""
+    pooled = _fusion_seqpool_concat(
+        ctx, {"X": inputs["X"], "Length": inputs.get("Length")},
+        {"pooltype": attrs.get("pooltype", "SUM")})["Out"][0]
+    use_cvm = attrs.get("use_cvm", True)
+    if not use_cvm:
+        # drop the leading 2 cvm slots of each concatenated block, using
+        # each input's own feature width (widths may differ)
+        parts, pos = [], 0
+        for x in inputs["X"]:
+            d = x.shape[-1]
+            parts.append(pooled[:, pos + 2:pos + d])
+            pos += d
+        return one(jnp.concatenate(parts, axis=-1))
+    return one(pooled)
+
+
+@register_op("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(ctx, inputs, attrs):
+    """fusion_repeated_fc_relu_op.cc: a chain of fc+relu layers in one op
+    (final fc has no relu, matching the reference)."""
+    (x,) = inputs["X"]
+    ws = inputs["W"]
+    bs = inputs["Bias"]
+    out = x.reshape(x.shape[0], -1)
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        out = out @ w + b.reshape(1, -1)
+        if i < len(ws) - 1:
+            out = jax.nn.relu(out)
+    return one(out)
+
+
+@register_op("fusion_squared_mat_sub")
+def _fusion_squared_mat_sub(ctx, inputs, attrs):
+    """fusion_squared_mat_sub_op.cc: scalar * ((X@Y)^2 - (X^2)@(Y^2)) —
+    the pairwise-interaction term of factorization machines."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    scalar = attrs.get("scalar", 1.0)
+    xy = x @ y
+    return one(scalar * (xy * xy - (x * x) @ (y * y)))
+
+
+@register_op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx, inputs, attrs):
+    """fusion_transpose_flatten_concat_op.cc: per input transpose →
+    flatten(axis) → concat along the concat axis."""
+    xs = inputs["X"]
+    trans = [int(a) for a in attrs["trans_axis"]]
+    flat_axis = int(attrs.get("flatten_axis", 1))
+    concat_axis = int(attrs.get("concat_axis", 1))
+    outs = []
+    for x in xs:
+        t = jnp.transpose(x, trans)
+        lead = 1
+        for s in t.shape[:flat_axis]:
+            lead *= s
+        outs.append(t.reshape(lead, -1))
+    return one(jnp.concatenate(outs, axis=concat_axis))
+
+
+@register_op("match_matrix_tensor", nondiff_inputs=["LengthX", "LengthY"])
+def _match_matrix_tensor(ctx, inputs, attrs):
+    """match_matrix_tensor_op.cc: bilinear match of two sequence batches —
+    Out[b, t] = X[b] @ W[:, t, :] @ Y[b]^T for each of dim_t channels.
+    X [B,Tx,D], Y [B,Ty,D], W [D, dim_t, D] → Out [B, dim_t, Tx, Ty]."""
+    (x,) = inputs["X"]
+    (y,) = inputs["Y"]
+    (w,) = inputs["W"]
+    lx = opt_input(inputs, "LengthX")
+    ly = opt_input(inputs, "LengthY")
+    out = jnp.einsum("bxd,dte,bye->btxy", x, w, y)
+    B, Tx, Ty = x.shape[0], x.shape[1], y.shape[1]
+    mx = length_mask(lx, B, Tx, out.dtype)
+    my = length_mask(ly, B, Ty, out.dtype)
+    out = out * mx[:, None, :, None] * my[:, None, None, :]
+    return {"Out": [out], "Tmp": [jnp.einsum("bxd,dte->bxte", x, w)]}
+
+
+@register_op("var_conv_2d", nondiff_inputs=["LengthX", "LengthY"])
+def _var_conv_2d(ctx, inputs, attrs):
+    """var_conv_2d_op.cc: conv over per-sample variable-size 2-D maps (the
+    match-matrix output). Padded redesign: X [B, C_in, H, W] with validity
+    from LengthX/LengthY masks; W [C_out, C_in*kh*kw]."""
+    (x,) = inputs["X"]
+    (w,) = inputs["W"]
+    lx = opt_input(inputs, "LengthX")
+    ly = opt_input(inputs, "LengthY")
+    kh = int(attrs.get("kernel_h", 3))
+    kw = int(attrs.get("kernel_w", 3))
+    sh = int(attrs.get("stride_h", 1))
+    sw = int(attrs.get("stride_w", 1))
+    B, cin, H, W = x.shape
+    mh = length_mask(lx, B, H, x.dtype)
+    mw = length_mask(ly, B, W, x.dtype)
+    x = x * mh[:, None, :, None] * mw[:, None, None, :]
+    cout = w.shape[0]
+    wk = w.reshape(cout, cin, kh, kw)
+    out = lax.conv_general_dilated(
+        x, wk, window_strides=(sh, sw),
+        padding=[(kh // 2, kh // 2), (kw // 2, kw // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return one(out)
+
+
+@register_op("filter_by_instag", differentiable=False)
+def _filter_by_instag(ctx, inputs, attrs):
+    """filter_by_instag_op.cc: keep rows whose tag set intersects the
+    filter tags. Padded redesign: non-matching rows are zeroed in place
+    (the reference compacts rows — dynamic shape), LossWeight marks keeps."""
+    (ins,) = inputs["Ins"]          # [B, D]
+    (ins_tag,) = inputs["Ins_tag"]  # [B, T] int, -1 padded
+    (filter_tag,) = inputs["Filter_tag"]   # [K] int
+    match = (ins_tag[:, :, None] == filter_tag[None, None, :]).any((1, 2))
+    out = jnp.where(match[:, None], ins, 0.0)
+    lw = match.astype(ins.dtype).reshape(-1, 1)
+    idx = jnp.where(match, jnp.arange(ins.shape[0]), -1).astype(jnp.int32)
+    return {"Out": [out], "LossWeight": [lw], "IndexMap": [idx]}

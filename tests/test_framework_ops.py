@@ -1,0 +1,154 @@
+"""Framework/runtime op checks (save/load ops, coalesce_tensor,
+average_accumulates, LoD workflow machinery parity)."""
+import numpy as np
+
+from op_test_base import OpTest
+
+
+class _T(OpTest):
+    pass
+
+
+def test_save_load_roundtrip(tmp_path):
+    path = str(tmp_path / "var.pkl")
+    x = np.random.RandomState(0).randn(3, 4).astype("float32")
+    t = _T(); t.op_type = "save"
+    t.run_op({"X": x}, attrs={"file_path": path}, output_slots=())
+    t2 = _T(); t2.op_type = "load"
+    out = t2.run_op({}, attrs={"file_path": path})
+    np.testing.assert_allclose(out["Out"], x)
+
+
+def test_save_combine_load_combine(tmp_path):
+    path = str(tmp_path / "bundle.pkl")
+    a = np.ones((2, 2), "float32")
+    b = np.arange(3, dtype="float32")
+    t = _T(); t.op_type = "save_combine"
+    t.run_op({"X": [a, b]}, attrs={"file_path": path}, output_slots=())
+    t2 = _T(); t2.op_type = "load_combine"
+    out = t2.run_op({}, attrs={"file_path": path},
+                    multi_output_counts={"Out": 2})
+    np.testing.assert_allclose(out["Out"][0], a)
+    np.testing.assert_allclose(out["Out"][1], b)
+
+
+def test_coalesce_tensor_views():
+    t = _T(); t.op_type = "coalesce_tensor"
+    a = np.ones((2, 3), "float32")
+    b = 2 * np.ones((4,), "float32")
+    out = t.run_op({"Input": [a, b]}, output_slots=("Output", "FusedOutput"),
+                   multi_output_counts={"Output": 2})
+    assert out["FusedOutput"].shape == (10,)
+    np.testing.assert_allclose(out["Output"][0], a)
+    np.testing.assert_allclose(out["Output"][1], b)
+    np.testing.assert_allclose(out["FusedOutput"][:6], 1.0)
+    np.testing.assert_allclose(out["FusedOutput"][6:], 2.0)
+
+
+def test_average_accumulates_window_cascade():
+    t = _T(); t.op_type = "average_accumulates"
+    p = np.full((2,), 3.0, "float32")
+    zeros = np.zeros((2,), "float32")
+    cnt = np.zeros((), "int64")
+    # min window 2: first call accumulates, second call closes the window
+    s1, s2, s3 = zeros, zeros, zeros
+    na, no, nu = cnt, cnt, cnt
+    for step in range(2):
+        out = t.run_op(
+            {"param": p, "in_sum_1": s1, "in_sum_2": s2, "in_sum_3": s3,
+             "in_num_accumulates": na, "in_old_num_accumulates": no,
+             "in_num_updates": nu},
+            attrs={"average_window": 1.0, "max_average_window": 2,
+                   "min_average_window": 2},
+            output_slots=("out_sum_1", "out_sum_2", "out_sum_3",
+                          "out_num_accumulates", "out_old_num_accumulates",
+                          "out_num_updates"))
+        s1, s2, s3 = out["out_sum_1"], out["out_sum_2"], out["out_sum_3"]
+        na, no, nu = (out["out_num_accumulates"],
+                      out["out_old_num_accumulates"], out["out_num_updates"])
+    # reference cascade: sum_3 takes the closed window, sum_1/sum_2 reset,
+    # old_num ASSIGNED the window size
+    np.testing.assert_allclose(s1, 0.0)
+    np.testing.assert_allclose(s2, 0.0)
+    np.testing.assert_allclose(s3, 6.0)
+    assert int(no) == 2 and int(nu) == 2 and int(na) == 0
+    # downstream ModelAverage estimate: (s1+s2+s3)/(na+no) == param
+    np.testing.assert_allclose(
+        (s1 + s2 + s3) / (int(na) + int(no)), p, rtol=1e-6)
+
+
+def test_lod_rank_table_sorts_by_length():
+    t = _T(); t.op_type = "lod_rank_table"
+    x = np.zeros((3, 5, 2), "float32")
+    length = np.array([2, 5, 3], "int32")
+    out = t.run_op({"X": x, "Length": length})
+    np.testing.assert_array_equal(out["Out"][:, 0], [1, 2, 0])
+    np.testing.assert_array_equal(out["Out"][:, 1], [5, 3, 2])
+
+
+def test_reorder_and_shrink_rnn_memory():
+    t = _T(); t.op_type = "lod_rank_table"
+    x = np.arange(12, dtype="float32").reshape(3, 2, 2)
+    length = np.array([1, 2, 1], "int32")
+    table = t.run_op({"X": x, "Length": length})["Out"]
+    t2 = _T(); t2.op_type = "reorder_lod_tensor_by_rank"
+    ordered = t2.run_op({"X": x, "RankTable": table})["Out"]
+    np.testing.assert_allclose(ordered[0], x[1])   # longest first
+    t3 = _T(); t3.op_type = "shrink_rnn_memory"
+    # shrink consumes X in RANK-TABLE order (reorder output), like the
+    # reference DynamicRNN program
+    out = t3.run_op({"X": ordered, "RankTable": table,
+                     "I": np.array([1], "int64")})["Out"]
+    # at step 1 only the longest sequence (orig sample 1, rank row 0) lives
+    np.testing.assert_allclose(out[0], x[1])
+    np.testing.assert_allclose(out[1], 0.0)
+    np.testing.assert_allclose(out[2], 0.0)
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    x = np.arange(8, dtype="float32").reshape(4, 2)
+    mask = np.array([[1], [0], [1], [0]], "bool")
+    t = _T(); t.op_type = "split_lod_tensor"
+    parts = t.run_op({"X": x, "Mask": mask},
+                     output_slots=("OutTrue", "OutFalse"))
+    np.testing.assert_allclose(parts["OutTrue"][0], x[0])
+    np.testing.assert_allclose(parts["OutTrue"][1], 0.0)
+    t2 = _T(); t2.op_type = "merge_lod_tensor"
+    merged = t2.run_op({"InTrue": parts["OutTrue"],
+                        "InFalse": parts["OutFalse"], "Mask": mask})["Out"]
+    np.testing.assert_allclose(merged, x)
+
+
+def test_lod_tensor_array_roundtrip():
+    x = np.random.RandomState(0).randn(2, 3, 4).astype("float32")
+    t = _T(); t.op_type = "lod_tensor_to_array"
+    tm = t.run_op({"X": x})["Out"]
+    assert tm.shape == (3, 2, 4)
+    t2 = _T(); t2.op_type = "array_to_lod_tensor"
+    back = t2.run_op({"X": tm})["Out"]
+    np.testing.assert_allclose(back, x)
+
+
+def test_fake_init_and_get_places():
+    t = _T(); t.op_type = "fake_init"
+    out = t.run_op({}, attrs={"shape": [2, 3], "dtype": "float32"})
+    assert out["Out"].shape == (2, 3)
+    t2 = _T(); t2.op_type = "get_places"
+    places = t2.run_op({}, attrs={"device_count": 4})["Out"]
+    np.testing.assert_array_equal(places, [0, 1, 2, 3])
+
+
+def test_sync_batch_norm_matches_batch_norm():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3, 2, 2).astype("float32")
+    scale = np.ones((3,), "float32")
+    bias = np.zeros((3,), "float32")
+    mean = np.zeros((3,), "float32")
+    var = np.ones((3,), "float32")
+    ins = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var}
+    slots = ("Y",)
+    t = _T(); t.op_type = "sync_batch_norm"
+    a = t.run_op(dict(ins), attrs={"epsilon": 1e-5}, output_slots=slots)
+    t2 = _T(); t2.op_type = "batch_norm"
+    b = t2.run_op(dict(ins), attrs={"epsilon": 1e-5}, output_slots=slots)
+    np.testing.assert_allclose(a["Y"], b["Y"], rtol=1e-5)

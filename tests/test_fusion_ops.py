@@ -1,0 +1,254 @@
+"""Fusion/sequence-model op family checks (fused/fusion_*_op.cc,
+lstmp_op.cc, warpctc_op.cc, match_matrix_tensor_op.cc parity)."""
+import itertools
+
+import numpy as np
+
+from op_test_base import OpTest
+
+
+class _T(OpTest):
+    pass
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def test_fc_matches_matmul():
+    t = _T(); t.op_type = "fc"
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype("float32")
+    w = rng.randn(4, 5).astype("float32")
+    b = rng.randn(5).astype("float32")
+    out = t.run_op({"Input": x, "W": w, "Bias": b},
+                   attrs={"activation_type": "relu"})
+    np.testing.assert_allclose(out["Out"], np.maximum(x @ w + b, 0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_warpctc_vs_brute_force():
+    """CTC loss equals -log sum over all alignments (path enumeration)."""
+    rng = np.random.RandomState(0)
+    B, T, C, L = 1, 4, 3, 2
+    logits = rng.randn(B, T, C).astype("float32")
+    label = np.array([[1, 2]], "int32")
+    t = _T(); t.op_type = "warpctc"
+    out = t.run_op({"Logits": logits, "Label": label,
+                    "LogitsLength": np.array([T], "int32"),
+                    "LabelLength": np.array([L], "int32")},
+                   attrs={"blank": 0}, output_slots=("Loss",))
+    # brute force: every length-T path over C symbols that collapses
+    # (remove repeats then blanks) to the label
+    probs = _np_softmax(logits[0])
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        collapsed = [k for k, _ in itertools.groupby(path)]
+        collapsed = [c for c in collapsed if c != 0]
+        if collapsed == [1, 2]:
+            p = 1.0
+            for step, sym in enumerate(path):
+                p *= probs[step, sym]
+            total += p
+    expected = -np.log(total)
+    np.testing.assert_allclose(float(out["Loss"]), expected, rtol=1e-4)
+
+
+def test_warpctc_respects_lengths():
+    """Padding steps/labels beyond the declared lengths must not change
+    the loss."""
+    rng = np.random.RandomState(1)
+    logits = rng.randn(1, 6, 4).astype("float32")
+    label = np.array([[2, 3, 0]], "int32")       # only first 2 valid
+    t = _T(); t.op_type = "warpctc"
+    kw = dict(attrs={"blank": 0}, output_slots=("Loss",))
+    l1 = t.run_op({"Logits": logits, "Label": label,
+                   "LogitsLength": np.array([4], "int32"),
+                   "LabelLength": np.array([2], "int32")}, **kw)
+    # garbage in the padded region
+    logits2 = logits.copy(); logits2[0, 4:] = 99.0
+    label2 = label.copy(); label2[0, 2] = 1
+    l2 = t.run_op({"Logits": logits2, "Label": label2,
+                   "LogitsLength": np.array([4], "int32"),
+                   "LabelLength": np.array([2], "int32")}, **kw)
+    np.testing.assert_allclose(float(l1["Loss"]), float(l2["Loss"]), rtol=1e-5)
+
+
+def test_lstmp_projection_shape_and_dynamics():
+    rng = np.random.RandomState(0)
+    B, T, H, P = 2, 3, 4, 2
+    x = rng.randn(B, T, 4 * H).astype("float32") * 0.1
+    w = rng.randn(P, 4 * H).astype("float32") * 0.1
+    wp = rng.randn(H, P).astype("float32") * 0.1
+    t = _T(); t.op_type = "lstmp"
+    out = t.run_op({"Input": x, "Weight": w, "ProjWeight": wp},
+                   output_slots=("Projection", "Cell"))
+    assert out["Projection"].shape == (B, T, P)
+    assert out["Cell"].shape == (B, T, H)
+    # projection is bounded by tanh
+    assert np.abs(out["Projection"]).max() <= 1.0
+
+
+def test_fusion_lstm_equals_fc_plus_lstm():
+    rng = np.random.RandomState(0)
+    B, T, D, H = 2, 3, 4, 5
+    x = rng.randn(B, T, D).astype("float32") * 0.3
+    wx = rng.randn(D, 4 * H).astype("float32") * 0.3
+    wh = rng.randn(H, 4 * H).astype("float32") * 0.3
+    b = rng.randn(4 * H).astype("float32") * 0.3
+    t = _T(); t.op_type = "fusion_lstm"
+    fused = t.run_op({"X": x, "WeightX": wx, "WeightH": wh, "Bias": b},
+                     output_slots=("Hidden",))
+    t2 = _T(); t2.op_type = "lstm"
+    ref = t2.run_op({"Input": (x.reshape(-1, D) @ wx).reshape(B, T, 4 * H),
+                     "Weight": wh, "Bias": b}, output_slots=("Hidden",))
+    np.testing.assert_allclose(fused["Hidden"], ref["Hidden"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_gru_runs_and_masks():
+    rng = np.random.RandomState(0)
+    B, T, D, H = 2, 4, 3, 5
+    x = rng.randn(B, T, D).astype("float32")
+    wx = rng.randn(D, 3 * H).astype("float32") * 0.3
+    wh = rng.randn(H, 3 * H).astype("float32") * 0.3
+    length = np.array([4, 2], "int32")
+    t = _T(); t.op_type = "fusion_gru"
+    out = t.run_op({"X": x, "WeightX": wx, "WeightH": wh, "Length": length},
+                   output_slots=("Hidden",))
+    h = out["Hidden"]
+    # beyond sample 1's length the hidden state stays frozen
+    np.testing.assert_allclose(h[1, 2], h[1, 1], rtol=1e-6)
+    np.testing.assert_allclose(h[1, 3], h[1, 1], rtol=1e-6)
+
+
+def test_attention_lstm_uniform_attention_at_init():
+    rng = np.random.RandomState(0)
+    B, T, D, H = 1, 3, 4, 2
+    x = rng.randn(B, T, D).astype("float32")
+    w_att = np.zeros((D + H, 1), "float32")      # zero scores -> uniform att
+    w_lstm = rng.randn(D + H, 4 * H).astype("float32") * 0.1
+    t = _T(); t.op_type = "attention_lstm"
+    out = t.run_op({"X": x, "AttentionWeight": w_att, "LSTMWeight": w_lstm},
+                   output_slots=("Hidden", "Cell"))
+    assert out["Hidden"].shape == (B, T, H)
+    assert np.isfinite(out["Hidden"]).all()
+
+
+def test_fused_embedding_seq_pool():
+    t = _T(); t.op_type = "fused_embedding_seq_pool"
+    w = np.arange(12, dtype="float32").reshape(4, 3)
+    ids = np.array([[1, 2, 0], [3, 0, 0]], "int32")
+    length = np.array([2, 1], "int32")
+    out = t.run_op({"Ids": ids, "W": w, "Length": length})
+    np.testing.assert_allclose(out["Out"][0], w[1] + w[2])
+    np.testing.assert_allclose(out["Out"][1], w[3])
+
+
+def test_fusion_seqpool_concat():
+    t = _T(); t.op_type = "fusion_seqpool_concat"
+    x1 = np.ones((2, 3, 2), "float32")
+    x2 = 2 * np.ones((2, 3, 4), "float32")
+    l = np.array([3, 1], "int32")
+    out = t.run_op({"X": [x1, x2], "Length": [l, l]},
+                   attrs={"pooltype": "SUM"})
+    assert out["Out"].shape == (2, 6)
+    np.testing.assert_allclose(out["Out"][0], [3, 3, 6, 6, 6, 6])
+    np.testing.assert_allclose(out["Out"][1], [1, 1, 2, 2, 2, 2])
+
+
+def test_fusion_repeated_fc_relu():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3).astype("float32")
+    w1 = rng.randn(3, 4).astype("float32")
+    b1 = rng.randn(4).astype("float32")
+    w2 = rng.randn(4, 2).astype("float32")
+    b2 = rng.randn(2).astype("float32")
+    t = _T(); t.op_type = "fusion_repeated_fc_relu"
+    out = t.run_op({"X": x, "W": [w1, w2], "Bias": [b1, b2]})
+    ref = np.maximum(x @ w1 + b1, 0) @ w2 + b2
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_squared_mat_sub():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    t = _T(); t.op_type = "fusion_squared_mat_sub"
+    out = t.run_op({"X": x, "Y": y}, attrs={"scalar": 0.5})
+    ref = 0.5 * ((x @ y) ** 2 - (x ** 2) @ (y ** 2))
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_match_matrix_tensor():
+    rng = np.random.RandomState(0)
+    B, Tx, Ty, D, dim_t = 2, 3, 4, 5, 2
+    x = rng.randn(B, Tx, D).astype("float32")
+    y = rng.randn(B, Ty, D).astype("float32")
+    w = rng.randn(D, dim_t, D).astype("float32")
+    t = _T(); t.op_type = "match_matrix_tensor"
+    out = t.run_op({"X": x, "Y": y, "W": w}, output_slots=("Out",))
+    ref = np.einsum("bxd,dte,bye->btxy", x, w, y)
+    np.testing.assert_allclose(out["Out"], ref, rtol=1e-3, atol=1e-4)
+
+
+def test_filter_by_instag():
+    t = _T(); t.op_type = "filter_by_instag"
+    ins = np.arange(6, dtype="float32").reshape(3, 2)
+    tags = np.array([[1, -1], [2, 3], [4, -1]], "int32")
+    filt = np.array([3, 7], "int32")
+    out = t.run_op({"Ins": ins, "Ins_tag": tags, "Filter_tag": filt},
+                   output_slots=("Out", "LossWeight"))
+    np.testing.assert_allclose(out["LossWeight"].ravel(), [0, 1, 0])
+    np.testing.assert_allclose(out["Out"][1], ins[1])
+    np.testing.assert_allclose(out["Out"][0], 0)
+
+
+def test_fusion_seqpool_concat_max_empty_sequence():
+    t = _T(); t.op_type = "fusion_seqpool_concat"
+    x = np.ones((2, 2, 3), "float32")
+    l = np.array([2, 0], "int32")
+    out = t.run_op({"X": [x], "Length": [l]}, attrs={"pooltype": "MAX"})
+    np.testing.assert_allclose(out["Out"][0], 1.0)
+    np.testing.assert_allclose(out["Out"][1], 0.0)   # empty -> pad, not -1e30
+
+
+def test_fusion_seqpool_cvm_concat_heterogeneous_widths():
+    t = _T(); t.op_type = "fusion_seqpool_cvm_concat"
+    # widths 3 and 4; use_cvm=False must drop 2 LEADING slots of each block
+    x1 = np.tile(np.array([10, 1, 2], "float32"), (1, 2, 1))
+    x2 = np.tile(np.array([20, 30, 5, 6], "float32"), (1, 2, 1))
+    l = np.array([1], "int32")
+    out = t.run_op({"X": [x1, x2], "Length": [l, l]},
+                   attrs={"pooltype": "SUM", "use_cvm": False})
+    np.testing.assert_allclose(out["Out"][0], [2, 5, 6])
+
+
+def test_lstmp_proj_clip():
+    rng = np.random.RandomState(0)
+    B, T, H, P = 1, 2, 3, 2
+    x = (rng.randn(B, T, 4 * H) * 5).astype("float32")
+    w = (rng.randn(P, 4 * H)).astype("float32")
+    wp = (rng.randn(H, P) * 5).astype("float32")
+    t = _T(); t.op_type = "lstmp"
+    out = t.run_op({"Input": x, "Weight": w, "ProjWeight": wp},
+                   attrs={"proj_activation": "identity", "proj_clip": 0.1},
+                   output_slots=("Projection",))
+    assert np.abs(out["Projection"]).max() <= 0.1 + 1e-6
+
+
+def test_attention_lstm_respects_initial_state():
+    rng = np.random.RandomState(0)
+    B, T, D, H = 1, 2, 3, 2
+    x = rng.randn(B, T, D).astype("float32")
+    w_att = rng.randn(D + H, 1).astype("float32")
+    w_lstm = rng.randn(D + H, 4 * H).astype("float32") * 0.3
+    t = _T(); t.op_type = "attention_lstm"
+    base = t.run_op({"X": x, "AttentionWeight": w_att, "LSTMWeight": w_lstm},
+                    output_slots=("Hidden",))
+    warm = t.run_op({"X": x, "AttentionWeight": w_att, "LSTMWeight": w_lstm,
+                     "H0": np.full((B, H), 2.0, "float32"),
+                     "C0": np.full((B, H), -2.0, "float32")},
+                    output_slots=("Hidden",))
+    assert not np.allclose(base["Hidden"], warm["Hidden"])
