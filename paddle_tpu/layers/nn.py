@@ -285,6 +285,19 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None) -> Variable:
     return out
 
 
+def cos_sim(X: Variable, Y: Variable, name=None) -> Variable:
+    """Cosine similarity along the last axis (reference nn.py cos_sim →
+    cos_sim_op.cc). Returns [..., 1]."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X.name], "Y": [Y.name]},
+                     outputs={"Out": [out.name], "XNorm": [xn.name],
+                              "YNorm": [yn.name]}, attrs={})
+    return out
+
+
 # -- losses -----------------------------------------------------------------
 
 def cross_entropy(input: Variable, label: Variable, soft_label: bool = False,

@@ -62,14 +62,22 @@ def _lstmp(ctx, inputs, attrs):
     proj_act = _ACTS[attrs.get("proj_activation", "tanh")]
     cell_clip = float(attrs.get("cell_clip", 0.0) or 0.0)
     proj_clip = float(attrs.get("proj_clip", 0.0) or 0.0)
+    is_reverse = attrs.get("is_reverse", False)
+    use_peepholes = attrs.get("use_peepholes", False) and \
+        bias is not None and bias.reshape(-1).shape[0] == 7 * H
 
     r0 = h0 if h0 is not None else jnp.zeros((B, P), x.dtype)
     c0 = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
     b = None if bias is None else bias.reshape(-1)[: 4 * H]
+    if use_peepholes:
+        pk = bias.reshape(-1)
+        w_ic, w_fc, w_oc = pk[4 * H:5 * H], pk[5 * H:6 * H], pk[6 * H:7 * H]
     mask = length_mask(length, B, T, x.dtype)
 
     xs = jnp.swapaxes(x, 0, 1)
     ms = jnp.swapaxes(mask, 0, 1)
+    if is_reverse:
+        xs, ms = xs[::-1], ms[::-1]
 
     def step(carry, xm):
         r_prev, c_prev = carry
@@ -78,9 +86,14 @@ def _lstmp(ctx, inputs, attrs):
         if b is not None:
             gates = gates + b
         gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
         c_new = gate_act(gf) * c_prev + gate_act(gi) * cand_act(gc)
         if cell_clip > 0:
             c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+        if use_peepholes:
+            go = go + c_new * w_oc
         h_new = gate_act(go) * cell_act(c_new)
         r_new = proj_act(h_new @ w_proj)
         if proj_clip > 0:
@@ -91,6 +104,8 @@ def _lstmp(ctx, inputs, attrs):
         return (r_new, c_new), (r_new, c_new)
 
     (_, _), (rs, cs) = lax.scan(step, (r0, c0), (xs, ms))
+    if is_reverse:
+        rs, cs = rs[::-1], cs[::-1]
     return {"Projection": [jnp.swapaxes(rs, 0, 1)],
             "Cell": [jnp.swapaxes(cs, 0, 1)],
             "Hidden": [jnp.swapaxes(rs, 0, 1)]}
@@ -223,12 +238,14 @@ def _attention_lstm(ctx, inputs, attrs):
     gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
     cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
 
+    # the x-part of the attention score is loop-invariant — one [B,T,D]x[D,1]
+    # product hoisted out of the scan; only h_prev @ w_att[D:] rides the loop
+    x_score = jnp.einsum("btd,dk->btk", x, w_att[:D])[..., 0]   # [B, T]
+    w_att_h = w_att[D:]                                         # [H, 1]
+
     def step(carry, t):
         h_prev, c_prev = carry
-        # attention scores: fc([x_t', h_prev]) for every t'
-        hx = jnp.concatenate(
-            [x, jnp.broadcast_to(h_prev[:, None, :], (B, T, H))], axis=-1)
-        score = jnp.einsum("btd,dk->btk", hx, w_att)[..., 0]   # [B, T]
+        score = x_score + (h_prev @ w_att_h)                    # [B,T]+[B,1]
         if b_att is not None:
             score = score + b_att.reshape(-1)[0]
         score = jnp.where(mask > 0, score, NEG_INF)
@@ -285,23 +302,23 @@ def _fusion_seqconv_eltadd_relu(ctx, inputs, attrs):
 
 @register_op("fusion_seqpool_concat", nondiff_inputs=["Length"])
 def _fusion_seqpool_concat(ctx, inputs, attrs):
-    """fusion_seqpool_concat_op.cc: seq-pool each input, concat features."""
+    """fusion_seqpool_concat_op.cc: seq-pool each input (delegating to the
+    sequence_pool lowering — SUM/AVERAGE/SQRT/MAX/LAST/FIRST all supported),
+    concat features. Empty sequences emit pad 0.0 under MAX."""
+    from .sequence_ops import _sequence_pool
     xs = inputs["X"]
     lengths = inputs.get("Length") or [None] * len(xs)
     pooltype = attrs.get("pooltype", "SUM").upper()
     outs = []
     for x, ln in zip(xs, lengths):
-        B, T = x.shape[0], x.shape[1]
-        m = length_mask(ln, B, T, x.dtype)
-        if pooltype == "SUM":
-            outs.append(jnp.einsum("btd,bt->bd", x, m))
-        elif pooltype == "AVERAGE":
-            s = jnp.einsum("btd,bt->bd", x, m)
-            outs.append(s / jnp.maximum(m.sum(-1, keepdims=True), 1.0))
-        else:  # MAX/SQRT fall back to max; empty sequences emit pad 0.0
-            mx = jnp.max(jnp.where(m[..., None] > 0, x, NEG_INF), axis=1)
-            empty = m.sum(-1, keepdims=True) == 0
-            outs.append(jnp.where(empty, 0.0, mx))
+        if ln is None:
+            ln = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+        pooled = _sequence_pool(ctx, {"X": [x], "Length": [ln]},
+                                {"pooltype": pooltype})["Out"][0]
+        if pooltype == "MAX":
+            empty = (ln == 0).reshape((-1,) + (1,) * (pooled.ndim - 1))
+            pooled = jnp.where(empty, 0.0, pooled)
+        outs.append(pooled)
     return one(jnp.concatenate(outs, axis=-1))
 
 

@@ -1,0 +1,206 @@
+"""Book-model end-to-end convergence smokes — the reference's
+tests/book suite (test_fit_a_line.py, test_word2vec.py,
+test_recommender_system.py, test_rnn_encoder_decoder.py,
+test_label_semantic_roles.py, test_machine_translation.py). Each builds the
+classic model through the layers DSL, trains a few steps on synthetic data,
+and asserts the loss drops; fit_a_line also round-trips
+save/load_inference_model like the originals. (recognize_digits lives in
+test_mnist.py, image_classification in test_parallel/bench.)
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _train(main, startup, feed_fn, loss, steps=12, exe=None):
+    exe = exe or fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for i in range(steps):
+        out = exe.run(main, feed=feed_fn(i), fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).ravel()[0]))
+    return losses, exe
+
+
+def test_fit_a_line(tmp_path):
+    """test_fit_a_line.py: linear regression, SGD, save/load inference."""
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(13, 1).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    def feed(i):
+        xv = rng.randn(16, 13).astype("float32")
+        return {"x": xv, "y": xv @ true_w}
+
+    losses, exe = _train(main, startup, feed, loss, steps=30)
+    assert losses[-1] < losses[0] * 0.2, losses
+    # save / reload / infer (book pattern)
+    d = str(tmp_path / "fit_a_line")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe, main_program=main)
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    xv = rng.randn(4, 13).astype("float32")
+    out = exe.run(prog, feed={feeds[0]: xv}, fetch_list=fetches)
+    assert np.asarray(out[0]).shape == (4, 1)
+
+
+def test_word2vec_nce_and_hsigmoid():
+    """test_word2vec.py: N-gram LM — embeddings concat -> hidden -> nce
+    (and an hsigmoid variant), loss decreases."""
+    vocab, emb_dim = 40, 8
+    rng = np.random.RandomState(0)
+    for head in ("nce", "hsigmoid"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                     for i in range(4)]
+            target = fluid.layers.data(name="tgt", shape=[1], dtype="int64")
+            embs = [fluid.layers.embedding(w, size=[vocab, emb_dim])
+                    for w in words]
+            concat = fluid.layers.concat(embs, axis=1)
+            hidden = fluid.layers.fc(concat, size=16, act="sigmoid")
+            if head == "nce":
+                cost = fluid.layers.nce(hidden, target,
+                                        num_total_classes=vocab,
+                                        num_neg_samples=5)
+            else:
+                cost = fluid.layers.hsigmoid(hidden, target,
+                                             num_classes=vocab)
+            loss = fluid.layers.mean(cost)
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+        def feed(i):
+            f = {f"w{j}": rng.randint(0, vocab, (8, 1)).astype("int64")
+                 for j in range(4)}
+            f["tgt"] = rng.randint(0, vocab, (8, 1)).astype("int64")
+            return f
+
+        # fixed batch each step so memorization is measurable
+        batch = feed(0)
+        losses, _ = _train(main, startup, lambda i: batch, loss, steps=20)
+        assert losses[-1] < losses[0] * 0.8, (head, losses)
+
+
+def test_recommender_system():
+    """test_recommender_system.py: user/item embeddings -> fc towers ->
+    cos_sim -> regression on rating."""
+    n_users, n_items = 30, 50
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = fluid.layers.data(name="uid", shape=[1], dtype="int64")
+        mid = fluid.layers.data(name="mid", shape=[1], dtype="int64")
+        rating = fluid.layers.data(name="score", shape=[1], dtype="float32")
+        uemb = fluid.layers.embedding(uid, size=[n_users, 16])
+        memb = fluid.layers.embedding(mid, size=[n_items, 16])
+        uvec = fluid.layers.fc(uemb, size=16, act="relu")
+        mvec = fluid.layers.fc(memb, size=16, act="relu")
+        sim = fluid.layers.cos_sim(uvec, mvec)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, rating))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    users = rng.randint(0, n_users, (32, 1)).astype("int64")
+    items = rng.randint(0, n_items, (32, 1)).astype("int64")
+    scores = rng.randint(1, 6, (32, 1)).astype("float32")
+    batch = {"uid": users, "mid": items, "score": scores}
+    losses, _ = _train(main, startup, lambda i: batch, loss, steps=25)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_rnn_encoder_decoder():
+    """test_rnn_encoder_decoder.py / test_machine_translation.py train halves:
+    GRU encoder -> decoder with teacher forcing -> per-step softmax CE."""
+    src_vocab, tgt_vocab, hid = 25, 20, 16
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[6], dtype="int64")
+        tgt_in = fluid.layers.data(name="tgt_in", shape=[5], dtype="int64")
+        tgt_out = fluid.layers.data(name="tgt_out", shape=[5], dtype="int64")
+        semb = fluid.layers.embedding(src, size=[src_vocab, hid])
+        enc = fluid.layers.dynamic_gru(
+            fluid.layers.fc(semb, size=3 * hid, num_flatten_dims=2), size=hid)
+        enc_last = fluid.layers.reduce_max(enc, dim=1)
+        temb = fluid.layers.embedding(tgt_in, size=[tgt_vocab, hid])
+        dec = fluid.layers.dynamic_gru(
+            fluid.layers.fc(temb, size=3 * hid, num_flatten_dims=2),
+            size=hid, h_0=enc_last)
+        logits = fluid.layers.fc(dec, size=tgt_vocab, num_flatten_dims=2)
+        lbl = fluid.layers.reshape(tgt_out, shape=[-1, 5, 1])
+        ce = fluid.layers.softmax_with_cross_entropy(logits, lbl)
+        loss = fluid.layers.mean(ce)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    batch = {"src": rng.randint(0, src_vocab, (8, 6)).astype("int64"),
+             "tgt_in": rng.randint(0, tgt_vocab, (8, 5)).astype("int64"),
+             "tgt_out": rng.randint(0, tgt_vocab, (8, 5)).astype("int64")}
+    losses, _ = _train(main, startup, lambda i: batch, loss, steps=20)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_label_semantic_roles():
+    """test_label_semantic_roles.py: embedding -> lstm -> linear_chain_crf
+    training + crf_decoding inference."""
+    vocab, n_labels, hid = 30, 7, 12
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = fluid.layers.data(name="word", shape=[6], dtype="int64")
+        mark = fluid.layers.data(name="mark", shape=[6], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[6], dtype="int64")
+        wemb = fluid.layers.embedding(word, size=[vocab, hid],
+                                      param_attr=fluid.ParamAttr(name="wemb"))
+        memb = fluid.layers.embedding(mark, size=[2, hid],
+                                      param_attr=fluid.ParamAttr(name="memb"))
+        feat = fluid.layers.concat([wemb, memb], axis=2)
+        rnn, _ = fluid.layers.dynamic_lstm(
+            fluid.layers.fc(feat, size=4 * hid, num_flatten_dims=2,
+                            param_attr=fluid.ParamAttr(name="proj_w"),
+                            bias_attr=fluid.ParamAttr(name="proj_b")),
+            size=4 * hid, param_attr=fluid.ParamAttr(name="lstm_w"),
+            bias_attr=fluid.ParamAttr(name="lstm_b"))
+        emission = fluid.layers.fc(rnn, size=n_labels, num_flatten_dims=2,
+                                   param_attr=fluid.ParamAttr(name="emis_w"),
+                                   bias_attr=fluid.ParamAttr(name="emis_b"))
+        crf_cost = fluid.layers.linear_chain_crf(
+            emission, label, param_attr=fluid.ParamAttr(name="crfw"))
+        loss = fluid.layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    batch = {"word": rng.randint(0, vocab, (4, 6)).astype("int64"),
+             "mark": rng.randint(0, 2, (4, 6)).astype("int64"),
+             "label": rng.randint(0, n_labels, (4, 6)).astype("int64")}
+    losses, exe = _train(main, startup, lambda i: batch, loss, steps=15)
+    assert losses[-1] < losses[0] * 0.9, losses
+
+    # decoding path (inference half of the book test)
+    infer = fluid.Program()
+    with fluid.program_guard(infer, fluid.Program()):
+        word = fluid.layers.data(name="word", shape=[6], dtype="int64")
+        mark = fluid.layers.data(name="mark", shape=[6], dtype="int64")
+        wemb = fluid.layers.embedding(word, size=[vocab, hid],
+                                      param_attr=fluid.ParamAttr(name="wemb"))
+        memb = fluid.layers.embedding(mark, size=[2, hid],
+                                      param_attr=fluid.ParamAttr(name="memb"))
+        feat = fluid.layers.concat([wemb, memb], axis=2)
+        rnn, _ = fluid.layers.dynamic_lstm(
+            fluid.layers.fc(feat, size=4 * hid, num_flatten_dims=2,
+                            param_attr=fluid.ParamAttr(name="proj_w"),
+                            bias_attr=fluid.ParamAttr(name="proj_b")),
+            size=4 * hid, param_attr=fluid.ParamAttr(name="lstm_w"),
+            bias_attr=fluid.ParamAttr(name="lstm_b"))
+        emission = fluid.layers.fc(rnn, size=n_labels, num_flatten_dims=2,
+                                   param_attr=fluid.ParamAttr(name="emis_w"),
+                                   bias_attr=fluid.ParamAttr(name="emis_b"))
+        decode = fluid.layers.crf_decoding(
+            emission, param_attr=fluid.ParamAttr(name="crfw"))
+    out = exe.run(infer, feed={"word": batch["word"], "mark": batch["mark"]},
+                  fetch_list=[decode])
+    path = np.asarray(out[0])
+    assert path.shape[0] == 4 and path.min() >= 0 and path.max() < n_labels

@@ -252,3 +252,37 @@ def test_attention_lstm_respects_initial_state():
                      "C0": np.full((B, H), -2.0, "float32")},
                     output_slots=("Hidden",))
     assert not np.allclose(base["Hidden"], warm["Hidden"])
+
+
+def test_fusion_seqpool_concat_sqrt():
+    t = _T(); t.op_type = "fusion_seqpool_concat"
+    x = np.ones((1, 4, 2), "float32")
+    l = np.array([4], "int32")
+    out = t.run_op({"X": [x], "Length": [l]}, attrs={"pooltype": "SQRT"})
+    np.testing.assert_allclose(out["Out"][0], 4.0 / 2.0)   # sum/sqrt(len)
+
+
+def test_lstmp_peepholes_and_reverse():
+    rng = np.random.RandomState(0)
+    B, T, H, P = 1, 3, 2, 2
+    x = rng.randn(B, T, 4 * H).astype("float32") * 0.2
+    w = rng.randn(P, 4 * H).astype("float32") * 0.2
+    wp = rng.randn(H, P).astype("float32") * 0.2
+    b4 = rng.randn(4 * H).astype("float32") * 0.2
+    b7 = np.concatenate([b4, rng.randn(3 * H).astype("float32")])
+    t = _T(); t.op_type = "lstmp"
+    plain = t.run_op({"Input": x, "Weight": w, "ProjWeight": wp, "Bias": b4},
+                     output_slots=("Projection",))
+    peep = t.run_op({"Input": x, "Weight": w, "ProjWeight": wp, "Bias": b7},
+                    attrs={"use_peepholes": True},
+                    output_slots=("Projection",))
+    assert not np.allclose(plain["Projection"], peep["Projection"])
+    rev = t.run_op({"Input": x, "Weight": w, "ProjWeight": wp, "Bias": b4},
+                   attrs={"is_reverse": True}, output_slots=("Projection",))
+    # reversed scan of reversed input == forward scan, re-reversed
+    fwd_of_flipped = t.run_op({"Input": x[:, ::-1].copy(), "Weight": w,
+                               "ProjWeight": wp, "Bias": b4},
+                              output_slots=("Projection",))
+    np.testing.assert_allclose(rev["Projection"],
+                               fwd_of_flipped["Projection"][:, ::-1],
+                               rtol=1e-5, atol=1e-6)
