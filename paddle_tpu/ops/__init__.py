@@ -23,6 +23,7 @@ from . import (  # noqa: F401
     misc_ops,
     nn_ops,
     optimizer_ops,
+    parity_ops,
     pipeline_ops,
     quant_ops,
     reduce_ops,

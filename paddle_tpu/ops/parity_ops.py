@@ -1,0 +1,423 @@
+"""Parity-op sweep round 2 — the remaining implementable reference ops.
+
+References: recurrent_op.cc (recurrent), conditional_block_op.cc
+(conditional_block_infer), quantize_op.cc / dequantize_op.cc /
+requantize_op.cc (mkldnn int8 trio), fake_quantize_op.cc
+(fake_quantize_dequantize_moving_average_abs_max), fused/conv_fusion_op.cc
+(conv2d_fusion), fused/fusion_seqexpand_concat_fc_op.cc,
+fused/fused_embedding_fc_lstm_op.cc, tree_conv_op.cc,
+deformable_psroi_pooling_op.cc, detection/roi_perspective_transform_op.cc,
+detection/generate_mask_labels_op.cc, distributed_ops/split_ids_op.cc /
+merge_ids_op.cc, split_selected_rows_op.cc, collective/c_comm_init_op.cc,
+controlflow/feed_op / fetch_op (framework/feed_fetch_method.cc).
+
+Deliberately NOT registered (declared non-goals, SURVEY §7): the gRPC
+pserver runtime (listen_and_serv/send/recv/*_barrier/prefetch/
+checkpoint_notify/distributed_lookup_table/lookup_sparse_table),
+pslib BoxPS (pull/push_box_sparse), and vendor engines
+(tensorrt/anakin/ngraph) — capabilities replaced by GSPMD sharding and XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import act_map, one, opt_input
+
+_ACTS = act_map()
+
+
+# -- framework plumbing ------------------------------------------------------
+
+@register_op("feed", differentiable=False)
+def _feed(ctx, inputs, attrs):
+    """feed_op: identity — the executor feeds directly, this exists so
+    programs serialized with explicit feed ops execute."""
+    (x,) = inputs["X"]
+    return one(x)
+
+
+@register_op("fetch", differentiable=False)
+def _fetch(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(x)
+
+
+@register_op("read", differentiable=False)
+def _read(ctx, inputs, attrs):
+    """reader read_op: passthrough of already-materialized batch tensors
+    (the double-buffered reader lives in paddle_tpu.reader / native)."""
+    return {"Out": list(inputs.get("X", []))}
+
+
+@register_op("recurrent")
+def _recurrent(ctx, inputs, attrs):
+    """recurrent_op.cc: block-per-timestep RNN — same lowering as
+    static_rnn (lax.scan over the sub-block), kept as its own type for
+    program parity."""
+    from .control_flow_ops import _static_rnn
+    return _static_rnn(ctx, inputs, attrs)
+
+
+@register_op("conditional_block_infer", differentiable=False)
+def _conditional_block_infer(ctx, inputs, attrs):
+    from .control_flow_ops import _conditional_block
+    return _conditional_block(ctx, inputs, attrs)
+
+
+@register_op("merge_lod_tensor_infer", nondiff_inputs=["Mask"])
+def _merge_lod_tensor_infer(ctx, inputs, attrs):
+    from .framework_ops import _merge_lod_tensor
+    return _merge_lod_tensor(ctx, inputs, attrs)
+
+
+# -- int8 quantization trio (mkldnn int8 path capability) -------------------
+
+@register_op("quantize", differentiable=False)
+def _quantize(ctx, inputs, attrs):
+    """quantize_op.cc: f32 → int8 with a static scale."""
+    (x,) = inputs["Input"]
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    signed = attrs.get("is_negative_input", True)
+    if signed:
+        q = jnp.clip(jnp.round(x * scale + shift), -128, 127).astype(jnp.int8)
+    else:  # asymmetric uint8 path (Shift typically 128)
+        q = jnp.clip(jnp.round(x * scale + shift), 0, 255).astype(jnp.uint8)
+    return {"Output": [q]}
+
+
+@register_op("dequantize", differentiable=False)
+def _dequantize(ctx, inputs, attrs):
+    (x,) = inputs["Input"]
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    return {"Output": [(x.astype(jnp.float32) - shift) / scale]}
+
+
+@register_op("requantize", differentiable=False)
+def _requantize(ctx, inputs, attrs):
+    """requantize_op.cc: rescale int8 between two quantization domains."""
+    (x,) = inputs["Input"]
+    s_in = attrs.get("Scale_in", 1.0)
+    s_out = attrs.get("Scale_out", 1.0)
+    sh_in = attrs.get("Shift_in", 0.0)
+    sh_out = attrs.get("Shift_out", 0.0)
+    real = (x.astype(jnp.float32) - sh_in) / s_in
+    if x.dtype == jnp.uint8 or sh_out:
+        q = jnp.clip(jnp.round(real * s_out + sh_out), 0, 255).astype(jnp.uint8)
+    else:
+        q = jnp.clip(jnp.round(real * s_out), -128, 127).astype(jnp.int8)
+    return {"Output": [q]}
+
+
+from .quant_ops import _ste_grad  # noqa: E402  (STE for QDQ below)
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             grad_fn=_ste_grad,
+             nondiff_inputs=["InScale", "InAccum", "InState"])
+def _fake_qdq_moving_avg(ctx, inputs, attrs):
+    """fake_quantize_op.cc QDQ variant — our moving-average quantizer
+    already emits the quantize→dequantize composition (quant_ops
+    _quant_dequant), so this is the same lowering under the reference's
+    QDQ op name (STE gradient included via its grad_fn)."""
+    from .quant_ops import _fake_quantize_ma_abs_max
+    return _fake_quantize_ma_abs_max(ctx, inputs, attrs)
+
+
+# -- fused vision/sequence composites ---------------------------------------
+
+@register_op("conv2d_fusion")
+def _conv2d_fusion(ctx, inputs, attrs):
+    """fused/conv_fusion_op.cc: conv + bias + activation (+ residual)."""
+    from .nn_ops import _conv2d
+    y = _conv2d(ctx, {"Input": inputs["Input"], "Filter": inputs["Filter"]},
+                attrs)["Out"][0]
+    bias = opt_input(inputs, "Bias")
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1, 1)
+    resid = opt_input(inputs, "ResidualData")
+    if resid is not None:
+        y = y + resid
+    return {"Output": [_ACTS[attrs.get("activation", "relu")](y)]}
+
+
+@register_op("fusion_seqexpand_concat_fc", nondiff_inputs=["Length"])
+def _fusion_seqexpand_concat_fc(ctx, inputs, attrs):
+    """fused/fusion_seqexpand_concat_fc_op.cc: X[0] is [B,T,D0]; the rest
+    are per-batch vectors [B,Di] broadcast over T; concat features, fc,
+    activation."""
+    xs = inputs["X"]
+    (w,) = inputs["FCWeight"]
+    bias = opt_input(inputs, "FCBias")
+    seq = xs[0]
+    B, T = seq.shape[0], seq.shape[1]
+    feats = [seq] + [jnp.broadcast_to(v[:, None, :], (B, T, v.shape[-1]))
+                     for v in xs[1:]]
+    h = jnp.concatenate(feats, axis=-1)
+    out = jnp.einsum("btd,dh->bth", h, w)
+    if bias is not None:
+        out = out + bias.reshape(1, 1, -1)
+    return one(_ACTS[attrs.get("fc_activation", "relu")](out))
+
+
+@register_op("fused_embedding_fc_lstm", nondiff_inputs=["Ids", "Length"])
+def _fused_embedding_fc_lstm(ctx, inputs, attrs):
+    """fused/fused_embedding_fc_lstm_op.cc: the embedding table arrives
+    pre-multiplied by the input projection (Embeddings = table @ WeightX,
+    folded offline by the fuse pass), so the lookup directly yields the
+    4H gate pre-activations; only the recurrence runs."""
+    from .rnn_ops import _lstm
+    (ids,) = inputs["Ids"]
+    (emb,) = inputs["Embeddings"]        # [V, 4H]
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    gates = emb[ids]                     # [B, T, 4H]
+    sub = {"Input": [gates], "Weight": inputs["WeightH"]}
+    for slot in ("Bias", "Length", "H0", "C0"):
+        if inputs.get(slot):
+            sub[slot] = inputs[slot]
+    return _lstm(ctx, sub, attrs)
+
+
+@register_op("tree_conv", nondiff_inputs=["EdgeSet"])
+def _tree_conv(ctx, inputs, attrs):
+    """tree_conv_op.cc (continuous binary tree convolution, simplified to
+    one propagation step): each node aggregates itself + its children
+    (normalized) through three role weight matrices W[D, 3, C]
+    (self / left-half / right-half of the child list by position).
+    NodesVector [B, N, D]; EdgeSet [B, E, 2] int32 (parent, child) rows,
+    (-1,-1) padded. Out [B, N, C]."""
+    (nodes,) = inputs["NodesVector"]
+    (edges,) = inputs["EdgeSet"]
+    (w,) = inputs["Filter"]              # [D, 3, C]
+    B, N, D = nodes.shape
+
+    def per_sample(x, e):
+        parent, child = e[:, 0], e[:, 1]
+        valid = parent >= 0
+        p = jnp.where(valid, parent, N)
+        # child order within each parent decides left/right mix
+        ones = jnp.where(valid, 1.0, 0.0)
+        adj = jnp.zeros((N + 1, N), x.dtype).at[p, jnp.clip(child, 0, N - 1)].add(ones)
+        adj = adj[:N]
+        deg = jnp.maximum(adj.sum(1, keepdims=True), 1.0)
+        halves = jnp.cumsum(adj, axis=1)
+        left = jnp.where(halves <= deg / 2, adj, 0.0)
+        right = adj - left
+        lmean = (left @ x) / jnp.maximum(left.sum(1, keepdims=True), 1.0)
+        rmean = (right @ x) / jnp.maximum(right.sum(1, keepdims=True), 1.0)
+        out = (x @ w[:, 0] + lmean @ w[:, 1] + rmean @ w[:, 2])
+        return jnp.tanh(out)
+
+    return one(jax.vmap(per_sample)(nodes, edges))
+
+
+def _bilinear_chw(img, yy, xx):
+    """img [C,H,W]; sample at float coords yy/xx [...] → [C, ...]."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(yy); x0 = jnp.floor(xx)
+    wy = yy - y0; wx = xx - x0
+    vals = 0.0
+    for yi, wyi in ((y0, 1 - wy), (y0 + 1, wy)):
+        for xi, wxi in ((x0, 1 - wx), (x0 + 1, wx)):
+            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            vals = vals + img[:, yc, xc] * (wyi * wxi * inb)[None]
+    return vals
+
+
+@register_op("deformable_psroi_pooling", nondiff_inputs=["ROIs"])
+def _deformable_psroi_pooling(ctx, inputs, attrs):
+    """deformable_psroi_pooling_op.cc: position-sensitive RoI pooling with
+    learned per-bin offsets (bilinear-sampled, offsets differentiable)."""
+    (x,) = inputs["Input"]               # [N, C*P*P, H, W]
+    (rois,) = inputs["ROIs"]             # [R, 5] (batch_idx, x1,y1,x2,y2)
+    trans = opt_input(inputs, "Trans")   # [R, 2, P, P] offsets or None
+    P = int(attrs.get("pooled_height", attrs.get("group_size", 7)))
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    trans_std = attrs.get("trans_std", 0.1)
+    C = x.shape[1] // (P * P)
+
+    def per_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1) / P
+        rh = jnp.maximum(y2 - y1, 0.1) / P
+        img = x[b].reshape(C, P, P, x.shape[2], x.shape[3])
+        py, px = jnp.meshgrid(jnp.arange(P, dtype=jnp.float32),
+                              jnp.arange(P, dtype=jnp.float32), indexing="ij")
+        cy = y1 + (py + 0.5) * rh
+        cx = x1 + (px + 0.5) * rw
+        if tr is not None:
+            cy = cy + tr[1] * trans_std * (y2 - y1)
+            cx = cx + tr[0] * trans_std * (x2 - x1)
+        # per-bin: sample the (i,j)-th group channel map at the bin center
+        def bin_val(i, j):
+            sub = img[:, i, j]                         # [C, H, W]
+            return _bilinear_chw(sub, cy[i, j], cx[i, j])   # [C]
+        vals = jnp.stack([jnp.stack([bin_val(i, j) for j in range(P)], -1)
+                          for i in range(P)], -2)      # [C, P, P]
+        return vals
+
+    if trans is None:
+        out = jax.vmap(lambda r: per_roi(r, None))(rois)
+    else:
+        out = jax.vmap(per_roi)(rois, trans)
+    return {"Output": [out], "TopCount": [jnp.ones(out.shape, jnp.int32)]}
+
+
+@register_op("roi_perspective_transform", nondiff_inputs=["ROIs"])
+def _roi_perspective_transform(ctx, inputs, attrs):
+    """roi_perspective_transform_op.cc: warp a quadrilateral RoI
+    (x1..x4,y1..y4) to a fixed [H, W] output via the 4-point homography,
+    bilinear-sampled."""
+    (x,) = inputs["X"]                   # [N, C, H, W]
+    (rois,) = inputs["ROIs"]             # [R, 9]: batch_idx + 8 quad coords
+    oh = int(attrs.get("transformed_height", 8))
+    ow = int(attrs.get("transformed_width", 8))
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+
+    def homography(quad):
+        # map unit rect corners (0,0),(w-1,0),(w-1,h-1),(0,h-1) → quad
+        src = jnp.asarray([[0, 0], [ow - 1, 0], [ow - 1, oh - 1], [0, oh - 1]],
+                          jnp.float32)
+        dst = quad.reshape(4, 2) * spatial_scale
+        rows = []
+        for k in range(4):
+            sx, sy = src[k]
+            dx, dy = dst[k, 0], dst[k, 1]
+            rows.append(jnp.asarray(
+                [sx, sy, 1, 0, 0, 0, 0, 0], jnp.float32) * 1.0)
+            rows.append(jnp.asarray(
+                [0, 0, 0, sx, sy, 1, 0, 0], jnp.float32) * 1.0)
+        A = jnp.stack(rows)
+        A = A.at[0::2, 6].set(-src[:, 0] * dst[:, 0])
+        A = A.at[0::2, 7].set(-src[:, 1] * dst[:, 0])
+        A = A.at[1::2, 6].set(-src[:, 0] * dst[:, 1])
+        A = A.at[1::2, 7].set(-src[:, 1] * dst[:, 1])
+        b = dst.T.reshape(2, 4).T.reshape(-1)   # [dx0,dy0,dx1,dy1,...]
+        h = jnp.linalg.solve(A, b)   # exact 8x8; degenerate quads -> NaN, loud
+        return jnp.concatenate([h, jnp.ones((1,))]).reshape(3, 3)
+
+    def per_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        H = homography(roi[1:])
+        gy, gx = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                              jnp.arange(ow, dtype=jnp.float32), indexing="ij")
+        pts = jnp.stack([gx.ravel(), gy.ravel(), jnp.ones(oh * ow)], 0)
+        warped = H @ pts
+        wx = warped[0] / (warped[2] + 1e-8)
+        wy = warped[1] / (warped[2] + 1e-8)
+        return _bilinear_chw(x[b], wy, wx).reshape(-1, oh, ow)
+
+    out = jax.vmap(per_roi)(rois)
+    return {"Out": [out]}
+
+
+@register_op("generate_mask_labels", differentiable=False)
+def _generate_mask_labels(ctx, inputs, attrs):
+    """generate_mask_labels_op.cc (Mask R-CNN targets), bitmap redesign:
+    gt masks arrive as binary bitmaps [G, Hm, Wm] (the reference takes COCO
+    polygons — rasterization happens in the data pipeline here). For each
+    RoI, crop its matched gt mask and resize to [R, R]."""
+    (rois,) = inputs["Rois"]             # [M, 4]
+    (gt_masks,) = inputs["GtSegms"]      # [G, Hm, Wm] float 0/1
+    (match,) = inputs["MatchedGts"]      # [M] int32 index into G
+    (labels,) = inputs["LabelsInt32"]    # [M]
+    R = int(attrs.get("resolution", 14))
+
+    def per_roi(roi, g, lab):
+        m = gt_masks[jnp.clip(g, 0, gt_masks.shape[0] - 1)]
+        y = jnp.linspace(roi[1], roi[3], R)
+        x = jnp.linspace(roi[0], roi[2], R)
+        yy, xx = jnp.meshgrid(y, x, indexing="ij")
+        vals = _bilinear_chw(m[None], yy, xx)[0]
+        tgt = (vals > 0.5).astype(jnp.float32)
+        return jnp.where(lab > 0, tgt, -jnp.ones_like(tgt))
+
+    out = jax.vmap(per_roi)(rois, match, labels)
+    return {"MaskRois": [rois], "RoiHasMaskInt32": [(labels > 0).astype(jnp.int32)],
+            "MaskInt32": [out]}
+
+
+# -- pserver-era sharding helpers (kept: useful for sharded embeddings) -----
+
+@register_op("split_ids", differentiable=False)
+def _split_ids(ctx, inputs, attrs):
+    """split_ids_op.cc: route ids to N shards by id % N. Padded redesign:
+    each shard output keeps the full length with non-members replaced by -1
+    (the reference emits variable-length shards)."""
+    (ids,) = inputs["Ids"]
+    shard_num = attrs.get("shard_num")
+    if isinstance(shard_num, (list, tuple)):
+        n = len(shard_num)
+    elif shard_num is not None:
+        n = int(shard_num)
+    else:
+        n = int(attrs.get("num_shards", 2))
+    flat = ids.reshape(-1)
+    outs = []
+    for s in range(n):
+        outs.append(jnp.where(flat % n == s, flat, -1))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", differentiable=False)
+def _merge_ids(ctx, inputs, attrs):
+    """merge_ids_op.cc: inverse of split_ids — merge per-shard embedding
+    rows back into original id order. Ids [B] original, per-shard rows
+    aligned with split_ids' padded layout."""
+    (ids,) = inputs["Ids"]
+    rows = inputs["X"]                   # N tensors [B, D] (padded rows)
+    n = len(rows)
+    flat = ids.reshape(-1)
+    out = jnp.zeros((flat.shape[0], rows[0].shape[-1]), rows[0].dtype)
+    for s in range(n):
+        sel = (flat % n == s)
+        out = out + jnp.where(sel[:, None], rows[s], 0)
+    return one(out)
+
+
+@register_op("split_selected_rows", differentiable=False)
+def _split_selected_rows(ctx, inputs, attrs):
+    """split_selected_rows_op.cc: slice rows into height_sections."""
+    (x,) = inputs["X"]
+    sections = [int(s) for s in attrs["height_sections"]]
+    outs, pos = [], 0
+    for s in sections:
+        outs.append(x[pos:pos + s])
+        pos += s
+    return {"Out": outs}
+
+
+@register_op("split_byref", differentiable=False)
+def _split_byref(ctx, inputs, attrs):
+    return _split_selected_rows(ctx, inputs, attrs)
+
+
+# -- collective bootstrap shims ---------------------------------------------
+
+@register_op("c_comm_init", differentiable=False)
+def _c_comm_init(ctx, inputs, attrs):
+    """c_comm_init_op.cc: NCCL communicator setup. On TPU the mesh is the
+    communicator — jax.distributed.initialize + Mesh construction
+    (parallel/env.py) replace id exchange; this op is a structural no-op
+    so transpiled startup programs run."""
+    return {}
+
+
+@register_op("c_comm_init_all", differentiable=False)
+def _c_comm_init_all(ctx, inputs, attrs):
+    return {}
+
+
+@register_op("c_gen_nccl_id", differentiable=False)
+def _c_gen_nccl_id(ctx, inputs, attrs):
+    """c_gen_nccl_id_op.cc: emits a dummy id handle — XLA owns transport."""
+    return {"Out": [jnp.zeros((1,), jnp.int32)]}

@@ -1,0 +1,194 @@
+"""Parity-sweep op checks (quantize trio, conv2d_fusion, fused embedding
+LSTM, psroi/perspective/mask detection tails, id sharding helpers)."""
+import numpy as np
+
+from op_test_base import OpTest
+
+
+class _T(OpTest):
+    pass
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.array([[-1.5, 0.0, 2.25]], "float32")
+    t = _T(); t.op_type = "quantize"
+    q = t.run_op({"Input": x}, attrs={"Scale": 10.0}, output_slots=("Output",))
+    assert q["Output"].dtype == np.int8
+    np.testing.assert_array_equal(q["Output"], [[-15, 0, 22]])
+    t2 = _T(); t2.op_type = "dequantize"
+    d = t2.run_op({"Input": q["Output"]}, attrs={"Scale": 10.0},
+                  output_slots=("Output",))
+    np.testing.assert_allclose(d["Output"], [[-1.5, 0.0, 2.2]], atol=1e-6)
+
+
+def test_requantize_rescales():
+    q = np.array([[100, -50]], "int8")
+    t = _T(); t.op_type = "requantize"
+    out = t.run_op({"Input": q}, attrs={"Scale_in": 10.0, "Scale_out": 5.0},
+                   output_slots=("Output",))
+    np.testing.assert_array_equal(out["Output"], [[50, -25]])
+
+
+def test_conv2d_fusion_matches_parts():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    b = rng.randn(3).astype("float32")
+    t = _T(); t.op_type = "conv2d_fusion"
+    out = t.run_op({"Input": x, "Filter": w, "Bias": b},
+                   attrs={"strides": [1, 1], "paddings": [1, 1],
+                          "activation": "relu"},
+                   output_slots=("Output",))
+    t2 = _T(); t2.op_type = "conv2d"
+    ref = t2.run_op({"Input": x, "Filter": w},
+                    attrs={"strides": [1, 1], "paddings": [1, 1]})["Out"]
+    np.testing.assert_allclose(out["Output"],
+                               np.maximum(ref + b.reshape(1, -1, 1, 1), 0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_embedding_fc_lstm():
+    rng = np.random.RandomState(0)
+    V, H, B, T = 10, 3, 2, 4
+    emb = rng.randn(V, 4 * H).astype("float32") * 0.2
+    wh = rng.randn(H, 4 * H).astype("float32") * 0.2
+    ids = rng.randint(0, V, (B, T)).astype("int32")
+    t = _T(); t.op_type = "fused_embedding_fc_lstm"
+    out = t.run_op({"Ids": ids, "Embeddings": emb, "WeightH": wh},
+                   output_slots=("Hidden",))
+    t2 = _T(); t2.op_type = "lstm"
+    ref = t2.run_op({"Input": emb[ids], "Weight": wh},
+                    output_slots=("Hidden",))
+    np.testing.assert_allclose(out["Hidden"], ref["Hidden"], rtol=1e-5)
+
+
+def test_fusion_seqexpand_concat_fc():
+    rng = np.random.RandomState(0)
+    seq = rng.randn(2, 3, 4).astype("float32")
+    vec = rng.randn(2, 2).astype("float32")
+    w = rng.randn(6, 5).astype("float32")
+    t = _T(); t.op_type = "fusion_seqexpand_concat_fc"
+    out = t.run_op({"X": [seq, vec], "FCWeight": w},
+                   attrs={"fc_activation": "identity"})
+    h = np.concatenate([seq, np.tile(vec[:, None, :], (1, 3, 1))], -1)
+    np.testing.assert_allclose(out["Out"], h @ w, rtol=1e-4, atol=1e-5)
+
+
+def test_tree_conv_star_graph():
+    # node 0 is parent of nodes 1..3; identity self-weight, zero child
+    # weights -> output is tanh(x); nonzero child weights change node 0 only
+    x = np.random.RandomState(0).randn(1, 4, 3).astype("float32")
+    edges = np.array([[[0, 1], [0, 2], [0, 3], [-1, -1]]], "int32")
+    w = np.zeros((3, 3, 3), "float32")
+    w[:, 0] = np.eye(3)
+    t = _T(); t.op_type = "tree_conv"
+    out = t.run_op({"NodesVector": x, "EdgeSet": edges, "Filter": w})
+    np.testing.assert_allclose(out["Out"], np.tanh(x), rtol=1e-5)
+    w2 = w.copy(); w2[:, 1] = np.eye(3)   # add left-children aggregation
+    out2 = t.run_op({"NodesVector": x, "EdgeSet": edges, "Filter": w2})
+    assert not np.allclose(out2["Out"][0, 0], np.tanh(x)[0, 0])
+    np.testing.assert_allclose(out2["Out"][0, 1:], np.tanh(x)[0, 1:], rtol=1e-5)
+
+
+def test_roi_perspective_transform_identity_quad():
+    # quad == axis-aligned rect covering a ramp image: warp ~ crop+resize
+    H = W = 8
+    img = np.arange(H * W, dtype="float32").reshape(1, 1, H, W)
+    rois = np.array([[0, 0, 0, W - 1.0, 0, W - 1.0, H - 1.0, 0, H - 1.0]],
+                    "float32")
+    t = _T(); t.op_type = "roi_perspective_transform"
+    out = t.run_op({"X": img, "ROIs": rois},
+                   attrs={"transformed_height": H, "transformed_width": W,
+                          "spatial_scale": 1.0})
+    np.testing.assert_allclose(out["Out"][0, 0], img[0, 0], atol=0.5)
+
+
+def test_generate_mask_labels_crop():
+    gt = np.zeros((1, 8, 8), "float32"); gt[0, :4, :4] = 1.0
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+    match = np.array([0], "int32")
+    labels = np.array([1], "int32")
+    t = _T(); t.op_type = "generate_mask_labels"
+    out = t.run_op({"Rois": rois, "GtSegms": gt, "MatchedGts": match,
+                    "LabelsInt32": labels},
+                   attrs={"resolution": 4}, output_slots=("MaskInt32",))
+    np.testing.assert_allclose(out["MaskInt32"][0], 1.0)   # roi inside mask
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([3, 4, 7, 10], "int64")
+    t = _T(); t.op_type = "split_ids"
+    parts = t.run_op({"Ids": ids}, attrs={"num_shards": 2},
+                     multi_output_counts={"Out": 2})["Out"]
+    np.testing.assert_array_equal(parts[0], [-1, 4, -1, 10])
+    np.testing.assert_array_equal(parts[1], [3, -1, 7, -1])
+    # shard rows for merge: shard s row i = embedding of ids[i] if owned
+    emb = np.arange(8, dtype="float32").reshape(4, 2)
+    r0 = np.where((ids % 2 == 0)[:, None], emb, 0)
+    r1 = np.where((ids % 2 == 1)[:, None], emb, 0)
+    t2 = _T(); t2.op_type = "merge_ids"
+    merged = t2.run_op({"Ids": ids, "X": [r0, r1]})["Out"]
+    np.testing.assert_allclose(merged, emb)
+
+
+def test_split_selected_rows_sections():
+    x = np.arange(12, dtype="float32").reshape(6, 2)
+    t = _T(); t.op_type = "split_selected_rows"
+    outs = t.run_op({"X": x}, attrs={"height_sections": [2, 4]},
+                    multi_output_counts={"Out": 2})["Out"]
+    np.testing.assert_allclose(outs[0], x[:2])
+    np.testing.assert_allclose(outs[1], x[2:])
+
+
+def test_feed_fetch_read_identity():
+    x = np.ones((2, 2), "float32")
+    for op in ("feed", "fetch"):
+        t = _T(); t.op_type = op
+        np.testing.assert_allclose(t.run_op({"X": x})["Out"], x)
+
+
+def test_deformable_psroi_pooling_uniform():
+    # uniform feature map: every bin must sample the constant value
+    P = 2
+    x = np.full((1, 3 * P * P, 6, 6), 2.5, "float32")
+    rois = np.array([[0, 1.0, 1.0, 4.0, 4.0]], "float32")
+    t = _T(); t.op_type = "deformable_psroi_pooling"
+    out = t.run_op({"Input": x, "ROIs": rois},
+                   attrs={"pooled_height": P, "spatial_scale": 1.0},
+                   output_slots=("Output",))
+    np.testing.assert_allclose(out["Output"], 2.5, rtol=1e-6)
+
+
+def test_quantize_uint8_asymmetric():
+    x = np.array([[0.0, 0.5, 1.0]], "float32")
+    t = _T(); t.op_type = "quantize"
+    q = t.run_op({"Input": x}, attrs={"Scale": 100.0, "Shift": 128.0,
+                                      "is_negative_input": False},
+                 output_slots=("Output",))
+    assert q["Output"].dtype == np.uint8
+    np.testing.assert_array_equal(q["Output"], [[128, 178, 228]])
+
+
+def test_qdq_observer_has_ste_gradient():
+    """STE: d(qdq(x))/dx must be ~1 inside the clip range, not 0."""
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        scale = fluid.layers.create_parameter(
+            [1], "float32", name="s0",
+            default_initializer=fluid.initializer.Constant(1.0))
+        out = blk.create_var(name="qdq_o", dtype="float32")
+        os_ = blk.create_var(name="qdq_s", dtype="float32")
+        blk.append_op("fake_quantize_dequantize_moving_average_abs_max",
+                      {"X": [x.name], "InScale": [scale.name]},
+                      {"Out": [out.name], "OutScale": [os_.name]},
+                      {"bit_length": 8})
+        loss = fluid.layers.reduce_sum(out)
+        grads = fluid.gradients([loss], [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    g = exe.run(main, feed={"x": np.array([[0.1, -0.2, 0.3, 0.4]], "float32")},
+                fetch_list=[grads[0]])[0]
+    np.testing.assert_allclose(np.asarray(g), 1.0, atol=1e-6)
