@@ -98,6 +98,10 @@ class ExecContext:
         # FLOPs for HBM; the win is on elementwise-heavy ops)
         self.remat = remat
         self.tape: List[TapeEntry] = []
+        # declared output arity of the op currently being run ({slot: n}) —
+        # lets arity-driven kernels (reference: split_ids_op.cc sizes N from
+        # its output count) see the OpDesc's declared outputs
+        self.out_arity: Dict[str, int] = {}
 
     def rng(self):
         if self._key is None:
@@ -151,6 +155,7 @@ def _amp_cast(vals_by_slot, op_type, amp):
 
 def _run_op(op, env: Dict[str, object], ctx: ExecContext):
     opdef = registry.get_op(op.type)
+    ctx.out_arity = {slot: len(names) for slot, names in op.outputs.items()}
     in_vals = {slot: [env[n] for n in names] for slot, names in op.inputs.items()}
 
     flat_in_names = [n for slot in sorted(op.inputs) for n in op.inputs[slot]]

@@ -239,19 +239,20 @@ def _deformable_psroi_pooling(ctx, inputs, attrs):
     (rois,) = inputs["ROIs"]             # [R, 5] (batch_idx, x1,y1,x2,y2)
     trans = opt_input(inputs, "Trans")   # [R, 2, P, P] offsets or None
     P = int(attrs.get("pooled_height", attrs.get("group_size", 7)))
+    PW = int(attrs.get("pooled_width", P))
     spatial_scale = attrs.get("spatial_scale", 1.0)
     trans_std = attrs.get("trans_std", 0.1)
-    C = x.shape[1] // (P * P)
+    C = x.shape[1] // (P * PW)
 
     def per_roi(roi, tr):
         b = roi[0].astype(jnp.int32)
         x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
             roi[3] * spatial_scale, roi[4] * spatial_scale
-        rw = jnp.maximum(x2 - x1, 0.1) / P
+        rw = jnp.maximum(x2 - x1, 0.1) / PW
         rh = jnp.maximum(y2 - y1, 0.1) / P
-        img = x[b].reshape(C, P, P, x.shape[2], x.shape[3])
+        img = x[b].reshape(C, P, PW, x.shape[2], x.shape[3])
         py, px = jnp.meshgrid(jnp.arange(P, dtype=jnp.float32),
-                              jnp.arange(P, dtype=jnp.float32), indexing="ij")
+                              jnp.arange(PW, dtype=jnp.float32), indexing="ij")
         cy = y1 + (py + 0.5) * rh
         cx = x1 + (px + 0.5) * rw
         if tr is not None:
@@ -261,8 +262,8 @@ def _deformable_psroi_pooling(ctx, inputs, attrs):
         def bin_val(i, j):
             sub = img[:, i, j]                         # [C, H, W]
             return _bilinear_chw(sub, cy[i, j], cx[i, j])   # [C]
-        vals = jnp.stack([jnp.stack([bin_val(i, j) for j in range(P)], -1)
-                          for i in range(P)], -2)      # [C, P, P]
+        vals = jnp.stack([jnp.stack([bin_val(i, j) for j in range(PW)], -1)
+                          for i in range(P)], -2)      # [C, P, PW]
         return vals
 
     if trans is None:
@@ -293,15 +294,15 @@ def _roi_perspective_transform(ctx, inputs, attrs):
             sx, sy = src[k]
             dx, dy = dst[k, 0], dst[k, 1]
             rows.append(jnp.asarray(
-                [sx, sy, 1, 0, 0, 0, 0, 0], jnp.float32) * 1.0)
+                [sx, sy, 1, 0, 0, 0, 0, 0], jnp.float32))
             rows.append(jnp.asarray(
-                [0, 0, 0, sx, sy, 1, 0, 0], jnp.float32) * 1.0)
+                [0, 0, 0, sx, sy, 1, 0, 0], jnp.float32))
         A = jnp.stack(rows)
         A = A.at[0::2, 6].set(-src[:, 0] * dst[:, 0])
         A = A.at[0::2, 7].set(-src[:, 1] * dst[:, 0])
         A = A.at[1::2, 6].set(-src[:, 0] * dst[:, 1])
         A = A.at[1::2, 7].set(-src[:, 1] * dst[:, 1])
-        b = dst.T.reshape(2, 4).T.reshape(-1)   # [dx0,dy0,dx1,dy1,...]
+        b = dst.reshape(-1)   # [dx0,dy0,dx1,dy1,...] matching the row pairs
         h = jnp.linalg.solve(A, b)   # exact 8x8; degenerate quads -> NaN, loud
         return jnp.concatenate([h, jnp.ones((1,))]).reshape(3, 3)
 
@@ -360,7 +361,9 @@ def _split_ids(ctx, inputs, attrs):
     elif shard_num is not None:
         n = int(shard_num)
     else:
-        n = int(attrs.get("num_shards", 2))
+        # reference derives N from the op's declared output arity
+        n = getattr(ctx, "out_arity", {}).get("Out") or \
+            int(attrs.get("num_shards", 2))
     flat = ids.reshape(-1)
     outs = []
     for s in range(n):

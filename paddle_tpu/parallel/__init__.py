@@ -19,6 +19,8 @@ from .checkpoint import (  # noqa: F401
     Checkpointer, load_checkpoint, save_checkpoint)
 from .env import get_rank, get_world_size, init_parallel_env  # noqa: F401
 from .mesh import DistributedStrategy, auto_mesh, make_mesh  # noqa: F401
+from .moe import (  # noqa: F401
+    init_moe_params, moe_ffn, moe_ffn_expert_parallel, top_k_gating)
 from .pipeline import GPipe, pipeline_step  # noqa: F401
 from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
 from .tensor_parallel import MEGATRON_RULES, annotate_tp  # noqa: F401

@@ -1,0 +1,166 @@
+"""Mixture-of-Experts with expert parallelism over a named mesh axis.
+
+No reference analog: barrierye/Paddle has no MoE/expert-parallel machinery
+(its closest sparse-capacity idea is the pserver-sharded embedding,
+operators/distributed/parameter_prefetch.cc). This is a new first-class
+parallel axis of the TPU build (SURVEY §5 "long-context/parallelism" gap),
+designed XLA-first:
+
+- Static capacity dispatch (GShard/Switch style): every shape is fixed at
+  trace time — tokens route into an [E, C, D] buffer via one-hot einsums, so
+  the MXU does the dispatch and no dynamic shapes leak into the graph.
+- Expert parallelism via `lax.all_to_all` inside `shard_map`: tokens are
+  sharded over the `ep` axis (the data axis doubles as the expert axis, the
+  standard TPU layout), experts are sharded over the same axis; one
+  all-to-all sends token slices to their experts' hosts, a second brings
+  results home. Both ride ICI.
+- Load-balance aux loss (Switch: E * Σ_e f_e·P_e) with globally-psummed
+  statistics so the loss is identical no matter how the batch is sharded.
+
+The dense path (`moe_ffn`) and the expert-parallel path
+(`moe_ffn_expert_parallel`) compute identical results when capacity is not
+exceeded — tested in tests/test_moe.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collective import shard_map
+
+
+class GateOutput(NamedTuple):
+    combine: jax.Array   # [N, E, C] float — combine weights (0 where dropped)
+    dispatch: jax.Array  # [N, E, C] bool  — dispatch mask
+    aux_loss: jax.Array  # []  load-balance loss
+    probs: jax.Array     # [N, E] softmax router probabilities
+
+
+def top_k_gating(x, gate_w, k: int = 2, capacity: int = 0,
+                 capacity_factor: float = 1.25, renormalize: bool = True,
+                 axis: Optional[str] = None) -> GateOutput:
+    """Static-capacity top-k router.
+
+    x: [N, D] tokens, gate_w: [D, E]. Returns combine/dispatch tensors with a
+    fixed per-expert capacity C (computed from capacity_factor if capacity is
+    0). When `axis` is given (inside shard_map), aux-loss statistics are
+    psum-averaged across the axis so the loss matches the unsharded run.
+    """
+    n, _ = x.shape
+    e = gate_w.shape[1]
+    if capacity <= 0:
+        capacity = max(1, int(math.ceil(k * n / e * capacity_factor)))
+    c = capacity
+
+    logits = jnp.dot(x.astype(jnp.float32), gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                      # [N, E]
+
+    gate_vals, gate_idx = lax.top_k(probs, k)                    # [N, k]
+    if renormalize:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # Slot-major priority: all slot-0 assignments claim capacity before any
+    # slot-1 assignment (GShard ordering).
+    combine = jnp.zeros((n, e, c), dtype=jnp.float32)
+    counts = jnp.zeros((e,), dtype=jnp.int32)   # tokens already placed per expert
+    for j in range(k):
+        onehot = jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.int32)  # [N, E]
+        pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]  # [N, E]
+        pos_j = jnp.sum(pos * onehot, axis=1)                        # [N]
+        keep = pos_j < c
+        counts = counts + jnp.sum(onehot, axis=0)
+        pos_oh = jax.nn.one_hot(pos_j, c, dtype=jnp.float32)         # [N, C]
+        combine = combine + (gate_vals[:, j] * keep)[:, None, None] \
+            * onehot.astype(jnp.float32)[:, :, None] * pos_oh[:, None, :]
+
+    dispatch = combine > 0.0
+
+    # Switch load-balance loss on the top-1 assignment.
+    top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+    frac_tokens = jnp.mean(top1, axis=0)       # f_e
+    frac_probs = jnp.mean(probs, axis=0)       # P_e
+    if axis is not None:
+        frac_tokens = lax.pmean(frac_tokens, axis)
+        frac_probs = lax.pmean(frac_probs, axis)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return GateOutput(combine.astype(x.dtype), dispatch, aux, probs)
+
+
+def _expert_ffn(h, w1, b1, w2, b2, act):
+    """h: [E_local, C', D]; w1: [E_local, D, H]; w2: [E_local, H, D]."""
+    u = jnp.einsum("ecd,edh->ech", h, w1) + b1[:, None, :]
+    u = act(u)
+    return jnp.einsum("ech,ehd->ecd", u, w2) + b2[:, None, :]
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, k: int = 2,
+            capacity_factor: float = 1.25, act=jax.nn.gelu):
+    """Dense (single-device) MoE FFN. x: [N, D] → [N, D], plus aux loss.
+
+    gate_w: [D, E]; w1: [E, D, H]; b1: [E, H]; w2: [E, H, D]; b2: [E, D].
+    """
+    gate = top_k_gating(x, gate_w, k=k, capacity_factor=capacity_factor)
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", gate.dispatch.astype(x.dtype), x)         # [E, C, D]
+    expert_out = _expert_ffn(expert_in, w1, b1, w2, b2, act)     # [E, C, D]
+    y = jnp.einsum("nec,ecd->nd", gate.combine, expert_out)
+    return y, gate.aux_loss
+
+
+def moe_ffn_expert_parallel(x, gate_w, w1, b1, w2, b2, mesh: Mesh,
+                            axis: str = "ep", k: int = 2,
+                            capacity_factor: float = 1.25, act=jax.nn.gelu):
+    """Expert-parallel MoE FFN over `axis`.
+
+    x is sharded on tokens along `axis` ([N, D] global, N/ep per device);
+    expert weights are sharded on the expert dim. Two all-to-alls move token
+    slices to expert hosts and back. Per-device capacity is computed from
+    the *local* token count, so the result equals the dense path run on each
+    shard's tokens independently (same router, same weights).
+    """
+    ep = mesh.shape[axis]
+    e = gate_w.shape[1]
+    if e % ep != 0:
+        raise ValueError(f"num experts {e} not divisible by mesh axis {ep}")
+
+    def local(xs, gw, w1s, b1s, w2s, b2s):
+        # xs: [N/ep, D]; expert weights: local shard [E/ep, ...]
+        gate = top_k_gating(xs, gw, k=k, capacity_factor=capacity_factor,
+                            axis=axis)
+        exp_in = jnp.einsum("nec,nd->ecd", gate.dispatch.astype(xs.dtype), xs)
+        # [E, C, D] → each device keeps its E/ep experts, gathering every
+        # device's token slice along capacity: [E/ep, C*ep, D]
+        exp_in = lax.all_to_all(exp_in, axis, split_axis=0, concat_axis=1,
+                                tiled=True)
+        exp_out = _expert_ffn(exp_in, w1s, b1s, w2s, b2s, act)
+        # route results home: [E/ep, C*ep, D] → [E, C, D]
+        exp_out = lax.all_to_all(exp_out, axis, split_axis=1, concat_axis=0,
+                                 tiled=True)
+        y = jnp.einsum("nec,ecd->nd", gate.combine, exp_out)
+        return y, gate.aux_loss
+
+    f = shard_map(local, mesh,
+                  in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis)),
+                  out_specs=(P(axis), P()))
+    return f(x, gate_w, w1, b1, w2, b2)
+
+
+def init_moe_params(rng, d_model: int, d_hidden: int, num_experts: int,
+                    dtype=jnp.float32):
+    """Convenience initializer returning (gate_w, w1, b1, w2, b2)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s1 = 1.0 / math.sqrt(d_model)
+    s2 = 1.0 / math.sqrt(d_hidden)
+    return (
+        jax.random.normal(k1, (d_model, num_experts), dtype) * s1,
+        jax.random.normal(k2, (num_experts, d_model, d_hidden), dtype) * s1,
+        jnp.zeros((num_experts, d_hidden), dtype),
+        jax.random.normal(k3, (num_experts, d_hidden, d_model), dtype) * s2,
+        jnp.zeros((num_experts, d_model), dtype),
+    )
