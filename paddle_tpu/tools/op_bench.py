@@ -1,0 +1,149 @@
+"""Per-op micro-benchmark harness.
+
+Reference analog: ``paddle/fluid/operators/benchmark/op_tester.cc`` — a
+config-driven runner that builds one op, feeds synthetic tensors, and
+reports per-op latency (op_tester.cc:1; config format op_tester_config.cc).
+BASELINE.md requires this harness to exist "from day one" since all speedup
+claims are measured, not quoted.
+
+TPU-native redesign: the op executes through the same Program→Executor→XLA
+path as production (so the measurement includes our lowering, XLA fusion,
+and dispatch), with an explicit compile warmup so steady-state latency is
+reported separately from compile time.
+
+CLI::
+
+    python -m paddle_tpu.tools.op_bench --op matmul \
+        --input X=256x256 --input Y=256x256 --repeat 200
+    python -m paddle_tpu.tools.op_bench --config bench_ops.json
+
+Config file: a JSON list of {"op", "inputs": {slot: {"shape", "dtype"}},
+"attrs", "outputs", "repeat"}. Output: one JSON line per config with
+{op, mean_us, min_us, p50_us, compile_ms, repeat}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _make_input(shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.randint(0, 8, size=shape).astype(dtype)
+    return rng.rand(*shape).astype(dtype)
+
+
+def bench_op(op_type: str, inputs: Dict[str, Dict], attrs: Optional[dict] = None,
+             outputs: Optional[Dict[str, int]] = None, repeat: int = 100,
+             warmup: int = 2) -> dict:
+    """Build a one-op program, execute through the real Executor, time it.
+
+    inputs: slot -> {"shape": [..], "dtype": "float32"} (or a list of such
+    for multi-value slots). outputs: slot -> count (default {"Out": 1}).
+    """
+    import paddle_tpu as fluid
+
+    attrs = attrs or {}
+    outputs = outputs or {"Out": 1}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_map, feed = {}, {}
+        for slot, specs in inputs.items():
+            specs = specs if isinstance(specs, list) else [specs]
+            names = []
+            for i, sp in enumerate(specs):
+                a = _make_input(sp["shape"], sp.get("dtype", "float32"),
+                                seed=(zlib.crc32(slot.encode()) + i) % 2 ** 31)
+                name = f"{slot.lower()}_{i}"
+                block.create_var(name=name, shape=a.shape, dtype=str(a.dtype),
+                                 is_data=True)
+                feed[name] = a
+                names.append(name)
+            in_map[slot] = names
+        out_map = {}
+        for slot, n in outputs.items():
+            out_map[slot] = [f"out_{slot.lower()}_{i}" for i in range(n)]
+            for nm in out_map[slot]:
+                block.create_var(name=nm, dtype="float32")
+        block.append_op(op_type, in_map, out_map, attrs)
+        fetch = [nm for slot in sorted(out_map) for nm in out_map[slot]]
+
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            t0 = time.perf_counter()
+            exe.run(main, feed=feed, fetch_list=fetch)
+            compile_ms = (time.perf_counter() - t0) * 1e3
+            for _ in range(warmup):
+                exe.run(main, feed=feed, fetch_list=fetch)
+            times = []
+            for _ in range(repeat):
+                t0 = time.perf_counter()
+                res = exe.run(main, feed=feed, fetch_list=fetch,
+                              return_numpy=False)
+                np.asarray(res[0])  # sync
+                times.append(time.perf_counter() - t0)
+    times = np.array(times) * 1e6
+    return {"op": op_type,
+            "mean_us": round(float(times.mean()), 2),
+            "min_us": round(float(times.min()), 2),
+            "p50_us": round(float(np.percentile(times, 50)), 2),
+            "compile_ms": round(compile_ms, 2),
+            "repeat": repeat}
+
+
+def _parse_input_flag(s: str):
+    # "X=256x256" or "X=256x256:int64"
+    slot, rest = s.split("=", 1)
+    parts = rest.split(":")
+    shape = [int(d) for d in parts[0].split("x")]
+    dtype = parts[1] if len(parts) > 1 else "float32"
+    return slot, {"shape": shape, "dtype": dtype}
+
+
+def main(argv: Optional[List[str]] = None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--op")
+    ap.add_argument("--input", action="append", default=[],
+                    help="SLOT=shape[:dtype], e.g. X=256x256:float32")
+    ap.add_argument("--attrs", default="{}", help="JSON attr dict")
+    ap.add_argument("--out", action="append", default=[],
+                    help="output slot[:count], default Out:1")
+    ap.add_argument("--repeat", type=int, default=100)
+    ap.add_argument("--config", help="JSON list of bench specs")
+    args = ap.parse_args(argv)
+
+    specs = []
+    if args.config:
+        with open(args.config) as f:
+            specs = json.load(f)
+    if args.op:
+        inputs = {}
+        for s in args.input:
+            slot, sp = _parse_input_flag(s)
+            inputs.setdefault(slot, []).append(sp)
+        outputs = {}
+        for o in args.out:
+            slot, _, n = o.partition(":")
+            outputs[slot] = int(n or 1)
+        specs.append({"op": args.op, "inputs": inputs,
+                      "attrs": json.loads(args.attrs),
+                      "outputs": outputs or None, "repeat": args.repeat})
+    if not specs:
+        ap.error("need --op or --config")
+
+    for sp in specs:
+        res = bench_op(sp["op"], sp["inputs"], sp.get("attrs"),
+                       sp.get("outputs"), sp.get("repeat", 100))
+        print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Preemptible trainer subprocess for tests/test_elastic.py.
+
+Trains a deterministic MLP via run_elastic; prints one line per completed
+step: `step <i> <loss>` (flushed, so the parent can SIGTERM mid-run), then
+`done <next_step>` on exit. Re-launching with the same --ckpt resumes.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt", required=True)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--save-interval", type=int, default=2)
+    ap.add_argument("--step-delay", type=float, default=0.0)
+    args = ap.parse_args()
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import run_elastic
+    from paddle_tpu.initializer import NumpyArrayInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        w = np.random.RandomState(5).rand(16, 4).astype("float32") * 0.1
+        logits = fluid.layers.fc(
+            x, 4, bias_attr=False,
+            param_attr=ParamAttr(name="w",
+                                 initializer=NumpyArrayInitializer(w)))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(32, 16).astype("float32"),
+            "y": rng.randint(0, 4, (32, 1)).astype("int64")}
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+
+        def step_fn(i):
+            (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
+            print(f"step {i} {float(lv):.8f}", flush=True)
+            if args.step_delay:
+                time.sleep(args.step_delay)
+
+        nxt = run_elastic(step_fn, args.ckpt, args.steps,
+                          save_interval=args.save_interval,
+                          program=main_p,
+                          heartbeat=os.path.join(args.ckpt, "heartbeat"))
+    print(f"done {nxt}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Elastic / preemption-aware training (beats the reference bar: SURVEY §5
+notes the reference has no automatic restart or elastic recovery — only a
+pserver checkpoint-notify RPC). A trainer subprocess is SIGTERMed mid-run,
+relaunched, and must resume from its last durable checkpoint with loss
+continuity vs an uninterrupted run."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUNNER = os.path.join(HERE, "elastic_runner.py")
+
+
+def _launch(ckpt, steps=12, delay=0.0):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    return subprocess.Popen(
+        [sys.executable, RUNNER, "--ckpt", ckpt, "--steps", str(steps),
+         "--save-interval", "2", "--step-delay", str(delay)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env)
+
+
+def _parse(out):
+    losses = {}
+    nxt = None
+    for line in out.splitlines():
+        if line.startswith("step "):
+            _, i, lv = line.split()
+            losses[int(i)] = float(lv)
+        elif line.startswith("done "):
+            nxt = int(line.split()[1])
+    return losses, nxt
+
+
+def test_preempt_resume_loss_continuity(tmp_path):
+    steps = 12
+
+    # uninterrupted reference run
+    p = _launch(str(tmp_path / "ref"), steps=steps)
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    ref_losses, nxt = _parse(out)
+    assert nxt == steps and len(ref_losses) == steps
+
+    # preempted run: SIGTERM after the 4th step line appears
+    ck = str(tmp_path / "el")
+    p = _launch(ck, steps=steps, delay=0.25)
+    seen = 0
+    t0 = time.time()
+    lines = []
+    while seen < 4 and time.time() - t0 < 240:
+        line = p.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith("step "):
+            seen += 1
+    assert seen >= 4, "".join(lines)
+    p.send_signal(signal.SIGTERM)
+    out, _ = p.communicate(timeout=120)
+    assert p.returncode == 0  # graceful: final checkpoint written
+    losses_a, resume_at = _parse("".join(lines) + out)
+    assert resume_at is not None and 4 <= resume_at < steps
+
+    # heartbeat file recorded the last completed step
+    hb = open(os.path.join(ck, "heartbeat")).read().split()
+    assert int(hb[0]) == resume_at
+
+    # relaunch: resumes at resume_at, finishes the remaining steps
+    p = _launch(ck, steps=steps)
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    losses_b, nxt = _parse(out)
+    assert nxt == steps
+    assert min(losses_b) == resume_at  # first step after resume
+
+    # loss continuity: the stitched trajectory equals the uninterrupted one
+    stitched = dict(losses_a)
+    stitched.update(losses_b)
+    for i in range(steps):
+        np.testing.assert_allclose(stitched[i], ref_losses[i], rtol=1e-5,
+                                   err_msg=f"step {i}")

@@ -1,0 +1,41 @@
+"""Op micro-bench harness (reference operators/benchmark/op_tester.cc
+parity): config- and CLI-driven single-op latency measurement through the
+real executor."""
+import json
+
+from paddle_tpu.tools import op_bench
+
+
+def test_bench_single_op():
+    res = op_bench.bench_op(
+        "matmul",
+        {"X": {"shape": [64, 64]}, "Y": {"shape": [64, 64]}},
+        repeat=5, warmup=1)
+    assert res["op"] == "matmul"
+    assert res["mean_us"] > 0 and res["min_us"] <= res["mean_us"]
+    assert res["compile_ms"] > 0
+
+
+def test_bench_cli_and_config(tmp_path, capsys):
+    cfg = [{"op": "relu", "inputs": {"X": {"shape": [128, 128]}},
+            "repeat": 3}]
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps(cfg))
+    op_bench.main(["--config", str(path)])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["op"] == "relu" and out["repeat"] == 3
+
+    op_bench.main(["--op", "elementwise_add",
+                   "--input", "X=32x32", "--input", "Y=32x32",
+                   "--repeat", "3"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["op"] == "elementwise_add"
+
+
+def test_bench_int_input_op():
+    res = op_bench.bench_op(
+        "lookup_table",
+        {"W": {"shape": [64, 8]}, "Ids": {"shape": [16, 1], "dtype": "int64"}},
+        repeat=3, warmup=1)
+    assert res["mean_us"] > 0
