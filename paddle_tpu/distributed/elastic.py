@@ -52,7 +52,10 @@ class PreemptionGuard:
     def _handler(self, signum, frame):
         self._stop = True
         prev = self._prev.get(signum)
-        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+        # never chain into default_int_handler: it raises KeyboardInterrupt
+        # mid-step, which is exactly the interruption this guard prevents
+        if callable(prev) and prev is not signal.default_int_handler \
+                and prev not in (signal.SIG_IGN, signal.SIG_DFL):
             prev(signum, frame)
 
     @property
@@ -61,7 +64,9 @@ class PreemptionGuard:
 
     def uninstall(self):
         for sig, prev in self._prev.items():
-            signal.signal(sig, prev)
+            # getsignal() returns None for handlers installed from C;
+            # signal.signal() rejects None — restore the OS default instead
+            signal.signal(sig, prev if prev is not None else signal.SIG_DFL)
         self._prev = {}
 
 

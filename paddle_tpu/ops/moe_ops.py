@@ -1,0 +1,47 @@
+"""Mixture-of-Experts framework op.
+
+No reference analog (barrierye/Paddle predates MoE) — this exposes the
+expert-parallel machinery of parallel/moe.py to static-graph programs as a
+single `moe_ffn` op, the same way the reference exposes composite blocks as
+fused ops (e.g. fused_embedding_seq_pool_op.cc). Under a compiled mesh with
+an `ep` axis the op dispatches tokens via all-to-all expert parallelism;
+otherwise it computes the identical dense path. Fully differentiable via
+the executor's vjp tape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..parallel import moe as _moe
+
+
+@register_op("moe_ffn")
+def _moe_ffn(ctx, inputs, attrs):
+    (x,) = inputs["X"]                 # [B, T, D] or [N, D]
+    (gate_w,) = inputs["GateW"]        # [D, E]
+    (w1,) = inputs["W1"]               # [E, D, H]
+    (b1,) = inputs["B1"]               # [E, H]
+    (w2,) = inputs["W2"]               # [E, H, D]
+    (b2,) = inputs["B2"]               # [E, D]
+    k = int(attrs.get("k", 2))
+    cf = float(attrs.get("capacity_factor", 1.25))
+    axis = attrs.get("ep_axis", "ep")
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "silu": jax.nn.silu}[attrs.get("act", "gelu")]
+
+    shape = x.shape
+    flat = x.reshape(-1, shape[-1])
+
+    mesh = ctx.mesh
+    if mesh is not None and axis in mesh.axis_names \
+            and gate_w.shape[1] % mesh.shape[axis] == 0 \
+            and flat.shape[0] % mesh.shape[axis] == 0:
+        y, aux = _moe.moe_ffn_expert_parallel(
+            flat, gate_w, w1, b1, w2, b2, mesh, axis=axis, k=k,
+            capacity_factor=cf, act=act)
+    else:
+        y, aux = _moe.moe_ffn(flat, gate_w, w1, b1, w2, b2, k=k,
+                              capacity_factor=cf, act=act)
+    return {"Out": [y.reshape(shape)], "AuxLoss": [aux]}
