@@ -171,6 +171,14 @@ def _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, block_q, block_k,
     bh, t, d = q.shape
     block_q, block_k = min(block_q, t), min(block_k, t)
     nq, nk = t // block_q, t // block_k
+    if nq == 1 and nk == 1:
+        per_q_bias = bias is not None and bias.shape[1] != 1
+        group = _pick_group(
+            bh, t, d, _tt_bytes_per_head(1, per_q_bias, dropout_rate, t))
+        if group > 1:
+            return _flash_fwd_pallas_onepass(
+                q, k, v, bias, sm_scale, causal, group, interpret=interpret,
+                dropout_rate=dropout_rate, seed=seed)
     grid = (bh, nq, nk)
 
     in_specs = [
@@ -232,6 +240,258 @@ def _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, block_q, block_k,
     # (2.3 GB of residuals on BERT-base b=64) — which forces XLA into far
     # more expensive rematerializations. Memory wins.
     return out, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# One-pass grouped kernels (T fits one block, i.e. nq == nk == 1).
+#
+# The general kernels pay a fixed per-grid-step cost (DMA setup, online-
+# softmax stats corrections) on a grid of BH tiny steps — measured 14% MXU
+# on BERT-base shapes (BH=768, T=512, D=64). When the whole sequence fits a
+# single block the online softmax is unnecessary; these kernels batch G
+# heads per grid step (BlockSpec (G, T, D) on the folded layout — leading-
+# dim blocking, so no 64-wide minor slicing, unlike the rejected head-
+# native path below) and compute plain softmax in one pass. Dropout masks
+# are generated PER HEAD with the head's global index, so they are
+# identical to the non-grouped kernels' masks (whose block index reduces to
+# `b` when nq == nk == 1) — fwd and bwd may even pick different group sizes.
+# ---------------------------------------------------------------------------
+
+def _causal_mask_full(t):
+    q_pos = lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    k_pos = lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    return q_pos >= k_pos
+
+
+def _group_keep_mask(seed_ref, g0, group, t, rate):
+    """[G, T, T] keep mask; per-head streams keyed by global head index."""
+    rows = []
+    for i in range(group):
+        rows.append(_keep_mask(seed_ref, g0 * group + i, (t, t), rate))
+    return jnp.stack(rows)
+
+
+def _fwd_kernel_onepass(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                        lse_ref, *, sm_scale, causal, dropout_rate, group):
+    g0 = pl.program_id(0)
+    q, k, v = q_ref[...], k_ref[...], v_ref[...]          # [G, T, D]
+    t = q.shape[1]
+    s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32) * sm_scale
+    if bias_ref is not None:
+        s = s + bias_ref[...].astype(jnp.float32)         # [G, Tq or 1, T]
+    if causal:
+        s = jnp.where(_causal_mask_full(t)[None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                # [G, T, 1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    if dropout_rate > 0.0:
+        keep = _group_keep_mask(seed_ref, g0, group, t, dropout_rate)
+        p_v = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+    else:
+        p_v = p
+    acc = lax.dot_general(p_v.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+                          preferred_element_type=jnp.float32)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                    lse_ref.shape).astype(jnp.float32)
+
+
+def _flash_fwd_pallas_onepass(q, k, v, bias, sm_scale, causal, group,
+                              interpret=False, dropout_rate=0.0, seed=None):
+    bh, t, d = q.shape
+    grid = (bh // group,)
+    in_specs = [pl.BlockSpec((group, t, d), lambda b, *_: (b, 0, 0))] * 3
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((group, bias.shape[1], t),
+                                     lambda b, *_: (b, 0, 0)))
+        args.append(bias)
+
+    body = functools.partial(_fwd_kernel_onepass, sm_scale=sm_scale,
+                             causal=causal, dropout_rate=dropout_rate,
+                             group=group)
+    if bias is not None:
+        kernel = body
+    else:
+        def kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
+            body(seed_ref, q_ref, k_ref, v_ref, None, o_ref, lse_ref)
+
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((group, t, d), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec((group, t, _LANES), lambda b, *_: (b, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(seed, *args)
+    return out, lse[:, :, 0]
+
+
+def _bwd_kernel_onepass(seed_ref, q_ref, k_ref, v_ref, bias_ref, g_ref,
+                        lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+                        dbias_ref, dbias_col_ref, *, sm_scale, causal,
+                        dropout_rate, group):
+    g0 = pl.program_id(0)
+    q, k, v, g = q_ref[...], k_ref[...], v_ref[...], g_ref[...]  # [G, T, D]
+    t = q.shape[1]
+    s = lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                        preferred_element_type=jnp.float32) * sm_scale
+    if bias_ref is not None:
+        s = s + bias_ref[...].astype(jnp.float32)
+    if causal:
+        s = jnp.where(_causal_mask_full(t)[None], s, _NEG_INF)
+    lse = lse_ref[:, :, :1]                                # [G, T, 1]
+    p = jnp.exp(s - lse)                                   # [G, T, T]
+    if dropout_rate > 0.0:
+        keep = _group_keep_mask(seed_ref, g0, group, t, dropout_rate)
+        inv = 1.0 / (1.0 - dropout_rate)
+        p_d = jnp.where(keep, p, 0.0) * inv
+    else:
+        p_d = p
+    # dv = p_dropᵀ · dO  (contract over q)
+    dv = lax.dot_general(p_d.astype(g.dtype), g, (((1,), (1,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    dp = lax.dot_general(g, v, (((2,), (2,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)  # [G, T, T]
+    if dropout_rate > 0.0:
+        dp = jnp.where(keep, dp * inv, 0.0)
+    ds = p * (dp - delta_ref[:, :, :1])                    # [G, T, T]
+    ds_c = ds.astype(q.dtype)
+    dk = lax.dot_general(ds_c, q, (((1,), (1,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    dq = lax.dot_general(ds_c, k, (((2,), (1,)), ((0,), (0,))),
+                         preferred_element_type=jnp.float32)
+    dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
+    dk_ref[...] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+    if dbias_ref is not None:
+        dbias_ref[...] = ds.astype(dbias_ref.dtype)
+    if dbias_col_ref is not None:
+        dbias_col_ref[...] = jnp.sum(ds, axis=1, keepdims=True).astype(
+            dbias_col_ref.dtype)
+
+
+def _bwd_host_prep(q, g, lse, out):
+    """Shared residual preprocessing for both backward wrappers.
+
+    delta = Σ_d dO·out; lse/delta are lane-replicated for the kernels. The
+    optimization_barrier ties the lse broadcast to g: without the data
+    dependency XLA's scheduler hoists every layer's 128-lane-replicated
+    broadcast to the start of the backward and keeps them all live
+    (~190 MB × layers); a `+ 0*g[0]` tie would instead propagate a single
+    inf/NaN to every row."""
+    bh, t, _ = q.shape
+    gf = g.astype(q.dtype)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse, _ = lax.optimization_barrier((lse, gf))
+    lse_r = jnp.broadcast_to(lse[:, :, None], (bh, t, _LANES))
+    delta_r = jnp.broadcast_to(delta[:, :, None], (bh, t, _LANES))
+    return gf, lse_r, delta_r
+
+
+def _flash_bwd_pallas_onepass(q, k, v, bias, g, lse, out, sm_scale, causal,
+                              group, dropout_rate=0.0, seed=None,
+                              interpret=False):
+    bh, t, d = q.shape
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    gf, lse_r, delta_r = _bwd_host_prep(q, g, lse, out)
+
+    has_bias = bias is not None
+    per_q_bias = has_bias and bias.shape[1] != 1
+    col_bias = has_bias and not per_q_bias
+
+    in_specs = [pl.BlockSpec((group, t, d), lambda b, *_: (b, 0, 0))] * 3
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((group, bias.shape[1], t),
+                                     lambda b, *_: (b, 0, 0)))
+        args.append(bias)
+    in_specs += [
+        pl.BlockSpec((group, t, d), lambda b, *_: (b, 0, 0)),
+        pl.BlockSpec((group, t, _LANES), lambda b, *_: (b, 0, 0)),
+        pl.BlockSpec((group, t, _LANES), lambda b, *_: (b, 0, 0)),
+    ]
+    args += [gf, lse_r, delta_r]
+
+    out_specs = [pl.BlockSpec((group, t, d), lambda b, *_: (b, 0, 0))] * 3
+    out_shape = [jax.ShapeDtypeStruct((bh, t, d), x.dtype) for x in (q, k, v)]
+    if per_q_bias:
+        out_specs.append(pl.BlockSpec((group, t, t), lambda b, *_: (b, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, t, t), jnp.float32))
+    if col_bias:
+        out_specs.append(pl.BlockSpec((group, 1, t), lambda b, *_: (b, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, 1, t), jnp.float32))
+
+    body = functools.partial(_bwd_kernel_onepass, sm_scale=sm_scale,
+                             causal=causal, dropout_rate=dropout_rate,
+                             group=group)
+
+    def kernel(seed_ref, *refs):
+        n_in = 6 + (1 if has_bias else 0)
+        ins, outs = refs[:n_in], refs[n_in:]
+        if has_bias:
+            q_r, k_r, v_r, b_r, g_r, l_r, d_r = ins
+        else:
+            (q_r, k_r, v_r, g_r, l_r, d_r), b_r = ins, None
+        dq_r, dk_r, dv_r = outs[:3]
+        db_r = outs[3] if per_q_bias else None
+        dbc_r = outs[3] if col_bias else None
+        body(seed_ref, q_r, k_r, v_r, b_r, g_r, l_r, d_r,
+             dq_r, dk_r, dv_r, db_r, dbc_r)
+
+    res = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh // group,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+        ),
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(seed, *args)
+    dq, dk, dv = res[:3]
+    dbias = res[3] if has_bias else None
+    return dq, dk, dv, dbias
+
+
+def _tt_bytes_per_head(base, per_q_bias, dropout_rate, t):
+    """Bytes of concurrently-live [T, T]-sized per-head buffers: `base` f32
+    intermediates (1 fwd: s/p; 3 bwd: p, dp, ds), the per-q bias input and
+    (bwd) dbias output, and the 1-byte dropout keep mask."""
+    n_f32 = base + (2 if per_q_bias and base > 1 else 1 if per_q_bias else 0)
+    mask = t * t if dropout_rate > 0.0 else 0
+    return n_f32 * t * t * 4 + mask
+
+
+def _pick_group(bh, t, d, tt_bytes, budget=10 * 2 ** 20):
+    """Heads per grid step for the one-pass kernels. `tt_bytes` is the
+    per-head [T, T]-buffer footprint (see _tt_bytes_per_head); exceeding
+    the budget falls back to the general blocked kernels, which is always
+    correct."""
+    for g in (8, 4, 2):
+        need = g * (tt_bytes + 6 * t * d * 4 + 2 * t * _LANES * 4)
+        if bh % g == 0 and need <= budget:
+            return g
+    return 1
 
 
 # ---------------------------------------------------------------------------
@@ -388,20 +648,18 @@ def _flash_bwd_pallas(q, k, v, bias, g, lse, out, sm_scale, causal,
     bh, t, d = q.shape
     block_q, block_k = min(block_q, t), min(block_k, t)
     nq, nk = t // block_q, t // block_k
+    if nq == 1 and nk == 1:
+        per_q_bias = bias is not None and bias.shape[1] != 1
+        group = _pick_group(
+            bh, t, d, _tt_bytes_per_head(3, per_q_bias, dropout_rate, t))
+        if group > 1:
+            return _flash_bwd_pallas_onepass(
+                q, k, v, bias, g, lse, out, sm_scale, causal, group,
+                dropout_rate=dropout_rate, seed=seed, interpret=interpret)
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
 
-    gf = g.astype(q.dtype)
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                                   # [BH, T]
-    # tie the lse broadcast to g: without the data dependency XLA's
-    # scheduler hoists every layer's 128-lane-replicated broadcast to the
-    # start of the backward and keeps them all live (~190 MB × layers).
-    # optimization_barrier creates the ordering without a numeric path (a
-    # `+ 0*g[0]` tie would propagate a single inf/NaN to every row)
-    lse, _ = lax.optimization_barrier((lse, gf))
-    lse_r = jnp.broadcast_to(lse[:, :, None], (bh, t, _LANES))
-    delta_r = jnp.broadcast_to(delta[:, :, None], (bh, t, _LANES))
+    gf, lse_r, delta_r = _bwd_host_prep(q, g, lse, out)
 
     has_bias = bias is not None
     per_q_bias = has_bias and bias.shape[1] != 1

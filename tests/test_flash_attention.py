@@ -234,14 +234,21 @@ def test_flash_gradients_multiblock_full_bias(causal):
     (False, "none"), (True, "none"), (False, "mask"), (True, "mask"),
     (False, "full"), (True, "full"),
 ])
-def test_pallas_backward_interpret_mode(causal, bias_kind):
-    """The Pallas dq and dk/dv kernels, run through the interpreter on CPU,
-    against the naive dense gradients — multi-block (t=256, block 128)."""
+@pytest.mark.parametrize("force_general", [False, True])
+def test_pallas_backward_interpret_mode(causal, bias_kind, force_general,
+                                        monkeypatch):
+    """The Pallas backward kernels through the interpreter on CPU against
+    the naive dense gradients. At t=256 the single-block shapes dispatch to
+    the grouped one-pass kernels; force_general pins the group to 1 so the
+    general dq and dk/dv kernels (incl. the col-bias accumulation) keep
+    interpreter coverage too."""
     import importlib
     import jax
     import jax.numpy as jnp
     fa_mod = importlib.import_module(
         "paddle_tpu.ops.pallas_kernels.flash_attention")
+    if force_general:
+        monkeypatch.setattr(fa_mod, "_pick_group", lambda *a, **k: 1)
 
     b, h, t, d = 1, 2, 256, 64
     q, k, v = (jnp.asarray(_rand((b, h, t, d), i)) for i in range(3))
