@@ -19,6 +19,7 @@ API shape follows fluid for migration friendliness::
 from . import initializer  # noqa: F401
 from . import ops  # registers all ops  # noqa: F401
 from . import layers  # noqa: F401
+from . import nets  # noqa: F401
 from . import clip  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

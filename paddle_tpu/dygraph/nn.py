@@ -16,6 +16,10 @@ def _pair(v):
     return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
 
 
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+
 class Linear(Layer):
     def __init__(self, input_dim: int, output_dim: int, param_attr=None,
                  bias_attr=None, act: Optional[str] = None, dtype="float32"):
@@ -230,3 +234,291 @@ class GRUUnit(Layer):
         c = trace_op(self._act, {"X": [c]}, {})["Out"][0]
         new_h = u * hidden + (c - u * c)
         return new_h, new_h, gates
+
+
+class Conv2DTranspose(Layer):
+    """Reference dygraph/nn.py Conv2DTranspose (:1981)."""
+
+    def __init__(self, num_channels: int, num_filters: int, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32",
+                 use_cudnn=True):
+        super().__init__()
+        fh, fw = _pair(filter_size)
+        self._attrs = {"strides": list(_pair(stride)),
+                       "paddings": list(_pair(padding)),
+                       "dilations": list(_pair(dilation)), "groups": groups}
+        if output_size is not None:
+            self._attrs["output_size"] = list(_pair(output_size))
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fh, fw], param_attr, dtype,
+            default_initializer=XavierInitializer())
+        self.bias = (self.create_parameter([num_filters], bias_attr, dtype,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        out = trace_op("conv2d_transpose",
+                       {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class Conv3D(Layer):
+    """Reference dygraph/nn.py Conv3D (:258)."""
+
+    def __init__(self, num_channels: int, num_filters: int, filter_size,
+                 stride=1, padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", use_cudnn=True):
+        super().__init__()
+        fd, fh, fw = _triple(filter_size)
+        self._attrs = {"strides": _triple(stride), "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups}
+        self._act = act
+        fan_in = fd * fh * fw * num_channels
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fd, fh, fw], param_attr,
+            dtype, default_initializer=NormalInitializer(
+                0.0, (2.0 / fan_in) ** 0.5))
+        self.bias = (self.create_parameter([num_filters], bias_attr, dtype,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        out = trace_op("conv3d", {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    """Reference dygraph/nn.py Conv3DTranspose (:455)."""
+
+    def __init__(self, num_channels: int, num_filters: int, filter_size,
+                 padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", use_cudnn=True):
+        super().__init__()
+        fd, fh, fw = _triple(filter_size)
+        self._attrs = {"strides": _triple(stride), "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fd, fh, fw], param_attr,
+            dtype, default_initializer=XavierInitializer())
+        self.bias = (self.create_parameter([num_filters], bias_attr, dtype,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        out = trace_op("conv3d_transpose",
+                       {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class NCE(Layer):
+    """Reference dygraph/nn.py NCE (:1579): noise-contrastive loss head."""
+
+    def __init__(self, num_total_classes: int, dim: int, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__()
+        if sampler != "uniform" or custom_dist is not None:
+            raise NotImplementedError(
+                "NCE: only the uniform noise sampler is implemented "
+                f"(got sampler={sampler!r})")
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples}
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            param_attr, dtype)
+        self.bias = (self.create_parameter([num_total_classes], bias_attr,
+                                           dtype, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label):
+        ins = {"Input": [input], "Label": [label], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return trace_op("nce", ins, self._attrs)["Cost"][0]
+
+
+class BilinearTensorProduct(Layer):
+    """Reference dygraph/nn.py BilinearTensorProduct (:1881)."""
+
+    def __init__(self, input1_dim: int, input2_dim: int, output_dim: int,
+                 name=None, act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__()
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], param_attr, dtype)
+        self.bias = (self.create_parameter([1, output_dim], bias_attr, dtype,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = trace_op("bilinear_tensor_product", ins, {})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class SequenceConv(Layer):
+    """Reference dygraph/nn.py SequenceConv (:2216). TPU note: takes the
+    dense per-row `length` tensor in forward (LoD replacement)."""
+
+    def __init__(self, input_dim: int, num_filters: int, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._attrs = {"contextLength": filter_size,
+                       "contextStride": filter_stride,
+                       "contextStart": -((filter_size - 1) // 2)}
+        self._act = act
+        self.weight = self.create_parameter(
+            [filter_size * input_dim, num_filters], param_attr, dtype)
+        self.bias = (self.create_parameter([num_filters], bias_attr, dtype,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x, length=None):
+        ins = {"X": [x], "Filter": [self.weight]}
+        if length is not None:
+            ins["Length"] = [length]
+        out = trace_op("sequence_conv", ins, self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": -1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class RowConv(Layer):
+    """Reference dygraph/nn.py RowConv (:2306): lookahead row convolution."""
+
+    def __init__(self, input_dim: int, future_context_size: int,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self.weight = self.create_parameter(
+            [future_context_size + 1, input_dim], param_attr, dtype)
+
+    def forward(self, x):
+        out = trace_op("row_conv", {"X": [x], "Filter": [self.weight]},
+                       {})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class GroupNorm(Layer):
+    """Reference dygraph/nn.py GroupNorm (:2382)."""
+
+    def __init__(self, channels: int, groups: int, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None,
+                 data_layout="NCHW", dtype="float32"):
+        super().__init__()
+        if data_layout not in ("NCHW", "NHWC"):
+            raise ValueError(f"unknown data_layout {data_layout!r}")
+        self._nhwc = data_layout == "NHWC"
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._act = act
+        self.weight = (self.create_parameter(
+            [channels], param_attr, dtype,
+            default_initializer=ConstantInitializer(1.0))
+            if param_attr is not False else None)
+        self.bias = (self.create_parameter([channels], bias_attr, dtype,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        if self._nhwc:  # the op computes over NCHW channels
+            nd = len(x.shape)
+            perm = [0, nd - 1] + list(range(1, nd - 1))
+            x = trace_op("transpose", {"X": [x]}, {"axis": perm})["Out"][0]
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = trace_op("group_norm", ins, self._attrs)["Y"][0]
+        if self._nhwc:
+            nd = len(out.shape)
+            perm = [0] + list(range(2, nd)) + [1]
+            out = trace_op("transpose", {"X": [out]}, {"axis": perm})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class SpectralNorm(Layer):
+    """Reference dygraph/nn.py SpectralNorm (:2481): power-iteration weight
+    normalization. Holds the u/v vectors as buffers."""
+
+    def __init__(self, weight_shape, dim: int = 0, power_iters: int = 1,
+                 eps: float = 1e-12, dtype="float32"):
+        super().__init__()
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        rng = np.random.RandomState(0)
+        self._u = self.register_buffer(
+            "_u", rng.normal(size=h).astype(dtype))
+        self._v = self.register_buffer(
+            "_v", rng.normal(size=w).astype(dtype))
+
+    def forward(self, weight):
+        return trace_op("spectral_norm",
+                        {"Weight": [weight], "U": [self._u], "V": [self._v]},
+                        self._attrs)["Out"][0]
+
+
+class TreeConv(Layer):
+    """Reference dygraph/nn.py TreeConv (:2581): tree-based convolution over
+    (NodesVector, EdgeSet)."""
+
+    def __init__(self, feature_size: int, output_size: int,
+                 num_filters: int = 1, max_depth: int = 8, act="tanh",
+                 param_attr=None, bias_attr=None, name=None, dtype="float32"):
+        super().__init__()
+        self._attrs = {"max_depth": max_depth}
+        self._act = act
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size * num_filters], param_attr, dtype)
+        self.bias = (self.create_parameter([output_size * num_filters],
+                                           bias_attr, dtype, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, nodes_vector, edge_set):
+        out = trace_op("tree_conv",
+                       {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                        "Filter": [self.weight]}, self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": -1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out

@@ -1,0 +1,127 @@
+"""Composite network helpers (reference python/paddle/fluid/nets.py).
+
+Same five public helpers as the reference — simple_img_conv_pool (:28),
+img_conv_group (:136), sequence_conv_pool (:249), glu (:307),
+scaled_dot_product_attention (:345) — composed from this framework's layers.
+Differences from the reference are TPU-design consequences:
+- sequence helpers take an explicit `length` Variable (LoD metadata rides a
+  dense tensor here, see layers/sequence.py);
+- scaled_dot_product_attention keeps the reference's shape contract but the
+  computation lowers to one fused XLA attention (and can be swapped for the
+  Pallas flash kernel via layers.flash_attention by callers that need it).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    """conv2d → pool2d (reference nets.py:28)."""
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act)
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """The VGG block: N×(conv[+bn][+dropout]) → pool (reference
+    nets.py:136)."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _extend(obj):
+        if not hasattr(obj, "__len__"):
+            return [obj] * len(conv_num_filter)
+        assert len(obj) == len(conv_num_filter)
+        return obj
+
+    conv_padding = _extend(conv_padding)
+    conv_filter_size = _extend(conv_filter_size)
+    param_attr = _extend(param_attr)
+    conv_with_batchnorm = _extend(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _extend(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i],
+            filter_size=conv_filter_size[i], padding=conv_padding[i],
+            param_attr=param_attr[i], act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, length,
+                       param_attr=None, act="sigmoid", pool_type="max",
+                       bias_attr=None):
+    """sequence_conv → sequence_pool (reference nets.py:249). `length` is
+    the per-row valid-length Variable (TPU replacement for LoD)."""
+    conv_out = layers.sequence_conv(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        length=length, param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type,
+                                length=length)
+
+
+def glu(input, dim: int = -1):
+    """Gated linear unit: split → a ⊙ σ(b) (reference nets.py:307)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(x=a, y=layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads: int = 1,
+                                 dropout_rate: float = 0.0):
+    """Multi-head scaled-dot-product attention over [B, T, D] tensors
+    (reference nets.py:345). Returns [B, Tq, D_v]."""
+    if len(queries.shape) != 3 or len(keys.shape) != 3 or len(values.shape) != 3:
+        raise ValueError("inputs must be 3-D [batch, seq, dim]")
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError("queries and keys must have the same hidden size")
+    if keys.shape[1] != values.shape[1]:
+        raise ValueError("keys and values must share the sequence length")
+    if queries.shape[-1] % num_heads != 0:
+        raise ValueError("hidden size must divide num_heads")
+
+    q, k, v = queries, keys, values
+    if num_heads > 1:
+        def split_heads(x):
+            b, t, dm = x.shape
+            x = layers.reshape(x, [b, t, num_heads, dm // num_heads])
+            return layers.transpose(x, [0, 2, 1, 3])     # [B, H, T, d]
+        q, k, v = split_heads(q), split_heads(k), split_heads(v)
+
+    import math
+    scaled_q = layers.scale(q, scale=1.0 / math.sqrt(q.shape[-1]))
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    if num_heads > 1:
+        b, t = queries.shape[0], queries.shape[1]
+        dv = values.shape[-1]
+        ctx = layers.transpose(ctx, [0, 2, 1, 3])
+        ctx = layers.reshape(ctx, [b, t, dv])
+    return ctx

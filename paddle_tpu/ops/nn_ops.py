@@ -66,12 +66,13 @@ def _depthwise_conv2d(ctx, inputs, attrs):
     return _conv2d(ctx, inputs, attrs)
 
 
-def conv_transpose_nd(x, w, strides, pads, dils, groups):
+def conv_transpose_nd(x, w, strides, pads, dils, groups, out_pads=None):
     """Shared N-d transposed-conv core (conv_transpose_op.cc semantics:
-    out = (i-1)*s - 2p + d*(k-1) + 1). Expressed as a fractionally-strided
-    conv (lhs_dilation) with the kernel spatially flipped — the
-    gradient-of-conv formulation XLA lowers well. `w` is paddle layout
-    [C_in, C_out/groups, *k]."""
+    out = (i-1)*s - 2p + d*(k-1) + 1, plus per-dim output_padding on the
+    trailing edge when `out_pads` is given — the output_size resolver).
+    Expressed as a fractionally-strided conv (lhs_dilation) with the kernel
+    spatially flipped — the gradient-of-conv formulation XLA lowers well.
+    `w` is paddle layout [C_in, C_out/groups, *k]."""
     nd = len(strides)
     ks = w.shape[2:]
     wt = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
@@ -81,14 +82,38 @@ def conv_transpose_nd(x, w, strides, pads, dils, groups):
         wt = jnp.swapaxes(wt, 1, 2).reshape(groups * cog, cin // groups, *ks)
     else:
         wt = jnp.swapaxes(wt, 0, 1)
-    pad = [(d * (k - 1) - p, d * (k - 1) - p)
-           for k, p, d in zip(ks, pads, dils)]
+    out_pads = out_pads or [0] * nd
+    pad = [(d * (k - 1) - p, d * (k - 1) - p + op)
+           for k, p, d, op in zip(ks, pads, dils, out_pads)]
     dn = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
           3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
     return lax.conv_general_dilated(
         x, wt, window_strides=(1,) * nd, padding=pad,
         lhs_dilation=tuple(strides), rhs_dilation=tuple(dils),
         feature_group_count=groups, dimension_numbers=dn)
+
+
+def _out_pads_from_output_size(x, w, attrs, nd):
+    """Resolve the reference's output_size attr into trailing output
+    padding: output_size must lie in [default, default + stride)."""
+    output_size = attrs.get("output_size")
+    if not output_size:
+        return None
+    strides = _pair(attrs.get("strides", [1] * nd), nd)
+    pads = _pair(attrs.get("paddings", [0] * nd), nd)
+    dils = _pair(attrs.get("dilations", [1] * nd), nd)
+    ks = w.shape[2:]
+    out_pads = []
+    for i, want in enumerate(_pair(output_size, nd)):
+        default = ((x.shape[2 + i] - 1) * strides[i] - 2 * pads[i]
+                   + dils[i] * (ks[i] - 1) + 1)
+        extra = int(want) - default
+        if not 0 <= extra < strides[i]:
+            raise ValueError(
+                f"conv_transpose output_size[{i}]={want} must be in "
+                f"[{default}, {default + strides[i] - 1}]")
+        out_pads.append(extra)
+    return out_pads
 
 
 @register_op("conv2d_transpose")
@@ -99,7 +124,8 @@ def _conv2d_transpose(ctx, inputs, attrs):
         x, w, _pair(attrs.get("strides", [1, 1])),
         _pair(attrs.get("paddings", [0, 0])),
         _pair(attrs.get("dilations", [1, 1])),
-        int(attrs.get("groups", 1))))
+        int(attrs.get("groups", 1)),
+        out_pads=_out_pads_from_output_size(x, w, attrs, 2)))
 
 
 @register_op("conv3d")

@@ -1,0 +1,71 @@
+"""Profile → chrome://tracing converter.
+
+Reference analog: ``tools/timeline.py`` (profiler.proto → chrome trace
+JSON). The TPU build profiles through jax.profiler (XPlane protos under
+``<logdir>/plugins/profile/<run>/*.xplane.pb``, written by
+``paddle_tpu.profiler`` / ``jax.profiler.trace``); this tool converts a
+run's XPlane to the same chrome://tracing JSON the reference produced, via
+the xprof trace-viewer converter when available.
+
+CLI::
+
+    python -m paddle_tpu.tools.timeline --logdir ./_trace --out trace.json
+    # then open chrome://tracing (or https://ui.perfetto.dev) and load it
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+from typing import List, Optional
+
+
+def find_xplanes(logdir: str) -> List[str]:
+    """Newest profile run's xplane files under a jax.profiler logdir."""
+    runs = sorted(glob.glob(os.path.join(logdir, "plugins", "profile", "*")))
+    if not runs:
+        # maybe logdir IS the run dir
+        direct = glob.glob(os.path.join(logdir, "*.xplane.pb"))
+        if direct:
+            return direct
+        raise FileNotFoundError(
+            f"no profile runs under {logdir!r} (expected "
+            f"plugins/profile/<run>/*.xplane.pb)")
+    return glob.glob(os.path.join(runs[-1], "*.xplane.pb"))
+
+
+def xplane_to_chrome_trace(xplane_files: List[str]) -> dict:
+    """XPlane → chrome trace events dict ({"traceEvents": [...]})."""
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "timeline conversion needs the xprof package (bundled with "
+            "tensorboard-plugin-profile)") from e
+    data, _ = rtd.xspace_to_tool_data(list(xplane_files), "trace_viewer@", {})
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", errors="replace")
+    out = json.loads(data)
+    if "traceEvents" not in out:
+        out = {"traceEvents": out if isinstance(out, list) else []}
+    return out
+
+
+def main(argv: Optional[List[str]] = None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--logdir", required=True,
+                    help="jax.profiler trace dir (the arg of profiler.start)")
+    ap.add_argument("--out", default="timeline.json",
+                    help="output chrome-trace JSON path")
+    args = ap.parse_args(argv)
+    files = find_xplanes(args.logdir)
+    trace = xplane_to_chrome_trace(files)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {args.out} ({len(trace.get('traceEvents', []))} events) — "
+          f"load in chrome://tracing or ui.perfetto.dev")
+
+
+if __name__ == "__main__":
+    main()

@@ -204,3 +204,102 @@ def test_label_semantic_roles():
                   fetch_list=[decode])
     path = np.asarray(out[0])
     assert path.shape[0] == 4 and path.min() >= 0 and path.max() < n_labels
+
+
+def test_image_classification_vgg():
+    """test_image_classification.py vgg16_bn_drop (shrunk): img_conv_group
+    blocks with batch norm + dropout on cifar-shaped input, loss decreases."""
+    from paddle_tpu import nets
+
+    rng = np.random.RandomState(0)
+    classes = 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 5
+        img = fluid.layers.data("img", [3, 16, 16])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        g1 = nets.img_conv_group(img, conv_num_filter=[8, 8], pool_size=2,
+                                 conv_act="relu", conv_with_batchnorm=True,
+                                 conv_batchnorm_drop_rate=0.3, pool_stride=2)
+        g2 = nets.img_conv_group(g1, conv_num_filter=[16, 16], pool_size=2,
+                                 conv_act="relu", conv_with_batchnorm=True,
+                                 pool_stride=2)
+        fc1 = fluid.layers.fc(g2, 32, act="relu")
+        logits = fluid.layers.fc(fc1, classes)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(label=label, logits=logits))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+
+    xs = rng.rand(32, 3, 16, 16).astype("float32")
+    ys = rng.randint(0, classes, (32, 1)).astype("int64")
+    losses, _ = _train(main, startup, lambda i: {"img": xs, "label": ys},
+                       loss, steps=25)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_understand_sentiment_conv():
+    """notest_understand_sentiment.py convolution_net: embedding →
+    sequence_conv_pool ×2 → fc softmax over imdb-shaped id sequences."""
+    from paddle_tpu import nets
+
+    rng = np.random.RandomState(0)
+    vocab, T, B = 60, 12, 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 9
+        data = fluid.layers.data("words", [T], dtype="int64")
+        length = fluid.layers.data("length", [1], dtype="int64")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        emb = fluid.layers.embedding(data, size=[vocab, 16])
+        conv3 = nets.sequence_conv_pool(emb, num_filters=16, filter_size=3,
+                                        length=length, act="tanh",
+                                        pool_type="sqrt")
+        conv4 = nets.sequence_conv_pool(emb, num_filters=16, filter_size=4,
+                                        length=length, act="tanh",
+                                        pool_type="sqrt")
+        both = fluid.layers.concat([conv3, conv4], axis=1)
+        logits = fluid.layers.fc(both, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(label=label, logits=logits))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    # synthetic sentiment: words < vocab//2 → positive
+    lens = rng.randint(4, T + 1, (B,))
+    words = np.zeros((B, T), "int64")
+    labels = np.zeros((B, 1), "int64")
+    for i, L in enumerate(lens):
+        pos = i % 2 == 0
+        lo, hi = (0, vocab // 2) if pos else (vocab // 2, vocab)
+        words[i, :L] = rng.randint(lo, hi, (L,))
+        labels[i, 0] = int(pos)
+    feed = {"words": words, "length": lens.reshape(-1, 1).astype("int64"),
+            "label": labels}
+    losses, _ = _train(main, startup, lambda i: feed, loss, steps=30)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_nets_glu_and_attention():
+    """nets.py glu (:307) + scaled_dot_product_attention (:345) parity."""
+    from paddle_tpu import nets
+
+    rng = np.random.RandomState(1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6, 8])
+        g = nets.glu(x, dim=-1)
+        q = fluid.layers.data("q", [5, 8])
+        kv = fluid.layers.data("kv", [7, 8])
+        att = nets.scaled_dot_product_attention(q, kv, kv, num_heads=2)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = rng.rand(2, 6, 8).astype("float32")
+    qv = rng.rand(2, 5, 8).astype("float32")
+    kvv = rng.rand(2, 7, 8).astype("float32")
+    g_out, a_out = exe.run(main, feed={"x": xv, "q": qv, "kv": kvv},
+                           fetch_list=[g, att])
+    a, b = xv[..., :4], xv[..., 4:]
+    np.testing.assert_allclose(g_out, a * (1 / (1 + np.exp(-b))), rtol=1e-5)
+    assert a_out.shape == (2, 5, 8)
+    # attention rows are convex combinations of v rows: bounded by min/max
+    assert a_out.max() <= kvv.max() + 1e-5 and a_out.min() >= kvv.min() - 1e-5

@@ -171,3 +171,91 @@ def test_no_grad():
         with dygraph.no_grad():
             y = x * 3.0
         assert y.stop_gradient
+
+
+def test_dygraph_layer_zoo_round2():
+    """Round-2 dygraph layer additions (reference dygraph/nn.py classes:
+    Conv2DTranspose :1981, Conv3D :258, NCE :1579, BilinearTensorProduct
+    :1881, SequenceConv :2216, RowConv :2306, GroupNorm :2382, SpectralNorm
+    :2481, TreeConv :2581): forward shapes + a gradient through each."""
+    from paddle_tpu.dygraph.tracer import trace_op
+
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        x4 = dygraph.to_variable(rng.rand(2, 3, 8, 8).astype("float32"))
+        y = dygraph.nn.Conv2DTranspose(3, 5, 3)(x4)
+        assert y.shape == (2, 5, 10, 10)
+
+        x5 = dygraph.to_variable(rng.rand(2, 3, 4, 8, 8).astype("float32"))
+        y = dygraph.nn.Conv3D(3, 5, 3)(x5)
+        assert y.shape == (2, 5, 2, 6, 6)
+        y = dygraph.nn.Conv3DTranspose(3, 5, 3)(x5)
+        assert y.shape == (2, 5, 6, 10, 10)
+
+        nce = dygraph.nn.NCE(num_total_classes=20, dim=6, num_neg_samples=4)
+        cost = nce(dygraph.to_variable(rng.rand(3, 6).astype("float32")),
+                   dygraph.to_variable(rng.randint(0, 20, (3, 1))))
+        assert cost.shape == (3, 1)
+        loss = trace_op("reduce_sum", {"X": [cost]}, {"reduce_all": True})["Out"][0]
+        loss.backward()
+        assert np.isfinite(nce.weight.gradient).all()
+
+        blt = dygraph.nn.BilinearTensorProduct(4, 5, 6)
+        out = blt(dygraph.to_variable(rng.rand(3, 4).astype("float32")),
+                  dygraph.to_variable(rng.rand(3, 5).astype("float32")))
+        assert out.shape == (3, 6)
+
+        sc = dygraph.nn.SequenceConv(8, 16, filter_size=3, act="tanh")
+        out = sc(dygraph.to_variable(rng.rand(2, 6, 8).astype("float32")),
+                 dygraph.to_variable(np.array([[6], [4]], "int64")))
+        assert out.shape == (2, 6, 16)
+
+        rc = dygraph.nn.RowConv(8, future_context_size=2)
+        out = rc(dygraph.to_variable(rng.rand(2, 6, 8).astype("float32")))
+        assert out.shape == (2, 6, 8)
+
+        gn = dygraph.nn.GroupNorm(6, groups=3)
+        out = gn(dygraph.to_variable(rng.rand(2, 6, 4, 4).astype("float32")))
+        assert out.shape == (2, 6, 4, 4)
+        got = out.numpy().reshape(2, 3, 2, 4, 4)
+        np.testing.assert_allclose(got.mean(axis=(2, 3, 4)), 0, atol=1e-4)
+
+        sn = dygraph.nn.SpectralNorm([6, 4], power_iters=3)
+        w = dygraph.to_variable(rng.rand(6, 4).astype("float32"))
+        wn = sn(w)
+        assert wn.shape == (6, 4)
+        # spectral norm of the output ≈ 1
+        s = np.linalg.svd(wn.numpy(), compute_uv=False)
+        assert abs(s[0] - 1.0) < 0.15
+
+        tc = dygraph.nn.TreeConv(feature_size=5, output_size=4, max_depth=2)
+        nodes = dygraph.to_variable(rng.rand(1, 6, 5).astype("float32"))
+        edges = dygraph.to_variable(
+            np.array([[[1, 2], [1, 3], [2, 4], [2, 5]]], "int32"))
+        out = tc(nodes, edges)
+        assert out.shape[0] == 1 and out.shape[1] == 6
+
+
+def test_dygraph_layer_zoo_fixes():
+    """Review fixes: GroupNorm with bias_attr=False and NHWC layout,
+    Conv2DTranspose output_size, NCE rejects non-uniform samplers."""
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        gn = dygraph.nn.GroupNorm(6, groups=3, param_attr=False,
+                                  bias_attr=False)
+        out = gn(dygraph.to_variable(rng.rand(2, 6, 4, 4).astype("float32")))
+        assert out.shape == (2, 6, 4, 4)
+
+        x_nhwc = rng.rand(2, 4, 4, 6).astype("float32")
+        gn2 = dygraph.nn.GroupNorm(6, groups=3, data_layout="NHWC")
+        out2 = gn2(dygraph.to_variable(x_nhwc))
+        assert out2.shape == (2, 4, 4, 6)
+        got = out2.numpy().transpose(0, 3, 1, 2).reshape(2, 3, 2, 4, 4)
+        np.testing.assert_allclose(got.mean(axis=(2, 3, 4)), 0, atol=1e-4)
+
+        ct = dygraph.nn.Conv2DTranspose(3, 5, 3, output_size=16, stride=2)
+        y = ct(dygraph.to_variable(rng.rand(2, 3, 7, 7).astype("float32")))
+        assert y.shape == (2, 5, 16, 16)
+
+        with pytest.raises(NotImplementedError):
+            dygraph.nn.NCE(num_total_classes=10, dim=4, sampler="log_uniform")

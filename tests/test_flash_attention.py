@@ -164,13 +164,20 @@ def test_bert_flash_matches_naive_path():
 
 @pytest.mark.parametrize("causal,with_bias", [(False, False), (True, False),
                                               (False, True)])
-def test_pallas_kernel_interpret_mode(causal, with_bias):
-    """The actual Pallas kernel, run through the interpreter on CPU, against
-    the naive reference — validates what executes on the real chip."""
+@pytest.mark.parametrize("force_general", [False, True])
+def test_pallas_kernel_interpret_mode(causal, with_bias, force_general,
+                                      monkeypatch):
+    """The actual Pallas kernels, run through the interpreter on CPU, against
+    the naive reference — validates what executes on the real chip. At these
+    single-block shapes the one-pass grouped kernel dispatches by default;
+    force_general pins group=1 so the online-softmax _fwd_kernel keeps
+    interpreter coverage too."""
     import jax.numpy as jnp
     import importlib
     fa_mod = importlib.import_module(
         "paddle_tpu.ops.pallas_kernels.flash_attention")
+    if force_general:
+        monkeypatch.setattr(fa_mod, "_pick_group", lambda *a, **k: 1)
 
     b, h, t, d = 1, 2, 256, 64
     bh = b * h

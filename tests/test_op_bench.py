@@ -39,3 +39,22 @@ def test_bench_int_input_op():
         {"W": {"shape": [64, 8]}, "Ids": {"shape": [16, 1], "dtype": "int64"}},
         repeat=3, warmup=1)
     assert res["mean_us"] > 0
+
+
+def test_timeline_conversion(tmp_path):
+    """tools/timeline.py parity (reference tools/timeline.py): capture a
+    jax.profiler trace, convert the xplane to chrome-trace JSON."""
+    import jax
+    import jax.numpy as jnp
+    import json
+    from paddle_tpu.tools import timeline
+
+    logdir = str(tmp_path / "trace")
+    with jax.profiler.trace(logdir):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    files = timeline.find_xplanes(logdir)
+    assert files
+    out = str(tmp_path / "timeline.json")
+    timeline.main(["--logdir", logdir, "--out", out])
+    trace = json.load(open(out))
+    assert "traceEvents" in trace
