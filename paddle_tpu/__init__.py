@@ -64,6 +64,31 @@ from .core import (  # noqa: F401
     scope_guard,
 )
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import data_generator  # noqa: F401
+from . import transpiler  # noqa: F401
+from .core.lod import (  # noqa: F401
+    LoDTensor,
+    LoDTensorArray,
+    create_lod_tensor,
+    create_random_int_lodtensor,
+)
+from .layers.math_op_patch import monkey_patch_variable  # noqa: F401
+from .parallel.fleet import fleet  # noqa: F401
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    memory_optimize,
+    release_memory,
+)
+
+
+def CUDAPinnedPlace():
+    """place.h CUDAPinnedPlace parity — host staging is XLA's job here; maps
+    to the CPU place."""
+    return CPUPlace()
+
+
+_Scope = Scope  # pybind alias parity (pybind.cc Scope binding)
 
 __version__ = "0.1.0"
 

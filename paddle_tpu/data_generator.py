@@ -1,0 +1,89 @@
+"""Dataset text-format emitters.
+
+Reference analog: ``python/paddle/fluid/incubate/data_generator/__init__.py``
+(DataGenerator :21, MultiSlotDataGenerator :157, MultiSlotStringDataGenerator
+— users override generate_sample to parse raw lines into
+[(slot_name, [feasign, ...]), ...]; the generator serializes them into the
+MultiSlot text format "len v1 v2 ... len v1 ...").
+
+That format is exactly what this framework's native C++ loader parses
+(native/src/dataloader.cc), so a reference data_generator script produces
+files `Dataset.set_filelist` consumes unchanged.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Iterable
+
+
+class DataGenerator:
+    """Base: override generate_sample(line); optionally generate_batch."""
+
+    def __init__(self):
+        self.batch_size_ = 1
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a callable yielding [(name, [feasign, ...]), ...] per
+        sample (the reference's generator-of-generators protocol)."""
+        raise NotImplementedError(
+            "please rewrite this function to return a generator of "
+            "[(name, [feasign, ...]), ...] samples")
+
+    def generate_batch(self, samples):
+        """Default batching: yield samples unchanged, one per line."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line) -> str:
+        raise NotImplementedError(
+            "please use MultiSlotDataGenerator or "
+            "MultiSlotStringDataGenerator")
+
+    # -- drivers ------------------------------------------------------------
+    def run_from_memory(self, lines: Iterable = (None,), out=None):
+        """Feed generate_sample with in-memory lines, write MultiSlot text
+        to `out` (default stdout)."""
+        out = out or sys.stdout
+        batch_samples = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for sample in gen():
+                if sample is None:
+                    continue
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    for s in self.generate_batch(batch_samples)():
+                        out.write(self._gen_str(s))
+                    batch_samples = []
+        if batch_samples:
+            for s in self.generate_batch(batch_samples)():
+                out.write(self._gen_str(s))
+
+    def run_from_stdin(self):
+        self.run_from_memory(sys.stdin)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric feasigns → "len v1 v2 ..." per slot, space-joined."""
+
+    def _gen_str(self, line) -> str:
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "generate_sample must yield a list/tuple of "
+                "(name, [feasign, ...]) pairs, got " + repr(type(line)))
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """Same wire format; feasigns are already strings (skips numeric
+    conversion — the reference's fast path)."""

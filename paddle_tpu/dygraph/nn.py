@@ -440,8 +440,8 @@ class GroupNorm(Layer):
         super().__init__()
         if data_layout not in ("NCHW", "NHWC"):
             raise ValueError(f"unknown data_layout {data_layout!r}")
-        self._nhwc = data_layout == "NHWC"
-        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._attrs = {"groups": groups, "epsilon": epsilon,
+                       "data_layout": data_layout}
         self._act = act
         self.weight = (self.create_parameter(
             [channels], param_attr, dtype,
@@ -452,20 +452,12 @@ class GroupNorm(Layer):
                      if bias_attr is not False else None)
 
     def forward(self, x):
-        if self._nhwc:  # the op computes over NCHW channels
-            nd = len(x.shape)
-            perm = [0, nd - 1] + list(range(1, nd - 1))
-            x = trace_op("transpose", {"X": [x]}, {"axis": perm})["Out"][0]
         ins = {"X": [x]}
         if self.weight is not None:
             ins["Scale"] = [self.weight]
         if self.bias is not None:
             ins["Bias"] = [self.bias]
         out = trace_op("group_norm", ins, self._attrs)["Y"][0]
-        if self._nhwc:
-            nd = len(out.shape)
-            perm = [0] + list(range(2, nd)) + [1]
-            out = trace_op("transpose", {"X": [out]}, {"axis": perm})["Out"][0]
         if self._act:
             out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
         return out

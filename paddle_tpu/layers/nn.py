@@ -131,11 +131,13 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     w = helper.create_parameter(param_attr, shape=[num_channels, num_filters // groups, fh, fw],
                                 dtype=input.dtype)
     out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"strides": list(_pair(stride)), "paddings": list(_pair(padding)),
+             "dilations": list(_pair(dilation)), "groups": groups}
+    if output_size is not None:
+        attrs["output_size"] = list(_pair(output_size))
     helper.append_op(
         type="conv2d_transpose", inputs={"Input": [input.name], "Filter": [w.name]},
-        outputs={"Out": [out.name]},
-        attrs={"strides": list(_pair(stride)), "paddings": list(_pair(padding)),
-               "dilations": list(_pair(dilation)), "groups": groups})
+        outputs={"Out": [out.name]}, attrs=attrs)
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, shape=[num_filters], dtype=input.dtype, is_bias=True)
         tmp = helper.create_variable_for_type_inference(input.dtype)

@@ -102,7 +102,7 @@ def scaled_dot_product_attention(queries, keys, values, num_heads: int = 1,
     if keys.shape[1] != values.shape[1]:
         raise ValueError("keys and values must share the sequence length")
     if queries.shape[-1] % num_heads != 0:
-        raise ValueError("hidden size must divide num_heads")
+        raise ValueError("num_heads must evenly divide the hidden size")
 
     q, k, v = queries, keys, values
     if num_heads > 1:

@@ -267,6 +267,9 @@ def _group_norm(ctx, inputs, attrs):
     bias = inputs.get("Bias", [None])[0]
     eps = attrs.get("epsilon", 1e-5)
     groups = attrs["groups"]
+    nhwc = attrs.get("data_layout", "NCHW") == "NHWC"
+    if nhwc:  # normalize in channels-first, restore on the way out
+        x = jnp.moveaxis(x, -1, 1)
     n, c = x.shape[0], x.shape[1]
     rest = x.shape[2:]
     xg = x.reshape((n, groups, c // groups) + rest)
@@ -279,6 +282,8 @@ def _group_norm(ctx, inputs, attrs):
         y = y * scale.reshape(cshape)
     if bias is not None:
         y = y + bias.reshape(cshape)
+    if nhwc:
+        y = jnp.moveaxis(y, 1, -1)
     return {"Y": [y], "Mean": [mean.reshape(n, groups)], "Variance": [var.reshape(n, groups)]}
 
 

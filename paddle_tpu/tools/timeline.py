@@ -24,15 +24,18 @@ from typing import List, Optional
 def find_xplanes(logdir: str) -> List[str]:
     """Newest profile run's xplane files under a jax.profiler logdir."""
     runs = sorted(glob.glob(os.path.join(logdir, "plugins", "profile", "*")))
-    if not runs:
-        # maybe logdir IS the run dir
-        direct = glob.glob(os.path.join(logdir, "*.xplane.pb"))
-        if direct:
-            return direct
-        raise FileNotFoundError(
-            f"no profile runs under {logdir!r} (expected "
-            f"plugins/profile/<run>/*.xplane.pb)")
-    return glob.glob(os.path.join(runs[-1], "*.xplane.pb"))
+    # newest run that actually holds an xplane (an interrupted newer run must
+    # not shadow a complete older one)
+    for run in reversed(runs):
+        files = glob.glob(os.path.join(run, "*.xplane.pb"))
+        if files:
+            return files
+    direct = glob.glob(os.path.join(logdir, "*.xplane.pb"))
+    if direct:
+        return direct
+    raise FileNotFoundError(
+        f"no profile runs under {logdir!r} (expected "
+        f"plugins/profile/<run>/*.xplane.pb)")
 
 
 def xplane_to_chrome_trace(xplane_files: List[str]) -> dict:
