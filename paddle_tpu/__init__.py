@@ -64,6 +64,7 @@ from .core import (  # noqa: F401
     scope_guard,
 )
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .core import unique_name  # noqa: F401
 from . import data_generator  # noqa: F401
 from . import transpiler  # noqa: F401
 from .core.lod import (  # noqa: F401

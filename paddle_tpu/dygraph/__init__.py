@@ -16,15 +16,55 @@ from .jit import jit  # noqa: F401
 from .layers import Layer  # noqa: F401
 from .nn import (  # noqa: F401
     BatchNorm,
+    BilinearTensorProduct,
     Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
     Embedding,
     FC,
+    GroupNorm,
     GRUUnit,
     LayerNorm,
     Linear,
+    NCE,
     Pool2D,
     PRelu,
+    RowConv,
+    SequenceConv,
+    SpectralNorm,
+    TreeConv,
+)
+from .checkpoint import (  # noqa: F401
+    load_dygraph as load_persistables,
+    save_dygraph as save_persistables,
+)
+from .learning_rate_scheduler import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    NaturalExpDecay,
+    NoamDecay,
+    PiecewiseDecay,
+    PolynomialDecay,
 )
 from .parallel import DataParallel, prepare_context  # noqa: F401
+
+
+class BackwardStrategy:
+    """Reference backward_strategy.py shim: sort_sum_gradient toggles an
+    accumulation order the functional vjp tape makes moot."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
+def start_gperf_profiler():
+    """imperative/profiler.cc gperftools hook — no gperftools here; use
+    paddle_tpu.profiler (jax traces) instead. No-op shim."""
+
+
+def stop_gperf_profiler():
+    """See start_gperf_profiler."""
 from .tracer import Tracer  # noqa: F401
 from .varbase import VarBase  # noqa: F401

@@ -140,3 +140,14 @@ Bilinear = BilinearInitializer
 
 def force_init_on_cpu() -> bool:
     return False
+
+
+from contextlib import contextmanager as _contextmanager
+
+
+@_contextmanager
+def init_on_cpu():
+    """Reference initializer.py init_on_cpu: force lr-schedule vars onto the
+    CPU. Device placement is XLA's decision here — a documented no-op kept
+    for API parity."""
+    yield

@@ -70,3 +70,21 @@ from .reduce import (  # noqa: F401
     reduce_sum,
 )
 from .tensor import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .distributions import (  # noqa: F401
+    Categorical,
+    MultivariateNormalDiag,
+    Normal,
+    Uniform,
+)
+
+# Layer-surface completion: export every coverage.py wrapper that doesn't
+# collide with an existing (more specific) definition above.
+from . import coverage as _coverage  # noqa: E402
+import sys as _sys  # noqa: E402
+
+_self = _sys.modules[__name__]
+for _n in dir(_coverage):
+    if not _n.startswith("_") and not hasattr(_self, _n):
+        setattr(_self, _n, getattr(_coverage, _n))
+del _sys, _n, _self

@@ -158,3 +158,80 @@ class EditDistance(MetricBase):
         if self.count == 0:
             raise ValueError("no data")
         return self.total / self.count, self.correct / self.count
+
+
+class ChunkEvaluator(MetricBase):
+    """metrics.py ChunkEvaluator: accumulate chunk_eval op counts into
+    running precision/recall/F1."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+
+class DetectionMAP(MetricBase):
+    """metrics.py DetectionMAP: mean average precision accumulator over
+    (score, tp/fp) detections — 11-point interpolated AP per class."""
+
+    def __init__(self, name=None, class_num=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral",
+                 input=None, gt_label=None, gt_box=None, gt_difficult=None):
+        super().__init__(name)
+        self.class_num = class_num
+        self.ap_version = ap_version
+        self.reset()
+
+    def update(self, detections, gt_labels):
+        """detections: rows [class, score, correct(0/1)] per detection;
+        gt_labels: iterable of ground-truth class ids."""
+        for row in np.asarray(detections).reshape(-1, 3):
+            c, score, correct = int(row[0]), float(row[1]), int(row[2])
+            self._dets.setdefault(c, []).append((score, correct))
+        for g in np.asarray(gt_labels).reshape(-1):
+            self._gt[int(g)] = self._gt.get(int(g), 0) + 1
+
+    def eval(self):
+        aps = []
+        for c, npos in self._gt.items():
+            dets = sorted(self._dets.get(c, []), reverse=True)
+            if not dets:
+                aps.append(0.0)
+                continue
+            tp = np.cumsum([d[1] for d in dets])
+            fp = np.cumsum([1 - d[1] for d in dets])
+            rec = tp / max(npos, 1)
+            prec = tp / np.maximum(tp + fp, 1e-9)
+            if self.ap_version == "11point":
+                ap = np.mean([prec[rec >= t].max() if (rec >= t).any()
+                              else 0.0 for t in np.linspace(0, 1, 11)])
+            else:  # integral
+                ap = float(np.sum((rec[1:] - rec[:-1]) * prec[1:])
+                           + rec[0] * prec[0]) if len(rec) > 1 else \
+                    float(rec[0] * prec[0])
+            aps.append(float(ap))
+        return float(np.mean(aps)) if aps else 0.0
+
+    def reset(self, executor=None, reset_program=None):
+        self._dets = {}
+        self._gt = {}

@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     collective_ops,
     compare_ops,
     control_flow_ops,
+    coverage_ops,
     crf_ops,
     detection_ops,
     framework_ops,

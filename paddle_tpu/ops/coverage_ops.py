@@ -1,0 +1,230 @@
+"""Final coverage ops — the last reference op families without a kernel.
+
+Reference analogs: brelu (activation_op.cc BRelu), pool_op.cc adaptive 3-D
+path, chunk_eval_op.cc/.h (IOB-family chunk F1), hash_op.cc (multi-seed
+mod-space hashing), unique_op.cc / unique_with_counts_op.cc,
+scatter_nd_op (via scatter_nd_add on zeros), isfinite_op.cc variants
+(has_inf / has_nan), fill_any_like (ones_like tensor.py).
+
+TPU notes: unique is inherently dynamic-shaped in the reference; here the
+output keeps the static input length with the tail padded by the first
+unique value, plus an explicit `Count` scalar — the padded+length idiom
+every LoD replacement in this build uses. chunk_eval computes span
+boundaries with a reverse scan (next-end index per position) so chunk
+matching is static-shape; hash uses a different (but deterministic)
+integer mix than the reference's xxHash — same contract: stable ids in
+[0, mod_by).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+from .common import one
+
+
+@register_op("brelu")
+def _brelu(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    t_min = attrs.get("t_min", 0.0)
+    t_max = attrs.get("t_max", 24.0)
+    return one(jnp.clip(x, t_min, t_max))
+
+
+@register_op("adaptive_pool3d")
+def _adaptive_pool3d(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    ksize = attrs.get("pooling_size", attrs.get("ksize"))
+    od, oh, ow = (ksize if isinstance(ksize, (list, tuple)) else [ksize] * 3)
+    ptype = attrs.get("pooling_type", "avg")
+    n, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        x6 = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        return one(jnp.mean(x6, axis=(3, 5, 7)) if ptype == "avg"
+                   else jnp.max(x6, axis=(3, 5, 7)))
+    from .nn_ops import _adaptive_bins
+    red = jnp.mean if ptype == "avg" else jnp.max
+    planes = []
+    for ds, de in _adaptive_bins(d, od):
+        rows = []
+        for hs, he in _adaptive_bins(h, oh):
+            cols = [red(x[:, :, ds:de, hs:he, ws:we], axis=(2, 3, 4))
+                    for ws, we in _adaptive_bins(w, ow)]
+            rows.append(jnp.stack(cols, axis=-1))
+        planes.append(jnp.stack(rows, axis=-2))
+    return one(jnp.stack(planes, axis=-3))
+
+
+@register_op("has_inf", differentiable=False)
+def _has_inf(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.isinf(x).any().reshape(1))
+
+
+@register_op("has_nan", differentiable=False)
+def _has_nan(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return one(jnp.isnan(x).any().reshape(1))
+
+
+@register_op("ones_like", differentiable=False)
+def _ones_like(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    return {"Out": [jnp.ones_like(x)]}
+
+
+@register_op("scatter_nd", differentiable=False, nondiff_inputs=["Index"])
+def _scatter_nd(ctx, inputs, attrs):
+    """scatter_nd = scatter_nd_add onto zeros of attr `shape`."""
+    (index,) = inputs["Index"]
+    (updates,) = inputs["Updates"]
+    shape = tuple(attrs["shape"])
+    zeros = jnp.zeros(shape, updates.dtype)
+    idx_dims = index.shape[-1]
+    dnums = lax.ScatterDimensionNumbers(
+        update_window_dims=tuple(range(index.ndim - 1, updates.ndim)),
+        inserted_window_dims=tuple(range(idx_dims)),
+        scatter_dims_to_operand_dims=tuple(range(idx_dims)))
+    out = lax.scatter_add(zeros, index, updates, dnums)
+    return {"Out": [out]}
+
+
+@register_op("hash", differentiable=False)
+def _hash(ctx, inputs, attrs):
+    """hash_op.cc: num_hash independent hashes of each id row into
+    [0, mod_by). Deterministic multiplicative mixing (splitmix-style)
+    instead of the reference's xxHash — same stable-id contract."""
+    (x,) = inputs["X"]
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+    ids = x.astype(jnp.uint32).reshape(x.shape[0], -1)
+    # combine the columns of each row into one key
+    key = jnp.zeros((x.shape[0],), jnp.uint32)
+    for c in range(ids.shape[1]):
+        key = key * jnp.uint32(1000003) + ids[:, c]
+    outs = []
+    for s in range(num_hash):
+        h = key + jnp.uint32((0x9E3779B9 * (s + 1)) & 0xFFFFFFFF)
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return {"Out": [jnp.stack(outs, axis=1)[:, :, None]]}
+
+
+def _unique_impl(x):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    uniq, idx, count = jnp.unique(flat, size=n, fill_value=flat[0],
+                                  return_inverse=True, return_counts=True)
+    # count is 0 for fill slots → number of real uniques
+    num = jnp.sum(count > 0)
+    return uniq, idx.reshape(-1), count, num
+
+
+@register_op("unique", differentiable=False)
+def _unique(ctx, inputs, attrs):
+    """unique_op.cc. Static-shape redesign: `Out` keeps the input length
+    (tail slots repeat the first element), `Index` is the inverse map, and
+    the extra `Count` scalar says how many leading slots are real."""
+    (x,) = inputs["X"]
+    uniq, idx, _, num = _unique_impl(x)
+    dtype = attrs.get("dtype", "int32")
+    it = jnp.int64 if "64" in str(dtype) else jnp.int32
+    return {"Out": [uniq], "Index": [idx.astype(it)],
+            "Count": [num.reshape(1).astype(it)]}
+
+
+@register_op("unique_with_counts", differentiable=False)
+def _unique_with_counts(ctx, inputs, attrs):
+    (x,) = inputs["X"]
+    uniq, idx, count, num = _unique_impl(x)
+    dtype = attrs.get("dtype", "int32")
+    it = jnp.int64 if "64" in str(dtype) else jnp.int32
+    return {"Out": [uniq], "Index": [idx.astype(it)],
+            "Counts": [count.astype(it)],
+            "Count": [num.reshape(1).astype(it)]}
+
+
+def _chunk_bounds(tags, num_chunk_types, scheme, lengths):
+    """(start, end, type) flags per position for IOB/IOE/IOBES/plain tag
+    encodings (chunk_eval_op.h tag layout: tag = type * num_tag_types +
+    tag_pos; `outside` = num_chunk_types * num_tag_types)."""
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    outside = num_chunk_types * n_tag
+    t = tags
+    valid = (jnp.arange(t.shape[1])[None, :] <
+             lengths.reshape(-1, 1)) & (t < outside)
+    typ = jnp.where(valid, t // n_tag, -1)
+    pos = jnp.where(valid, t % n_tag, -1)
+    prev_typ = jnp.concatenate(
+        [jnp.full_like(typ[:, :1], -1), typ[:, :-1]], 1)
+    nxt_typ = jnp.concatenate(
+        [typ[:, 1:], jnp.full_like(typ[:, :1], -1)], 1)
+    if scheme == "IOB":         # pos 0 = B, 1 = I
+        start = valid & ((pos == 0) | (typ != prev_typ))
+        prev_pos = jnp.concatenate(
+            [jnp.full_like(pos[:, :1], -1), pos[:, :-1]], 1)
+        nxt_pos = jnp.concatenate(
+            [pos[:, 1:], jnp.full_like(pos[:, :1], -1)], 1)
+        end = valid & ((nxt_typ != typ) | (nxt_pos == 0))
+    elif scheme == "IOE":       # pos 0 = I, 1 = E
+        end = valid & ((pos == 1) | (typ != nxt_typ))
+        start = valid & (typ != prev_typ)
+    elif scheme == "IOBES":     # 0=B 1=I 2=E 3=S
+        start = valid & ((pos == 0) | (pos == 3))
+        end = valid & ((pos == 2) | (pos == 3))
+    else:                       # plain: maximal same-type runs
+        start = valid & (typ != prev_typ)
+        end = valid & (typ != nxt_typ)
+    return start, end, typ, valid
+
+
+@register_op("chunk_eval", differentiable=False)
+def _chunk_eval(ctx, inputs, attrs):
+    """chunk_eval_op.cc: precision/recall/F1 over labeled chunks. Spans are
+    matched statically: per position, a reverse scan yields the index of
+    the chunk end at-or-after it; a label chunk counts as correct when the
+    inference starts a chunk at the same position with the same type and
+    both scans agree on the end."""
+    (inf,) = inputs["Inference"]
+    (lab,) = inputs["Label"]
+    length = inputs.get("Length", [None])[0]
+    num_chunk_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    b = inf.shape[0] if inf.ndim > 1 else 1
+    inf2 = inf.reshape(b, -1).astype(jnp.int32)
+    lab2 = lab.reshape(b, -1).astype(jnp.int32)
+    tlen = inf2.shape[1]
+    lengths = (length.reshape(-1).astype(jnp.int32) if length is not None
+               else jnp.full((b,), tlen, jnp.int32))
+
+    si, ei, ti, _ = _chunk_bounds(inf2, num_chunk_types, scheme, lengths)
+    sl, el, tl_, _ = _chunk_bounds(lab2, num_chunk_types, scheme, lengths)
+
+    def next_end(end):
+        # reverse scan: index of the first end flag at or after each pos
+        rev = jnp.flip(end, axis=1)
+        idx = jnp.flip(lax.associative_scan(
+            jnp.maximum, jnp.where(rev, jnp.arange(tlen)[None, :], -1),
+            axis=1), axis=1)
+        return tlen - 1 - idx  # back to forward indexing; -1→ tlen (none)
+
+    ne_i, ne_l = next_end(ei), next_end(el)
+    correct = si & sl & (ti == tl_) & (ne_i == ne_l)
+    n_inf = jnp.sum(si).astype(jnp.int64)
+    n_lab = jnp.sum(sl).astype(jnp.int64)
+    n_cor = jnp.sum(correct).astype(jnp.int64)
+    p = n_cor / jnp.maximum(n_inf, 1)
+    r = n_cor / jnp.maximum(n_lab, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-12)
+    f32 = jnp.float32
+    return {"Precision": [p.astype(f32).reshape(1)],
+            "Recall": [r.astype(f32).reshape(1)],
+            "F1-Score": [f1.astype(f32).reshape(1)],
+            "NumInferChunks": [n_inf.reshape(1)],
+            "NumLabelChunks": [n_lab.reshape(1)],
+            "NumCorrectChunks": [n_cor.reshape(1)]}

@@ -176,16 +176,29 @@ def _pool2d(ctx, inputs, attrs):
     return one(out)
 
 
+def _adaptive_bins(size, out):
+    """(start, end) per output bin — torch/paddle adaptive pooling rule."""
+    return [(i * size // out, -(-((i + 1) * size) // out))
+            for i in range(out)]
+
+
 @register_op("adaptive_pool2d")
 def _adaptive_pool2d(ctx, inputs, attrs):
     (x,) = inputs["X"]
     oh, ow = _pair(attrs["pooling_size"] if "pooling_size" in attrs else attrs["ksize"])
     ptype = attrs.get("pooling_type", "avg")
     n, c, h, w = x.shape
-    x5 = x.reshape(n, c, oh, h // oh, ow, w // ow)
-    if ptype == "avg":
-        return one(jnp.mean(x5, axis=(3, 5)))
-    return one(jnp.max(x5, axis=(3, 5)))
+    if h % oh == 0 and w % ow == 0:  # fast path: one reshape-reduce
+        x5 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return one(jnp.mean(x5, axis=(3, 5)) if ptype == "avg"
+                   else jnp.max(x5, axis=(3, 5)))
+    red = jnp.mean if ptype == "avg" else jnp.max
+    rows = []
+    for hs, he in _adaptive_bins(h, oh):
+        cols = [red(x[:, :, hs:he, ws:we], axis=(2, 3))
+                for ws, we in _adaptive_bins(w, ow)]
+        rows.append(jnp.stack(cols, axis=-1))
+    return one(jnp.stack(rows, axis=-2))
 
 
 # ---------------------------------------------------------------------------

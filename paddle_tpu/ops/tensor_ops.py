@@ -291,23 +291,37 @@ def _randint(ctx, inputs, attrs):
 
 @register_op("range", differentiable=False)
 def _range(ctx, inputs, attrs):
-    (start,) = inputs["Start"]
-    (end,) = inputs["End"]
-    (step,) = inputs["Step"]
-    # static-shape requirement: bounds must be concrete (trace-time) constants
+    # static-shape requirement: bounds must be trace-time constants — passed
+    # via attrs by callers that know them, else concretized from the inputs
     import numpy as np
-    return one(jnp.arange(np.asarray(start).item(), np.asarray(end).item(),
-                          np.asarray(step).item(), dtype=start.dtype))
+    start = attrs.get("start")
+    end = attrs.get("end")
+    step = attrs.get("step")
+    dtype = inputs["Start"][0].dtype if inputs.get("Start") else "float32"
+    if start is None:
+        start = np.asarray(inputs["Start"][0]).item()
+    if end is None:
+        end = np.asarray(inputs["End"][0]).item()
+    if step is None:
+        step = np.asarray(inputs["Step"][0]).item()
+    return one(jnp.arange(start, end, step, dtype=dtype))
 
 
 @register_op("linspace", differentiable=False)
 def _linspace(ctx, inputs, attrs):
     import numpy as np
-    (start,) = inputs["Start"]
-    (stop,) = inputs["Stop"]
-    (num,) = inputs["Num"]
-    return one(jnp.linspace(np.asarray(start).item(), np.asarray(stop).item(),
-                            int(np.asarray(num).item())))
+    # static num comes via attrs when the caller knows it (the output shape
+    # must be trace-time static); start/stop may stay traced
+    start = attrs.get("start")
+    stop = attrs.get("stop")
+    num = attrs.get("num")
+    if start is None:
+        start = inputs["Start"][0].reshape(())
+    if stop is None:
+        stop = inputs["Stop"][0].reshape(())
+    if num is None:
+        num = int(np.asarray(inputs["Num"][0]).item())
+    return one(jnp.linspace(start, stop, int(num)))
 
 
 @register_op("eye", differentiable=False)

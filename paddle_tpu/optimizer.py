@@ -790,6 +790,74 @@ class GradientMergeOptimizer:
         return self._opt.backward(*a, **kw)
 
 
+class LookaheadOptimizer:
+    """reference optimizer.py:2970 — fast/slow weight lookahead: every k
+    steps, slow += alpha·(fast − slow) and fast resets to slow. Lowered the
+    same way as GradientMergeOptimizer: a step counter + predicated
+    sub-block, compiled into the one jitted step."""
+
+    _uid = 0
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be within [0, 1]")
+        if k <= 0:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import control_flow as cf
+        from .layers import ops as ops_layers
+        from .layers import tensor as tensor_layers
+
+        out = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program)
+        helper = LayerHelper("lookahead")
+        LookaheadOptimizer._uid += 1
+        counter = helper.create_global_variable(
+            [1], "int64", name=f"lookahead_step_{LookaheadOptimizer._uid}",
+            initializer=ConstantInitializer(0.0))
+        one_v = tensor_layers.fill_constant([1], "int64", 1)
+        new_count = ops_layers.elementwise_add(counter, one_v)
+        tensor_layers.assign(new_count, counter)
+
+        from .core.program import default_startup_program
+        params = loss.block.program.global_block().all_parameters()
+        slows = []
+        startup_block = (startup_program
+                         or default_startup_program()).global_block()
+        for p in params:
+            slow = helper.create_global_variable(
+                list(p.shape), p.dtype, name=f"{p.name}@SLOW",
+                initializer=ConstantInitializer(0.0))
+            # slow starts as the INITIAL fast weights (reference seeds the
+            # slow copies in the startup program, before any update runs)
+            startup_block.append_op(type="assign", inputs={"X": [p.name]},
+                                    outputs={"Out": [slow.name]}, attrs={})
+            slows.append((p, slow))
+
+        k_v = tensor_layers.fill_constant([1], "int64", self.k)
+        sync = ops_layers.equal(
+            ops_layers.elementwise_mod(new_count, k_v),
+            tensor_layers.fill_constant([1], "int64", 0))
+        with cf.ConditionalBlock(sync):
+            for p, slow in slows:
+                blended = ops_layers.elementwise_add(
+                    ops_layers.scale(slow, scale=1.0 - self.alpha),
+                    ops_layers.scale(p, scale=self.alpha))
+                tensor_layers.assign(blended, slow)
+                tensor_layers.assign(blended, p)
+        return out
+
+    def backward(self, *a, **kw):
+        return self.inner_optimizer.backward(*a, **kw)
+
+
 class ModelAverage(Optimizer):
     """optimizer.py:2257 — maintain sliding-window parameter averages."""
 

@@ -99,3 +99,21 @@ def op_profiler():
     finally:
         tr_mod.Tracer.trace_op = orig
         _op_timer = None
+
+
+def reset_profiler():
+    """Reference profiler.py reset_profiler: clear collected per-op stats."""
+    global _op_timer
+    if _op_timer is not None:
+        _op_timer.times.clear()
+        _op_timer.counts.clear()
+
+
+from contextlib import contextmanager as _contextmanager
+
+
+@_contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Reference profiler.py cuda_profiler (nvprof hooks): no CUDA in the
+    TPU build — use `profiler()`/jax.profiler traces instead. No-op shim."""
+    yield
