@@ -1,2 +1,32 @@
-"""contrib — mixed precision + extensions (reference python/paddle/fluid/contrib/)."""
+"""contrib — mixed precision, slim, layers, decoder, trainer, utils
+(reference python/paddle/fluid/contrib/)."""
 from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401
+from . import layers  # noqa: F401
+from .decoder import (  # noqa: F401
+    BeamSearchDecoder, InitState, StateCell, TrainingDecoder)
+from .extras import (  # noqa: F401
+    HDFSClient,
+    convert_dist_to_sparse_program,
+    ctr_metric_bundle,
+    distributed_batch_reader,
+    extend_with_decoupled_weight_decay,
+    fused_elemwise_activation,
+    load_persistables_for_increment,
+    load_persistables_for_inference,
+    memory_usage,
+    multi_download,
+    multi_upload,
+    op_freq_statistic,
+)
+from .layers import BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm  # noqa: F401
+from .slim.quantization import QuantizeTranspiler  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Inferencer,
+    Trainer,
+)

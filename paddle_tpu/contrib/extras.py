@@ -1,0 +1,237 @@
+"""contrib odds and ends — utils, memory estimation, optimizer extensions.
+
+Reference analogs: contrib/utils/hdfs_utils.py (HDFSClient, multi_download,
+multi_upload — `hadoop fs` subprocess wrappers), memory_usage_calc.py
+(memory_usage), op_frequence.py (op_freq_statistic),
+extend_optimizer/extend_optimizer_with_weight_decay.py
+(extend_with_decoupled_weight_decay), layers/metric_op ctr bundle
+(ctr_metric_bundle), reader_util distributed_batch_reader,
+quantize/convert_dist_to_sparse_program, utils/lookup_table_utils
+(load_persistables_for_increment / load_persistables_for_inference),
+fused_elemwise_activation (layers wrapper over the fused op).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_DTYPE_BYTES = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+                "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
+                "bool": 1}
+
+
+def memory_usage(program, batch_size: int = 1):
+    """memory_usage_calc.py: rough activation+parameter footprint of a
+    program in MB for a given batch size (leading -1 dims ← batch_size)."""
+    total = 0
+    for var in program.list_vars():
+        shape = getattr(var, "shape", None)
+        if not shape:
+            continue
+        n = 1
+        for d in shape:
+            n *= batch_size if d in (-1, None) else int(d)
+        total += n * _DTYPE_BYTES.get(str(var.dtype), 4)
+    return total / (1 << 20)
+
+
+def op_freq_statistic(program):
+    """op_frequence.py: (uni-op counts, adjacent-op-pair counts)."""
+    uni: Dict[str, int] = {}
+    pair: Dict[str, int] = {}
+    prev = None
+    for op in program.global_block().ops:
+        uni[op.type] = uni.get(op.type, 0) + 1
+        if prev is not None:
+            key = f"{prev},{op.type}"
+            pair[key] = pair.get(key, 0) + 1
+        prev = op.type
+    return uni, pair
+
+
+def extend_with_decoupled_weight_decay(base_optimizer_cls):
+    """extend_optimizer_with_weight_decay.py: wrap an optimizer class with
+    AdamW-style decoupled decay: p -= lr·coeff·p after the inner update."""
+
+    class DecoupledWeightDecay(base_optimizer_cls):
+        def __init__(self, weight_decay, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._decoupled_coeff = float(weight_decay)
+
+        def minimize(self, loss, startup_program=None, parameter_list=None,
+                     no_grad_set=None):
+            out = super().minimize(loss, startup_program, parameter_list,
+                                   no_grad_set)
+            from ..layers import ops as ops_layers
+            from ..layers import tensor as tensor_layers
+            lr = getattr(self, "_learning_rate", None)
+            coeff = self._decoupled_coeff * (lr if isinstance(lr, float)
+                                             else 1.0)
+            for p in loss.block.program.global_block().all_parameters():
+                decayed = ops_layers.scale(p, scale=1.0 - coeff)
+                tensor_layers.assign(decayed, p)
+            return out
+
+    DecoupledWeightDecay.__name__ = \
+        f"Decoupled{base_optimizer_cls.__name__}"
+    return DecoupledWeightDecay
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=False):
+    """layers wrapper over the fused_elemwise_activation op
+    (fused_elemwise_activation_op.cc)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    mid = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(
+        type="fused_elemwise_activation",
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name], "IntermediateOut": [mid.name]},
+        attrs={"functor_list": list(functor_list), "axis": axis,
+               "scale": scale,
+               "save_intermediate_out": save_intermediate_out})
+    return out
+
+
+def ctr_metric_bundle(input, label):
+    """contrib/layers ctr_metric_bundle: (local_sqrerr, local_abserr,
+    local_prob, local_q) accumulator tensors for CTR evaluation."""
+    from ..layers import ops as ops_layers
+    from ..layers.reduce import reduce_sum
+    from ..layers import nn as nn_layers
+    diff = nn_layers.elementwise_sub(input, label)
+    sqrerr = reduce_sum(ops_layers.square(diff))
+    abserr = reduce_sum(ops_layers.abs(diff))
+    prob = reduce_sum(input)
+    q = reduce_sum(label)
+    return sqrerr, abserr, prob, q
+
+
+def distributed_batch_reader(batch_reader):
+    """contrib/reader distributed_batch_reader: each trainer takes its
+    rank-strided slice of the batch stream."""
+    import jax
+
+    def _reader():
+        try:
+            nranks, rank = jax.process_count(), jax.process_index()
+        except Exception:
+            nranks, rank = 1, 0
+        for i, batch in enumerate(batch_reader()):
+            if i % nranks == rank:
+                yield batch
+
+    return _reader
+
+
+def convert_dist_to_sparse_program(program):
+    """quantize/convert_dist_to_sparse_program parity: the pserver-sparse
+    program rewrite is moot under GSPMD sharded embeddings — returns the
+    program unchanged (see transpiler.DistributeTranspiler docstring)."""
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """utils/lookup_table_utils parity: continue-training load — here the
+    plain persistables load covers the embedding too (no pserver shards)."""
+    from .. import io as fluid_io
+    fluid_io.load_persistables(executor, dirname, main_program=program)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    from .. import io as fluid_io
+    fluid_io.load_persistables(executor, dirname, main_program=program)
+
+
+class HDFSClient:
+    """hdfs_utils.py HDFSClient: thin `hadoop fs` subprocess wrapper (the
+    reference shells out exactly the same way)."""
+
+    def __init__(self, hadoop_home: str = None, configs: Optional[dict] = None):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._cfg = []
+        for k, v in (configs or {}).items():
+            self._cfg += ["-D", f"{k}={v}"]
+
+    def _run(self, *args):
+        cmd = [self._hadoop, "fs"] + self._cfg + list(args)
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        return r.returncode, r.stdout, r.stderr
+
+    def is_exist(self, path):
+        return self._run("-test", "-e", path)[0] == 0
+
+    def is_dir(self, path):
+        return self._run("-test", "-d", path)[0] == 0
+
+    def delete(self, path):
+        return self._run("-rm", "-r", path)[0] == 0
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        args = ["-put"] + (["-f"] if overwrite else []) + \
+            [local_path, hdfs_path]
+        return self._run(*args)[0] == 0
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        return self._run("-get", hdfs_path, local_path)[0] == 0
+
+    def ls(self, path):
+        code, out, _ = self._run("-ls", path)
+        if code != 0:
+            return []
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+
+    def lsr(self, path):
+        code, out, _ = self._run("-ls", "-R", path)
+        if code != 0:
+            return []
+        return [line.split()[-1] for line in out.splitlines() if line]
+
+    def makedirs(self, path):
+        return self._run("-mkdir", "-p", path)[0] == 0
+
+    def rename(self, src, dst):
+        return self._run("-mv", src, dst)[0] == 0
+
+
+def multi_download(client: HDFSClient, hdfs_path: str, local_path: str,
+                   trainer_id: int, trainers: int, multi_processes: int = 5):
+    """hdfs_utils.py multi_download: this trainer downloads its rank-strided
+    share of the files under hdfs_path."""
+    files = client.ls(hdfs_path)
+    mine = [f for i, f in enumerate(sorted(files))
+            if i % trainers == trainer_id]
+    os.makedirs(local_path, exist_ok=True)
+    got = []
+    for f in mine:
+        dst = os.path.join(local_path, os.path.basename(f))
+        if client.download(f, dst):
+            got.append(dst)
+    return got
+
+
+def multi_upload(client: HDFSClient, hdfs_path: str, local_path: str,
+                 multi_processes: int = 5, overwrite: bool = False,
+                 sync: bool = True):
+    """hdfs_utils.py multi_upload: upload every file under local_path."""
+    client.makedirs(hdfs_path)
+    sent = []
+    for root, _, files in os.walk(local_path):
+        for f in files:
+            src = os.path.join(root, f)
+            rel = os.path.relpath(src, local_path)
+            if client.upload(os.path.join(hdfs_path, rel), src,
+                             overwrite=overwrite):
+                sent.append(src)
+    return sent

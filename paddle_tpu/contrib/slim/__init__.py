@@ -3,3 +3,32 @@ quantization QAT + post-training, magnitude pruning, distillation losses).
 NAS (simulated-annealing search over closed-source infra) is a documented
 non-goal; the search-space utilities live in .nas."""
 from . import distillation, nas, prune, quantization  # noqa: F401
+from .distillation import (  # noqa: F401
+    FSPDistiller, L2Distiller, SoftLabelDistiller)
+from .nas import SAController, SearchSpace  # noqa: F401
+from .framework import (  # noqa: F401
+    AutoPruneStrategy,
+    Compressor,
+    ConfigFactory,
+    Context,
+    ControllerServer,
+    EvolutionaryController,
+    GraphWrapper,
+    LightNASNet,
+    LightNASSpace,
+    LightNASStrategy,
+    MKLDNNPostTrainingQuantStrategy,
+    MobileNet,
+    OpWrapper,
+    PruneStrategy,
+    Pruner,
+    QuantizationStrategy,
+    DistillationStrategy,
+    SearchAgent,
+    SensitivePruneStrategy,
+    SlimGraphExecutor,
+    Strategy,
+    StructurePruner,
+    UniformPruneStrategy,
+    VarWrapper,
+)

@@ -46,3 +46,69 @@ def fsp_loss(t_feat_a, t_feat_b, s_feat_a, s_feat_b):
     d = ops_layers.elementwise_sub(fsp_matrix(t_feat_a, t_feat_b),
                                    fsp_matrix(s_feat_a, s_feat_b))
     return reduce_layers.reduce_mean(ops_layers.elementwise_mul(d, d))
+
+
+class _DistillerBase:
+    """distillation/distillers.py: wrap the functional losses in the
+    reference's class API — distiller_loss(graph) appends the loss to the
+    student program and returns the loss Variable."""
+
+    def __init__(self, student_var_name=None, teacher_var_name=None,
+                 student_feature_map=None, teacher_feature_map=None,
+                 student_pairs=None, teacher_pairs=None,
+                 distillation_loss_weight=1.0):
+        self.student = student_var_name or student_feature_map
+        self.teacher = teacher_var_name or teacher_feature_map
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.weight = distillation_loss_weight
+
+    def _vars(self, graph, name):
+        return graph.var(name)._var if hasattr(graph, "var") else name
+
+    def distiller_loss(self, graph):
+        raise NotImplementedError
+
+
+class L2Distiller(_DistillerBase):
+    def distiller_loss(self, graph):
+        s = self._vars(graph, self.student)
+        t = self._vars(graph, self.teacher)
+        loss = l2_distill_loss(t, s)
+        from ...layers import ops as ops_layers
+        return ops_layers.scale(loss, scale=self.weight)
+
+
+class FSPDistiller(_DistillerBase):
+    def distiller_loss(self, graph):
+        losses = []
+        from ...layers import ops as ops_layers
+        for (s1, s2), (t1, t2) in zip(self.student_pairs,
+                                      self.teacher_pairs):
+            losses.append(fsp_loss(self._vars(graph, s1),
+                                   self._vars(graph, s2),
+                                   self._vars(graph, t1),
+                                   self._vars(graph, t2)))
+        total = losses[0]
+        for l in losses[1:]:
+            from ...layers import nn as nn_layers
+            total = nn_layers.elementwise_add(total, l)
+        return ops_layers.scale(total, scale=self.weight)
+
+
+class SoftLabelDistiller(_DistillerBase):
+    def __init__(self, student_var_name=None, teacher_var_name=None,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        super().__init__(student_var_name, teacher_var_name,
+                         distillation_loss_weight=distillation_loss_weight)
+        self.st = student_temperature
+        self.tt = teacher_temperature
+
+    def distiller_loss(self, graph):
+        s = self._vars(graph, self.student)
+        t = self._vars(graph, self.teacher)
+        from ...layers import ops as ops_layers
+        # signature is (teacher_logits, student_logits, T_teacher, T_student)
+        loss = soft_label_distill_loss(t, s, self.tt, self.st)
+        return ops_layers.scale(loss, scale=self.weight)

@@ -1,0 +1,618 @@
+"""slim framework surface — graph wrappers, compressor, strategies.
+
+Reference analogs: contrib/slim/graph/graph_wrapper.py (GraphWrapper,
+OpWrapper, VarWrapper), graph/executor.py (SlimGraphExecutor),
+core/compressor.py (Compressor, Context), core/config.py (ConfigFactory),
+core/strategy.py (Strategy) and the per-family strategies:
+prune/prune_strategy.py (PruneStrategy, UniformPruneStrategy,
+SensitivePruneStrategy, AutoPruneStrategy), prune/pruner.py (Pruner,
+StructurePruner), quantization/quantization_strategy.py
+(QuantizationStrategy), quantization/mkldnn_post_training_strategy.py,
+distillation/distillation_strategy.py (DistillationStrategy),
+nas/light_nas_strategy.py + search_agent.py + controller_server.py +
+nas/lightnasnet (LightNASStrategy, LightNASSpace, LightNASNet,
+SearchAgent, ControllerServer), core/search_space controllers
+(EvolutionaryController), nas mobilenet baseline (MobileNet).
+
+TPU stance: the graph the wrappers expose is this framework's Program
+(vars/ops), the executor is the jitted Executor, and the strategies apply
+the functional passes that already exist in this tree
+(quantization_pass.py, prune.py magnitude_prune, distillation losses).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core.executor import Executor
+from ...core.program import Program
+from ...core.scope import Scope
+
+
+class VarWrapper:
+    def __init__(self, var, graph):
+        self._var = var
+        self._graph = graph
+
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return self._var.shape
+
+    def set_shape(self, shape):
+        self._var.shape = tuple(shape)
+
+    def inputs(self):
+        return [OpWrapper(op, self._graph)
+                for op in self._graph.program.global_block().ops
+                if any(self._var.name in names
+                       for names in op.outputs.values())]
+
+    def outputs(self):
+        return [OpWrapper(op, self._graph)
+                for op in self._graph.program.global_block().ops
+                if any(self._var.name in names
+                       for names in op.inputs.values())]
+
+
+class OpWrapper:
+    def __init__(self, op, graph):
+        self._op = op
+        self._graph = graph
+
+    def type(self):
+        return self._op.type
+
+    def attr(self, name):
+        return self._op.attrs.get(name)
+
+    def set_attr(self, name, value):
+        self._op.attrs[name] = value
+
+    def all_inputs(self):
+        return [self._graph.var(n) for ns in self._op.inputs.values()
+                for n in ns if self._graph.has_var(n)]
+
+    def all_outputs(self):
+        return [self._graph.var(n) for ns in self._op.outputs.values()
+                for n in ns if self._graph.has_var(n)]
+
+
+class GraphWrapper:
+    """graph_wrapper.py GraphWrapper over a Program."""
+
+    def __init__(self, program: Program, in_nodes=None, out_nodes=None):
+        self.program = program
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+
+    def all_parameters(self):
+        return [VarWrapper(p, self)
+                for p in self.program.global_block().all_parameters()]
+
+    def ops(self):
+        return [OpWrapper(op, self)
+                for op in self.program.global_block().ops]
+
+    def vars(self):
+        return [VarWrapper(v, self) for v in self.program.list_vars()]
+
+    def has_var(self, name):
+        return self.program.global_block()._find_var_recursive(name) is not None
+
+    def var(self, name):
+        v = self.program.global_block()._find_var_recursive(name)
+        if v is None:
+            raise KeyError(name)
+        return VarWrapper(v, self)
+
+    def clone(self, for_test=False):
+        return GraphWrapper(self.program.clone(for_test=for_test),
+                            self.in_nodes, self.out_nodes)
+
+    def numel_params(self):
+        total = 0
+        for p in self.all_parameters():
+            n = 1
+            for d in (p.shape() or []):
+                n *= max(int(d), 1)
+            total += n
+        return total
+
+
+class SlimGraphExecutor:
+    """graph/executor.py: run a wrapped graph."""
+
+    def __init__(self, place=None):
+        self.exe = Executor(place)
+
+    def run(self, graph: GraphWrapper, scope: Scope, data=None):
+        feed = data if isinstance(data, dict) else None
+        fetches = list(graph.out_nodes.values())
+        return self.exe.run(graph.program, feed=feed, fetch_list=fetches,
+                            scope=scope)
+
+
+class Context:
+    """core/compressor.py Context: the mutable bag strategies see."""
+
+    def __init__(self, place=None, scope=None, train_graph=None,
+                 eval_graph=None, optimizer=None):
+        self.place = place
+        self.scope = scope
+        self.train_graph = train_graph
+        self.eval_graph = eval_graph
+        self.optimizer = optimizer
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.eval_results: Dict[str, list] = {}
+
+
+class Strategy:
+    """core/strategy.py Strategy base: epoch-scoped callbacks."""
+
+    def __init__(self, start_epoch=0, end_epoch=10):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+
+class QuantizationStrategy(Strategy):
+    """quantization_strategy.py: insert QAT fake-quant ops at start_epoch
+    (uses this tree's QuantizationTransformPass)."""
+
+    def __init__(self, start_epoch=0, end_epoch=10, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max", save_in_nodes=None,
+                 save_out_nodes=None, **kw):
+        super().__init__(start_epoch, end_epoch)
+        self._args = dict(weight_bits=weight_bits,
+                          activation_bits=activation_bits,
+                          activation_quantize_type=activation_quantize_type,
+                          weight_quantize_type=weight_quantize_type)
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            from .quantization import QuantizationTransformPass
+            QuantizationTransformPass(**self._args).apply(
+                context.train_graph.program)
+
+
+class DistillationStrategy(Strategy):
+    """distillation_strategy.py: the distillers attach teacher losses at
+    start_epoch; here the user supplies ready distiller objects."""
+
+    def __init__(self, distillers=None, start_epoch=0, end_epoch=10):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = list(distillers or [])
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            from ...core.program import Program, program_guard
+            with program_guard(context.train_graph.program, Program()):
+                for d in self.distillers:
+                    d.distiller_loss(context.train_graph)
+
+
+class Pruner:
+    """prune/pruner.py Pruner: magnitude pruning of parameter arrays."""
+
+    def __init__(self, ratio=0.5):
+        self.ratio = ratio
+
+    def prune(self, scope: Scope, param_names: List[str],
+              ratio: Optional[float] = None):
+        from .prune import apply_masks, magnitude_prune
+        r = self.ratio if ratio is None else ratio
+        masks = magnitude_prune(scope, param_names, r)
+        apply_masks(scope, masks)
+        return masks
+
+
+class StructurePruner(Pruner):
+    """prune/pruner.py StructurePruner: zero whole output filters/rows by
+    smallest L1 norm."""
+
+    def prune(self, scope: Scope, param_names: List[str],
+              ratio: Optional[float] = None):
+        r = self.ratio if ratio is None else ratio
+        masks = {}
+        for name in param_names:
+            w = np.asarray(scope.find_var(name))
+            flat = w.reshape(w.shape[0], -1)
+            norms = np.abs(flat).sum(axis=1)
+            k = int(round(len(norms) * r))
+            mask = np.ones(len(norms), bool)
+            if k > 0:
+                mask[np.argsort(norms)[:k]] = False
+            w2 = w * mask.reshape((-1,) + (1,) * (w.ndim - 1))
+            scope.set_var(name, w2.astype(w.dtype))
+            masks[name] = mask
+        return masks
+
+
+class PruneStrategy(Strategy):
+    """prune_strategy.py base: prune at start_epoch, keep masks applied at
+    every batch end (so the optimizer can't resurrect pruned weights)."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=10,
+                 target_ratio=0.5, pruned_params=".*", **kw):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or Pruner(target_ratio)
+        self.target_ratio = target_ratio
+        self.pruned_params = pruned_params
+        self._masks = {}
+
+    def _param_names(self, context):
+        import re
+        pat = re.compile(self.pruned_params)
+        return [p.name() for p in context.train_graph.all_parameters()
+                if pat.match(p.name())]
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            self._masks = self.pruner.prune(context.scope,
+                                            self._param_names(context),
+                                            self.target_ratio)
+
+    def on_batch_end(self, context):
+        from .prune import apply_masks
+        if self._masks:
+            apply_masks(context.scope, self._masks)
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """Same ratio for every matched parameter (uniform_prune_strategy)."""
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """sensitive_prune_strategy.py: per-parameter ratios from a sensitivity
+    scan (loss increase per pruned fraction), highest-tolerance params
+    pruned hardest."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=10,
+                 target_ratio=0.5, pruned_params=".*",
+                 sensitivities=None, eval_fn=None, deltas=(0.2, 0.4, 0.6),
+                 **kw):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         pruned_params)
+        self.sensitivities = dict(sensitivities or {})
+        self.eval_fn = eval_fn
+        self.deltas = deltas
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id != self.start_epoch:
+            return
+        names = self._param_names(context)
+        if self.eval_fn is not None and not self.sensitivities:
+            base = float(self.eval_fn())
+            for n in names:
+                w0 = np.asarray(context.scope.find_var(n)).copy()
+                losses = []
+                for d in self.deltas:
+                    Pruner(d).prune(context.scope, [n])
+                    losses.append(float(self.eval_fn()) - base)
+                    context.scope.set_var(n, w0)
+                # sensitivity = mean loss increase per pruned fraction
+                self.sensitivities[n] = max(
+                    1e-8, float(np.mean(losses)) / float(np.mean(self.deltas)))
+        if self.sensitivities:
+            inv = {n: 1.0 / self.sensitivities.get(n, 1.0) for n in names}
+            tot = sum(inv.values())
+            self._masks = {}
+            for n in names:
+                ratio = min(0.95, self.target_ratio * len(names)
+                            * inv[n] / tot)
+                self._masks.update(
+                    self.pruner.prune(context.scope, [n], ratio))
+        else:
+            super().on_epoch_begin(context)
+
+
+class AutoPruneStrategy(PruneStrategy):
+    """auto_prune_strategy.py: simulated-annealing search over per-param
+    ratios (reuses the existing SAController)."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=10,
+                 target_ratio=0.5, pruned_params=".*", eval_fn=None,
+                 search_steps=20, **kw):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         pruned_params)
+        self.eval_fn = eval_fn
+        self.search_steps = search_steps
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id != self.start_epoch:
+            return
+        names = self._param_names(context)
+        if self.eval_fn is None or not names:
+            return super().on_epoch_begin(context)
+        levels = [0.1, 0.3, 0.5, 0.7]
+        ctl = EvolutionaryController([len(levels)] * len(names))
+        snapshot = {n: np.asarray(context.scope.find_var(n)).copy()
+                    for n in names}
+        best, best_reward = None, -np.inf
+        tokens = ctl.next_tokens()
+        for _ in range(self.search_steps):
+            for n, t in zip(names, tokens):
+                Pruner(levels[t]).prune(context.scope, [n])
+            reward = -float(self.eval_fn())
+            if reward > best_reward:
+                best, best_reward = list(tokens), reward
+            for n in names:
+                context.scope.set_var(n, snapshot[n])
+            tokens = ctl.next_tokens(reward, tokens)
+        self._masks = {}
+        for n, t in zip(names, best):
+            self._masks.update(self.pruner.prune(context.scope, [n],
+                                                 levels[t]))
+
+
+class MKLDNNPostTrainingQuantStrategy(Strategy):
+    """mkldnn_post_training_strategy.py: MKL-DNN int8 is x86-only — no
+    MKL-DNN in the TPU build."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "MKL-DNN post-training quantization targets x86 inference; use "
+            "slim.quantization.post_training_quantize on this build")
+
+
+class ConfigFactory:
+    """core/config.py: instantiate strategies from a YAML config."""
+
+    def __init__(self, config_path: str):
+        import yaml
+        with open(config_path) as f:
+            self._conf = yaml.safe_load(f)
+        self.compressor = self._conf.get("compressor", {})
+
+    def instance(self, name):
+        spec = dict(self._conf[name])
+        cls = spec.pop("class")
+        return globals()[cls](**spec)
+
+
+class Compressor:
+    """core/compressor.py: epoch loop driving strategies around a user
+    train step."""
+
+    def __init__(self, place, scope, train_program, train_reader=None,
+                 train_feed_list=None, train_fetch_list=None,
+                 eval_program=None, eval_reader=None, eval_feed_list=None,
+                 eval_fetch_list=None, teacher_programs=(), optimizer=None,
+                 epoch=1, checkpoint_path=None):
+        self.place = place
+        self.scope = scope or Scope()
+        self.graph = GraphWrapper(train_program,
+                                  out_nodes={"loss": (train_fetch_list or
+                                                      [None])[0]})
+        self.eval_graph = (GraphWrapper(eval_program)
+                           if eval_program is not None else None)
+        self.train_reader = train_reader
+        self.train_feed_list = train_feed_list or []
+        self.train_fetch_list = list(train_fetch_list or [])
+        self.epoch = epoch
+        self.strategies: List[Strategy] = []
+        self.optimizer = optimizer
+
+    def config(self, config_path: str):
+        factory = ConfigFactory(config_path)
+        for name in factory.compressor.get("strategies", []):
+            self.strategies.append(factory.instance(name))
+        self.epoch = factory.compressor.get("epoch", self.epoch)
+
+    def add_strategy(self, strategy: Strategy):
+        self.strategies.append(strategy)
+
+    def run(self):
+        from ...core.scope import scope_guard
+        exe = Executor(self.place)
+        ctx = Context(self.place, self.scope, self.graph, self.eval_graph,
+                      self.optimizer)
+        with scope_guard(self.scope):
+            for s in self.strategies:
+                s.on_compression_begin(ctx)
+            for epoch in range(self.epoch):
+                ctx.epoch_id = epoch
+                for s in self.strategies:
+                    s.on_epoch_begin(ctx)
+                if self.train_reader is not None:
+                    for bid, data in enumerate(self.train_reader()):
+                        ctx.batch_id = bid
+                        for s in self.strategies:
+                            s.on_batch_begin(ctx)
+                        feed = data if isinstance(data, dict) else \
+                            dict(zip(self.train_feed_list, data))
+                        exe.run(self.graph.program, feed=feed,
+                                fetch_list=self.train_fetch_list)
+                        for s in self.strategies:
+                            s.on_batch_end(ctx)
+                for s in self.strategies:
+                    s.on_epoch_end(ctx)
+            for s in self.strategies:
+                s.on_compression_end(ctx)
+        return self.graph.program
+
+
+# -- NAS tail ---------------------------------------------------------------
+
+class EvolutionaryController:
+    """core/search_space controller base (reference EvolutionaryController):
+    tournament mutation over token lists."""
+
+    def __init__(self, range_table, population=10, mutation_rate=0.2,
+                 seed=0):
+        self.range_table = list(range_table)
+        self.rng = random.Random(seed)
+        self.mutation_rate = mutation_rate
+        self.population = [[self.rng.randrange(r) for r in self.range_table]
+                           for _ in range(population)]
+        self.rewards = [-math.inf] * population
+
+    def next_tokens(self, reward=None, tokens=None):
+        if reward is not None and tokens is not None:
+            worst = int(np.argmin(self.rewards))
+            self.population[worst] = list(tokens)
+            self.rewards[worst] = reward
+        best = self.population[int(np.argmax(self.rewards))]
+        child = [t if self.rng.random() > self.mutation_rate
+                 else self.rng.randrange(r)
+                 for t, r in zip(best, self.range_table)]
+        return child
+
+
+class SearchAgent:
+    """nas/search_agent.py: client side of the controller loop. In-process
+    here — talks to the controller object directly instead of a socket."""
+
+    def __init__(self, controller=None, server_addr=None, port=None):
+        self.controller = controller
+
+    def next_tokens(self, reward=None, tokens=None):
+        if hasattr(self.controller, "next_tokens"):
+            try:
+                return self.controller.next_tokens(reward, tokens)
+            except TypeError:
+                return self.controller.next_tokens(reward)
+        raise RuntimeError("no controller attached")
+
+    update = next_tokens
+
+
+class ControllerServer:
+    """nas/controller_server.py: hosts a controller for distributed NAS; the
+    in-process build serves the same object directly."""
+
+    def __init__(self, controller=None, address=("", 0), max_client_num=100,
+                 search_steps=100, key=None):
+        self.controller = controller
+        self._addr = address
+
+    def start(self):
+        return self
+
+    def ip(self):
+        return self._addr[0] or "127.0.0.1"
+
+    def port(self):
+        return self._addr[1]
+
+    def close(self):
+        pass
+
+
+class LightNASSpace:
+    """nas/lightnas_space.py SearchSpace instance for LightNASNet: tokens
+    pick per-block expansion/filters."""
+
+    NUM_BLOCKS = 5
+    TOKENS_PER_BLOCK = 2
+    EXPANSIONS = (1, 3, 6)
+    FILTERS = (16, 24, 32, 64)
+
+    def init_tokens(self):
+        return [1, 1] * self.NUM_BLOCKS
+
+    def range_table(self):
+        return [len(self.EXPANSIONS), len(self.FILTERS)] * self.NUM_BLOCKS
+
+    def create_net(self, tokens=None):
+        tokens = tokens or self.init_tokens()
+        cfg = []
+        for b in range(self.NUM_BLOCKS):
+            e = self.EXPANSIONS[tokens[2 * b] % len(self.EXPANSIONS)]
+            f = self.FILTERS[tokens[2 * b + 1] % len(self.FILTERS)]
+            cfg.append((e, f))
+        return LightNASNet(cfg)
+
+
+class LightNASNet:
+    """nas/lightnasnet.py: MobileNetV2-style inverted-residual net built
+    from a (expansion, filters) token config."""
+
+    def __init__(self, block_config=None):
+        self.block_config = block_config or [(6, 24)] * 5
+
+    def net(self, input, class_dim=1000):
+        from ... import layers as L
+        x = L.conv2d(input, 16, 3, stride=2, padding=1, act="relu")
+        for e, f in self.block_config:
+            c_in = x.shape[1]
+            h = L.conv2d(x, c_in * e, 1, act="relu")
+            h = L.conv2d(h, c_in * e, 3, padding=1, groups=c_in * e,
+                         act="relu")
+            h = L.conv2d(h, f, 1)
+            x = h if c_in != f else L.elementwise_add(x, h)
+        pooled = L.pool2d(x, pool_type="avg", global_pooling=True)
+        return L.fc(pooled, class_dim)
+
+
+class LightNASStrategy(Strategy):
+    """nas/light_nas_strategy.py: controller-driven architecture search at
+    compression time. Needs an eval_fn(tokens)→reward; keeps the best."""
+
+    def __init__(self, controller=None, end_epoch=10, target_flops=None,
+                 search_steps=10, eval_fn=None, space=None, **kw):
+        super().__init__(0, end_epoch)
+        self.space = space or LightNASSpace()
+        self.controller = controller or EvolutionaryController(
+            self.space.range_table())
+        self.search_steps = search_steps
+        self.eval_fn = eval_fn
+        self.best_tokens = None
+
+    def on_compression_begin(self, context):
+        if self.eval_fn is None:
+            return
+        tokens = self.space.init_tokens()
+        best_r = -math.inf
+        for _ in range(self.search_steps):
+            r = float(self.eval_fn(tokens))
+            if r > best_r:
+                best_r, self.best_tokens = r, list(tokens)
+            tokens = self.controller.next_tokens(r, tokens)
+
+
+class MobileNet:
+    """nas baseline net (reference slim tests' MobileNet): depthwise-
+    separable conv stack."""
+
+    def net(self, input, class_dim=1000, scale=1.0):
+        from ... import layers as L
+
+        def dw_sep(x, cout, stride):
+            cin = x.shape[1]
+            x = L.conv2d(x, cin, 3, stride=stride, padding=1, groups=cin,
+                         act="relu")
+            return L.conv2d(x, cout, 1, act="relu")
+
+        c = int(32 * scale)
+        x = L.conv2d(input, c, 3, stride=2, padding=1, act="relu")
+        for cout, stride in [(64, 1), (128, 2), (128, 1), (256, 2),
+                             (256, 1), (512, 2)]:
+            x = dw_sep(x, int(cout * scale), stride)
+        pooled = L.pool2d(x, pool_type="avg", global_pooling=True)
+        return L.fc(pooled, class_dim)

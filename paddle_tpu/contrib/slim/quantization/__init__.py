@@ -1,2 +1,11 @@
 from .quantization_pass import (  # noqa: F401
     AddQuantDequantPass, QuantizationTransformPass, post_training_quantize)
+from .freeze_pass import (  # noqa: F401
+    ConvertToInt8Pass,
+    QuantizationFreezePass,
+    QuantizeTranspiler,
+    ScaleForInferencePass,
+    ScaleForTrainingPass,
+    TransformForMkldnnPass,
+    TransformForMobilePass,
+)

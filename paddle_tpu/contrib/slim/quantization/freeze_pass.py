@@ -1,0 +1,231 @@
+"""Quantization freeze / int8-conversion / deployment passes.
+
+Reference analogs: quantization_pass.py QuantizationFreezePass (fold
+trained fake-quant scales into the weights), ConvertToInt8Pass (store int8
+weight tensors + dequant ops), TransformForMobilePass (rename fake ops to
+the paddle-mobile `quantize`/`dequantize` pair), TransformForMkldnnPass
+(x86-only — raises here), ScaleForTrainingPass / ScaleForInferencePass
+(collect per-output moving-average scales and pin them as op attrs),
+contrib/quantize/quantize_transpiler.py QuantizeTranspiler (the legacy
+one-shot wrapper over transform+freeze).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ....core.program import Program
+from ....core.scope import _scope
+from .quantization_pass import (QuantizationTransformPass, QUANTIZABLE_OPS,
+                                _WEIGHT_SLOTS)
+
+_FAKE_QUANT_OPS = {"fake_quantize_abs_max",
+                   "fake_channel_wise_quantize_abs_max",
+                   "fake_quantize_moving_average_abs_max",
+                   "fake_quantize_range_abs_max"}
+
+
+def _weight_scale(w, channel_wise):
+    if channel_wise:
+        flat = np.abs(w.reshape(w.shape[0], -1)).max(axis=1)
+        return np.maximum(flat, 1e-8)
+    return max(float(np.abs(w).max()), 1e-8)
+
+
+class QuantizationFreezePass:
+    """Fold fake-quant into the weights: after QAT, each persistable weight
+    is replaced by its quantization-grid value (round(w·s)/s) and the
+    weight's fake-quant op is removed — inference then needs no weight
+    quant ops, matching the reference freeze semantics. Activation
+    fake-quant ops stay (their scale state is already trained/frozen)."""
+
+    def __init__(self, scope=None, place=None, weight_bits: int = 8,
+                 weight_quantize_type: str = "abs_max"):
+        # weight_quantize_type is recovered per op from the fake-quant op
+        # type itself; kept in the signature for reference-API compat
+        self.scope = scope
+        self.wbits = weight_bits
+        self.frozen_scales = {}
+
+    def apply(self, program: Program) -> Program:
+        scope = self.scope or _scope()
+        block = program.global_block()
+        qmax = (1 << (self.wbits - 1)) - 1
+        keep = []
+        for op in block.ops:
+            if op.type in _FAKE_QUANT_OPS:
+                src = op.inputs["X"][0]
+                v = block._find_var_recursive(src)
+                if v is not None and v.persistable \
+                        and scope.has_var(src):
+                    w = np.asarray(scope.find_var(src))
+                    cw = op.type == "fake_channel_wise_quantize_abs_max"
+                    s = _weight_scale(w, cw)
+                    scale = (np.asarray(s).reshape(-1, *([1] * (w.ndim - 1)))
+                             if cw else s)
+                    wq = np.clip(np.round(w / scale * qmax), -qmax, qmax)
+                    scope.set_var(src, (wq * scale / qmax).astype(w.dtype))
+                    self.frozen_scales[src] = s
+                    # rewire consumers of the op's output back to the now
+                    # pre-quantized weight and drop the op
+                    out = op.outputs["Out"][0]
+                    for other in block.ops:
+                        for slot, names in other.inputs.items():
+                            other.inputs[slot] = [src if n == out else n
+                                                  for n in names]
+                    continue
+            keep.append(op)
+        block.ops[:] = keep
+        program._bump_version()
+        return program
+
+
+class ConvertToInt8Pass:
+    """Store each frozen weight as an int8 tensor plus its scale var — the
+    serving artifact the reference produces; the executor feeds weights
+    through a dequant at load (int8 HBM footprint, bf16/f32 compute)."""
+
+    def __init__(self, scope=None, place=None, weight_bits: int = 8):
+        self.scope = scope
+        self.wbits = weight_bits
+
+    def apply(self, program: Program) -> Program:
+        scope = self.scope or _scope()
+        block = program.global_block()
+        qmax = (1 << (self.wbits - 1)) - 1
+        quantized = {}
+        for op in block.ops:
+            if op.type in QUANTIZABLE_OPS:
+                wslot = _WEIGHT_SLOTS[op.type]
+                for name in op.inputs.get(wslot, []):
+                    v = block._find_var_recursive(name)
+                    if v is None or not v.persistable \
+                            or not scope.has_var(name) \
+                            or name in quantized:
+                        continue
+                    w = np.asarray(scope.find_var(name))
+                    if w.dtype == np.int8:
+                        continue
+                    s = _weight_scale(w, False)
+                    scope.set_var(f"{name}.int8", np.clip(
+                        np.round(w / s * qmax), -qmax, qmax).astype(np.int8))
+                    scope.set_var(f"{name}.scale",
+                                  np.asarray([s], np.float32))
+                    quantized[name] = s
+        program._int8_weights = quantized  # manifest for savers
+        return program
+
+
+class TransformForMobilePass:
+    """Rename fake ops to the paddle-mobile quantize/dequantize pair
+    (reference TransformForMobilePass) — name-level rewrite only."""
+
+    def apply(self, program: Program) -> Program:
+        for op in program.global_block().ops:
+            if op.type in _FAKE_QUANT_OPS:
+                op.attrs["__mobile_op__"] = "quantize"
+            elif op.type.startswith("fake_dequantize"):
+                op.attrs["__mobile_op__"] = "dequantize"
+        return program
+
+
+class TransformForMkldnnPass:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "MKL-DNN int8 transforms target x86 CPUs; no MKL-DNN engine in "
+            "the TPU build (SURVEY non-goal)")
+
+
+class ScaleForTrainingPass:
+    """Attach moving_average_abs_max_scale ops to quantizable outputs so
+    output scales train alongside (reference ScaleForTrainingPass)."""
+
+    def __init__(self, scope=None, place=None, moving_rate: float = 0.9):
+        self.moving_rate = moving_rate
+
+    def apply(self, program: Program) -> Program:
+        from ....core.program import Operator, program_guard
+        from ....layer_helper import LayerHelper
+        from ....initializer import ConstantInitializer
+        block = program.global_block()
+        helper = LayerHelper("out_scale")
+        new_ops = []
+        for op in list(block.ops):
+            new_ops.append(op)
+            if op.type in QUANTIZABLE_OPS:
+                out = op.outputs.get("Out", op.outputs.get("Output", []))
+                if not out:
+                    continue
+                state = helper.create_global_variable(
+                    [1], "float32", name=f"{out[0]}.out_scale",
+                    initializer=ConstantInitializer(0.001))
+                scale_op = Operator(
+                    block, "moving_average_abs_max_scale",
+                    {"X": [out[0]], "InScale": [state.name]},
+                    {"OutScale": [state.name]},
+                    {"moving_rate": self.moving_rate})
+                new_ops.append(scale_op)
+        block.ops[:] = new_ops
+        program._bump_version()
+        return program
+
+
+class ScaleForInferencePass:
+    """Pin the trained output scales as `out_threshold` op attrs and drop
+    the collector ops (reference ScaleForInferencePass)."""
+
+    def __init__(self, scope=None):
+        self.scope = scope
+
+    def apply(self, program: Program) -> Program:
+        scope = self.scope or _scope()
+        block = program.global_block()
+        keep = []
+        scales = {}
+        for op in block.ops:
+            if op.type == "moving_average_abs_max_scale":
+                name = op.inputs["X"][0]
+                st = op.inputs["InScale"][0]
+                if scope.has_var(st):
+                    scales[name] = float(np.asarray(scope.find_var(st))[0])
+                continue
+            keep.append(op)
+        for op in keep:
+            for slot, outs in op.outputs.items():
+                for o in outs:
+                    if o in scales:
+                        op.attrs["out_threshold"] = scales[o]
+        block.ops[:] = keep
+        program._bump_version()
+        return program
+
+
+class QuantizeTranspiler:
+    """contrib/quantize/quantize_transpiler.py: the legacy all-in-one —
+    training_transpile inserts QAT ops; freeze_program folds the scales."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 window_size: int = 10000, moving_rate: float = 0.9):
+        self._transform = QuantizationTransformPass(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            activation_quantize_type=activation_quantize_type,
+            weight_quantize_type=weight_quantize_type,
+            moving_rate=moving_rate)
+        self._wbits = weight_bits
+        self._wtype = weight_quantize_type
+
+    def training_transpile(self, program=None, startup_program=None):
+        from ....core.program import default_main_program
+        return self._transform.apply(program or default_main_program())
+
+    def freeze_program(self, program, place=None, scope=None):
+        return QuantizationFreezePass(
+            scope=scope, weight_bits=self._wbits,
+            weight_quantize_type=self._wtype).apply(program)
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        return ConvertToInt8Pass(scope=scope,
+                                 weight_bits=self._wbits).apply(program)

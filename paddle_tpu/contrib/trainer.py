@@ -1,0 +1,149 @@
+"""contrib high-level Trainer/Inferencer API.
+
+Reference analog: ``python/paddle/fluid/contrib/trainer.py`` /
+``inferencer.py`` (the deprecated-but-exported high-level loop: Trainer
+with Begin/EndEpochEvent + Begin/EndStepEvent callbacks, CheckpointConfig,
+Inferencer). Implemented over this framework's Executor + Checkpointer —
+`run_elastic`-style checkpointing replaces the reference's
+CheckpointConfig directory juggling.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ..core.executor import Executor, CPUPlace
+from ..core.program import Program, program_guard
+from ..core.scope import Scope, scope_guard
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        # reference flag: set True in a handler to fetch metrics this step
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or "/tmp/paddle_tpu_ckpt"
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, epoch_interval)
+        self.step_interval = max(1, step_interval)
+
+
+class Trainer:
+    """trainer.py Trainer: train_func builds (loss, [metrics...]) in a fresh
+    program; `train(reader, num_epochs, event_handler)` drives the loop."""
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 place=None, parallel=False, checkpoint_config=None):
+        self._place = place or CPUPlace()
+        self._ckpt = checkpoint_config
+        self.scope = Scope()
+        self.train_program = Program()
+        self.startup_program = Program()
+        with program_guard(self.train_program, self.startup_program):
+            outs = train_func()
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            self.loss = outs[0]
+            self.metrics = list(outs[1:])
+            optimizer_func().minimize(self.loss)
+        self.exe = Executor(self._place)
+        self._step = 0
+
+    def train(self, num_epochs: int, event_handler=None, reader=None,
+              feed_order=None):
+        event_handler = event_handler or (lambda e: None)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            ck = None
+            if self._ckpt is not None:
+                from ..parallel.checkpoint import Checkpointer
+                ck = Checkpointer(self._ckpt.checkpoint_dir,
+                                  keep=self._ckpt.max_num_checkpoints)
+                ck.restore(program=self.train_program, scope=self.scope)
+            for epoch in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch))
+                for step, data in enumerate(reader()):
+                    ev = BeginStepEvent(epoch, step)
+                    event_handler(ev)
+                    feed = self._to_feed(data, feed_order)
+                    fetches = [self.loss] + self.metrics \
+                        if ev.fetch_metrics else []
+                    res = self.exe.run(self.train_program, feed=feed,
+                                       fetch_list=fetches)
+                    event_handler(EndStepEvent(epoch, step, res))
+                    self._step += 1
+                    if ck is not None and \
+                            self._step % self._ckpt.step_interval == 0:
+                        ck.save(self._step, program=self.train_program,
+                                scope=self.scope)
+                event_handler(EndEpochEvent(epoch))
+            if ck is not None:
+                ck.save(self._step, program=self.train_program,
+                        scope=self.scope, blocking=True)
+
+    def _to_feed(self, data, feed_order):
+        if isinstance(data, dict):
+            return data
+        names = feed_order or [v.name for v in
+                               self.train_program.list_vars()
+                               if getattr(v, "is_data", False)]
+        return dict(zip(names, data))
+
+    def save_params(self, dirname):
+        from .. import io as fluid_io
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(self.exe, dirname,
+                                       main_program=self.train_program)
+
+    def stop(self):
+        pass
+
+
+class Inferencer:
+    """inferencer.py Inferencer: infer_func rebuilds the net; params load
+    from the Trainer.save_params / save_persistables directory."""
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 parallel=False):
+        self._place = place or CPUPlace()
+        self.scope = Scope()
+        self.infer_program = Program()
+        startup = Program()
+        with program_guard(self.infer_program, startup):
+            self._outputs = infer_func()
+        self.exe = Executor(self._place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            from .. import io as fluid_io
+            fluid_io.load_persistables(self.exe, param_path,
+                                       main_program=self.infer_program)
+
+    def infer(self, inputs: dict, return_numpy=True):
+        outs = self._outputs if isinstance(self._outputs, (list, tuple)) \
+            else [self._outputs]
+        with scope_guard(self.scope):
+            return self.exe.run(self.infer_program.clone(for_test=True),
+                                feed=inputs, fetch_list=list(outs),
+                                return_numpy=return_numpy)

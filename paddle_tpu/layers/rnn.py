@@ -24,7 +24,7 @@ def dynamic_lstm(input, size: int, length=None, h_0=None, c_0=None,
                  is_reverse: bool = False, gate_activation: str = "sigmoid",
                  cell_activation: str = "tanh",
                  candidate_activation: str = "tanh", dtype="float32",
-                 name=None):
+                 name=None, return_last=False):
     """input: [B, T, 4*hidden] pre-projected (reference contract: fc of 4*size
     comes before dynamic_lstm — nn.py dynamic_lstm docstring). size = 4*hidden.
     Returns (hidden [B,T,H], cell [B,T,H])."""
@@ -59,13 +59,16 @@ def dynamic_lstm(input, size: int, length=None, h_0=None, c_0=None,
                "gate_activation": gate_activation,
                "cell_activation": cell_activation,
                "candidate_activation": candidate_activation})
+    if return_last:  # length-aware final states from the op itself
+        return hidden, cell, last_h, last_c
     return hidden, cell
 
 
 def dynamic_gru(input, size: int, length=None, h_0=None, param_attr=None,
                 bias_attr=None, is_reverse: bool = False,
                 gate_activation: str = "sigmoid", candidate_activation: str = "tanh",
-                origin_mode: bool = False, dtype="float32", name=None):
+                origin_mode: bool = False, dtype="float32", name=None,
+                return_last=False):
     """input: [B, T, 3*size] pre-projected. Returns hidden [B, T, size]."""
     helper = LayerHelper("gru", name=name)
     weight = helper.create_parameter(param_attr, shape=[size, 3 * size], dtype=dtype)
@@ -87,6 +90,8 @@ def dynamic_gru(input, size: int, length=None, h_0=None, param_attr=None,
         outputs={"Hidden": [hidden.name], "LastH": [last_h.name]},
         attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
                "activation": candidate_activation, "origin_mode": origin_mode})
+    if return_last:
+        return hidden, last_h
     return hidden
 
 

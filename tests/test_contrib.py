@@ -1,0 +1,315 @@
+"""contrib surface (reference python/paddle/fluid/contrib/): name parity +
+functional checks for the rnn stacks, decoder, trainer, slim framework,
+and quantization deployment passes."""
+import ast
+import glob
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import contrib, dygraph
+
+
+def test_contrib_names_exist():
+    ref = "/root/reference/python/paddle/fluid/contrib"
+    if not os.path.isdir(ref):
+        pytest.skip("reference tree not mounted")
+    names = set()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for f in glob.glob(ref + "/**/*.py", recursive=True):
+            try:
+                tree = ast.parse(open(f).read())
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "__all__":
+                            try:
+                                names.update(ast.literal_eval(node.value))
+                            except Exception:
+                                pass
+
+    def have(n):
+        return any(hasattr(t, n) for t in
+                   (contrib, contrib.mixed_precision, contrib.slim,
+                    contrib.slim.quantization, fluid))
+
+    missing = sorted(n for n in names if not have(n))
+    assert not missing, missing
+
+
+def test_basic_lstm_gru_stacks_train():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6, 8])
+        y = fluid.layers.data("y", [1])
+        out, last_h, last_c = contrib.basic_lstm(x, None, None, 16,
+                                                 num_layers=2)
+        g_out, g_last = contrib.basic_gru(x, None, 16, bidirectional=True)
+        feat = fluid.layers.concat(
+            [fluid.layers.reduce_mean(out, dim=1),
+             fluid.layers.reduce_mean(g_out, dim=1)], axis=1)
+        pred = fluid.layers.fc(feat, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    xv = rng.rand(4, 6, 8).astype("float32")
+    yv = rng.rand(4, 1).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ls = [float(exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(12)]
+    assert ls[-1] < ls[0], ls
+    # shapes
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        o, go = exe.run(main, feed={"x": xv, "y": yv},
+                        fetch_list=[out, g_out])
+    assert o.shape == (4, 6, 16)
+    assert go.shape == (4, 6, 32)  # bidirectional concat
+
+
+def test_basic_lstm_init_and_last_state_contract():
+    """init_hidden/init_cell are honored and last states come from the
+    length-aware op outputs with the [layers·dirs, B, H] layout."""
+    rng = np.random.RandomState(7)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [5, 8])
+        h0 = fluid.layers.data("h0", [1, 12], append_batch_size=False)
+        c0 = fluid.layers.data("c0", [1, 12], append_batch_size=False)
+        # feed layout [L*dirs, B, H] with B=2
+        h0r = fluid.layers.reshape(h0, [1, 2, 6])
+        c0r = fluid.layers.reshape(c0, [1, 2, 6])
+        out, lh, lc = contrib.basic_lstm(x, h0r, c0r, 6)
+        out0, lh0, lc0 = contrib.basic_lstm(x, None, None, 6)
+    xv = rng.rand(2, 5, 8).astype("float32")
+    hv = rng.rand(1, 12).astype("float32")
+    cv = rng.rand(1, 12).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        o, l_h, l_c, o0 = exe.run(
+            main, feed={"x": xv, "h0": hv, "c0": cv},
+            fetch_list=[out, lh, lc, out0])
+    assert l_h.shape == (1, 2, 6) and l_c.shape == (1, 2, 6)
+    # nonzero init must change the outputs vs the zero-init stack
+    assert not np.allclose(o, o0)
+    # last hidden equals the final output step (full-length sequences)
+    np.testing.assert_allclose(l_h[0], o[:, -1], rtol=1e-5, atol=1e-6)
+
+
+def test_basic_units_dygraph():
+    rng = np.random.RandomState(1)
+    with dygraph.guard():
+        lstm = contrib.BasicLSTMUnit(hidden_size=8)
+        h = dygraph.to_variable(np.zeros((2, 8), "float32"))
+        c = dygraph.to_variable(np.zeros((2, 8), "float32"))
+        x = dygraph.to_variable(rng.rand(2, 8).astype("float32"))
+        nh, nc = lstm(x, h, c)
+        assert nh.shape == (2, 8) and nc.shape == (2, 8)
+        gru = contrib.BasicGRUUnit(hidden_size=8)
+        nh2 = gru(x, h)
+        assert nh2.shape == (2, 8)
+
+
+def test_training_decoder():
+    """TrainingDecoder over a StateCell == manual GRU-ish recurrence."""
+    rng = np.random.RandomState(2)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        emb = fluid.layers.data("emb", [5, 4])
+        boot = fluid.layers.data("boot", [8])
+        cell = contrib.StateCell(inputs={"x": None},
+                                 states={"h": contrib.InitState(init=boot)},
+                                 out_state="h")
+
+        @cell.register_updater
+        def _update(sc):
+            x = sc.get_input("x")
+            h = sc.get_state("h")
+            nh = fluid.layers.fc(fluid.layers.concat([x, h], axis=1), 8,
+                                 act="tanh",
+                                 param_attr=fluid.ParamAttr(name="dec_w"),
+                                 bias_attr=fluid.ParamAttr(name="dec_b"))
+            sc.set_state("h", nh)
+
+        dec = contrib.TrainingDecoder(cell)
+        with dec.block():
+            w = dec.step_input(emb)
+            cell.compute_state(inputs={"x": w})
+            dec.output(cell.get_state("h"))
+        out = dec()
+    ev = rng.rand(3, 5, 4).astype("float32")
+    bv = rng.rand(3, 8).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        o, w_, b_ = exe.run(main, feed={"emb": ev, "boot": bv},
+                            fetch_list=[out, "dec_w", "dec_b"])
+    assert o.shape == (3, 5, 8)
+    h = bv
+    for t in range(5):
+        h = np.tanh(np.concatenate([ev[:, t], h], 1) @ w_ + b_)
+        np.testing.assert_allclose(o[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_inferencer_roundtrip(tmp_path):
+    rng = np.random.RandomState(3)
+    w_true = rng.rand(4, 1).astype("float32")
+
+    def train_func():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="tw"))
+        return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+    def reader():
+        for _ in range(8):
+            xv = rng.rand(16, 4).astype("float32")
+            yield {"x": xv, "y": xv @ w_true}
+
+    seen = []
+    trainer = contrib.Trainer(train_func,
+                              lambda: fluid.optimizer.SGD(0.2))
+    trainer.train(num_epochs=4,
+                  event_handler=lambda e: seen.append(type(e).__name__),
+                  reader=reader)
+    assert "BeginEpochEvent" in seen and "EndStepEvent" in seen
+    d = str(tmp_path / "params")
+    trainer.save_params(d)
+
+    def infer_func():
+        x = fluid.layers.data("x", [4])
+        return fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="tw"))
+
+    inf = contrib.Inferencer(infer_func, d)
+    xv = rng.rand(8, 4).astype("float32")
+    (pred,) = inf.infer({"x": xv})
+    np.testing.assert_allclose(pred, xv @ w_true, atol=0.3)
+
+
+def _quantizable_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        h = fluid.layers.fc(x, 8, act="relu",
+                            param_attr=fluid.ParamAttr(name="qw"))
+        out = fluid.layers.fc(h, 4)
+    return main, startup, x, out
+
+
+def test_quantization_freeze_and_int8(tmp_path):
+    from paddle_tpu.contrib.slim.quantization import (
+        ConvertToInt8Pass, QuantizationFreezePass, QuantizeTranspiler)
+
+    rng = np.random.RandomState(4)
+    main, startup, x, out = _quantizable_program()
+    t = QuantizeTranspiler(activation_quantize_type="abs_max")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        t.training_transpile(main)
+        assert any(op.type.startswith("fake_quantize")
+                   for op in main.global_block().ops)
+        feed = {"x": rng.rand(4, 8).astype("float32")}
+        exe.run(main, feed=feed, fetch_list=[out])   # QAT program runs
+
+        scope = fluid.global_scope()
+        w_before = np.asarray(scope.find_var("qw")).copy()
+        t.freeze_program(main, scope=scope)
+        # weight fake-quant removed; weights snapped to the 8-bit grid
+        wts = [op for op in main.global_block().ops
+               if op.type.startswith("fake_quantize")
+               and op.inputs["X"][0] == "qw"]
+        assert not wts
+        w_after = np.asarray(scope.find_var("qw"))
+        scale = np.abs(w_before).max() / 127.0
+        np.testing.assert_allclose(w_after / scale,
+                                   np.round(w_after / scale), atol=1e-4)
+        (o1,) = exe.run(main, feed=feed, fetch_list=[out])
+        assert np.isfinite(o1).all()
+
+        t.convert_to_int8(main, scope=scope)
+        w8 = np.asarray(scope.find_var("qw.int8"))
+        assert w8.dtype == np.int8
+
+
+def test_slim_framework_prune_and_compressor():
+    from paddle_tpu.contrib.slim import (Compressor, GraphWrapper,
+                                         PruneStrategy, Pruner,
+                                         StructurePruner)
+
+    rng = np.random.RandomState(5)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="pw"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    g = GraphWrapper(main)
+    assert any(p.name() == "pw" for p in g.all_parameters())
+    assert g.numel_params() >= 8
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        def reader():
+            for _ in range(4):
+                xv = rng.rand(8, 8).astype("float32")
+                yield {"x": xv, "y": xv.sum(1, keepdims=True)}
+
+        comp = Compressor(fluid.CPUPlace(), scope, main,
+                          train_reader=reader, train_fetch_list=[loss],
+                          epoch=2)
+        comp.add_strategy(PruneStrategy(Pruner(0.5), start_epoch=0,
+                                        target_ratio=0.5,
+                                        pruned_params="pw"))
+        comp.run()
+        w = np.asarray(scope.find_var("pw"))
+        assert (w == 0).mean() >= 0.45  # half the weights stay pruned
+
+    # structure pruner zeroes whole rows
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        StructurePruner(0.5).prune(scope2, ["pw"])
+        w = np.asarray(scope2.find_var("pw"))
+        zero_rows = (np.abs(w).sum(1) == 0).sum()
+        assert zero_rows == w.shape[0] // 2
+
+
+def test_contrib_extras():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        h = fluid.layers.fc(x, 8)
+        fluid.layers.fc(h, 2)
+    mb = contrib.memory_usage(main, batch_size=32)
+    assert mb > 0
+    uni, pair = contrib.op_freq_statistic(main)
+    assert uni.get("mul", 0) == 2 and sum(pair.values()) >= 1
+
+    # decoupled weight decay factory
+    AdamWLike = contrib.extend_with_decoupled_weight_decay(
+        fluid.optimizer.SGD)
+    assert AdamWLike.__name__ == "DecoupledSGDOptimizer"
+
+    # distributed_batch_reader strides batches
+    r = contrib.distributed_batch_reader(lambda: iter(range(6)))
+    assert list(r()) == [0, 1, 2, 3, 4, 5]  # single process: all batches
