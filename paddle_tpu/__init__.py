@@ -65,6 +65,32 @@ from .core import (  # noqa: F401
 )
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .core import unique_name  # noqa: F401
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import data_feed_desc  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from . import distribute_lookup_table  # noqa: F401
+from . import dygraph_grad_clip  # noqa: F401
+from . import incubate  # noqa: F401
+from . import inferencer  # noqa: F401
+from . import install_check  # noqa: F401
+from . import compiler  # noqa: F401
+from . import parallel_executor  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from . import trainer_desc  # noqa: F401
+from .core import executor  # noqa: F401
+from .core import program as framework  # noqa: F401
+from .average import WeightedAverage  # noqa: F401
+from .evaluator import Evaluator  # noqa: F401
+from . import net_drawer  # noqa: F401
+
+# register the aliased modules so `from paddle_tpu.framework import ...`
+# (the reference's common import form) resolves, not just attribute access
+import sys as _sys
+
+_sys.modules[__name__ + ".framework"] = framework
+_sys.modules[__name__ + ".executor"] = executor
+del _sys
 from . import data_generator  # noqa: F401
 from . import transpiler  # noqa: F401
 from .core.lod import (  # noqa: F401

@@ -1,0 +1,49 @@
+"""fluid.dygraph_grad_clip (reference dygraph_grad_clip.py — the dygraph
+clip classes; same math as paddle_tpu.clip, applied to VarBase grads)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["GradClipByValue", "GradClipByNorm", "GradClipByGlobalNorm"]
+
+
+class _DygraphClipBase:
+    def __call__(self, params_grads):
+        return [(p, self._clip(g)) for p, g in params_grads]
+
+
+class GradClipByValue(_DygraphClipBase):
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            min_value, max_value = -abs(min_value), abs(min_value)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _clip(self, g):
+        import jax.numpy as jnp
+        from .dygraph.varbase import VarBase
+        return VarBase(jnp.clip(g.value, self.min_value, self.max_value))
+
+
+class GradClipByNorm(_DygraphClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip(self, g):
+        import jax.numpy as jnp
+        from .dygraph.varbase import VarBase
+        norm = jnp.sqrt(jnp.sum(g.value ** 2))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return VarBase(g.value * scale)
+
+
+class GradClipByGlobalNorm:
+    def __init__(self, max_global_norm):
+        self.max_global_norm = max_global_norm
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+        from .dygraph.varbase import VarBase
+        gn = jnp.sqrt(sum(jnp.sum(g.value ** 2) for _, g in params_grads))
+        scale = jnp.minimum(
+            self.max_global_norm / jnp.maximum(gn, 1e-12), 1.0)
+        return [(p, VarBase(g.value * scale)) for p, g in params_grads]

@@ -1,0 +1,3 @@
+"""incubate/fleet/base/role_maker.py parity (role_maker.py:30)."""
+from ....parallel.fleet import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker)

@@ -1,0 +1,8 @@
+"""incubate/fleet/collective parity (collective/__init__.py:41): the
+collective-mode fleet singleton + optimizer wrapper. GSPMD inserts the
+gradient collectives, so CollectiveOptimizer is DistributedOptimizer."""
+from ....parallel.fleet import (  # noqa: F401
+    DistributedOptimizer, Fleet, fleet)
+from ....parallel.mesh import DistributedStrategy  # noqa: F401
+
+CollectiveOptimizer = DistributedOptimizer

@@ -1,0 +1,18 @@
+"""incubate/fleet/parameter_server parity: the pserver fleet mode is a
+declared non-goal (SURVEY §2.2) — importing works, using it points at the
+GSPMD path."""
+
+
+def _unsupported(*a, **kw):
+    raise NotImplementedError(
+        "parameter-server fleet mode is a non-goal of the TPU build; use "
+        "incubate.fleet.collective (GSPMD data parallel) and shard large "
+        "embeddings over the tp axis (parallel/tensor_parallel.py)")
+
+
+class DistributedTranspiler:
+    def __new__(cls, *a, **kw):
+        _unsupported()
+
+
+fleet = None  # set on demand by _unsupported paths in reference scripts

@@ -1,0 +1,5 @@
+"""fluid.inferencer (reference inferencer.py — re-exports the contrib
+Inferencer, same as the reference's deprecation shim)."""
+from .contrib.trainer import Inferencer  # noqa: F401
+
+__all__ = ["Inferencer"]

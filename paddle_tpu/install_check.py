@@ -1,0 +1,27 @@
+"""fluid.install_check (reference install_check.py run_check)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    """Build and run a tiny fc regression end to end, print success — the
+    reference's post-install smoke."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("install_check_x", [2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        exe.run(main,
+                feed={"install_check_x": np.ones((2, 2), "float32")},
+                fetch_list=[loss])
+    print("Your paddle_tpu works well on SINGLE device.")
+    print("install check success!")

@@ -1,0 +1,40 @@
+"""fluid.parallel_executor (reference parallel_executor.py ParallelExecutor).
+
+Compat wrapper: the C++ ParallelExecutor's role (clone graph per device +
+NCCL all-reduce, parallel_executor.cc:356) is played by
+`CompiledProgram.with_data_parallel` over GSPMD. This class keeps the
+constructor/run surface for scripts that used ParallelExecutor directly.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .core.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .core.executor import Executor, TPUPlace
+from .core.program import default_main_program
+
+__all__ = ["ParallelExecutor", "BuildStrategy", "ExecutionStrategy"]
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy,
+            share_vars_from=getattr(share_vars_from, "_compiled", None))
+        self._exe = Executor(TPUPlace())
+        self._scope = scope
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=list(fetch_list),
+                             scope=self._scope, return_numpy=return_numpy)
+
+    @property
+    def device_count(self):
+        import jax
+        return jax.local_device_count()

@@ -1,0 +1,51 @@
+"""fluid.trainer_desc (reference trainer_desc.py over trainer_desc.proto).
+
+Config-object parity for the Dataset/trainer runtime: the reference builds
+a protobuf TrainerDesc naming a trainer class + device worker; here
+`Executor.train_from_dataset` drives the loop and these classes carry the
+same knobs (SURVEY §1 row 8).
+"""
+from __future__ import annotations
+
+__all__ = ["TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+           "PipelineTrainer"]
+
+
+class TrainerDesc:
+    def __init__(self):
+        self._fetch_vars = []
+        self._fetch_info = []
+        self._print_period = 100
+        self._thread_num = 1
+        self._device_worker = None
+        self._program = None
+
+    def set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        self._fetch_vars = list(fetch_vars)
+        self._fetch_info = list(fetch_info)
+        self._print_period = print_period
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_device_worker(self, device_worker):
+        self._device_worker = device_worker
+
+    def set_program(self, program):
+        self._program = program
+
+    def _desc(self):
+        return self.__class__.__name__
+
+
+class MultiTrainer(TrainerDesc):
+    """trainer.h:63 MultiTrainer — N loader threads, one device loop."""
+
+
+class DistMultiTrainer(TrainerDesc):
+    """trainer.h:82 DistMultiTrainer — multi-trainer with fleet hooks."""
+
+
+class PipelineTrainer(TrainerDesc):
+    """trainer.h:110 PipelineTrainer — superseded by PipelineOptimizer's
+    compiled GPipe schedule (optimizer.py PipelineOptimizer)."""
