@@ -32,6 +32,10 @@ class DataFeedDesc:
 
     def _set_slot_flag(self, names, flag):
         for n in names:
+            if n not in self.slots:
+                raise ValueError(
+                    f"slot {n!r} not found in the data feed proto "
+                    f"(slots: {self.slots})")
             self._text = re.sub(
                 r'(slots\s*\{[^}]*?name\s*:\s*"' + re.escape(n)
                 + r'"[^}]*?' + flag + r'\s*:\s*)\w+',

@@ -8,3 +8,5 @@ network egress the canned readers fall back to deterministic synthetic data
 with the real shapes/vocab sizes."""
 from . import cifar, common, imdb, mnist, movielens, uci_housing, wmt16  # noqa: F401
 from .factory import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
+from .more import (  # noqa: F401
+    conll05, flowers, image, imikolov, mq2007, sentiment, voc2012, wmt14)

@@ -15,4 +15,12 @@ class DistributedTranspiler:
         _unsupported()
 
 
-fleet = None  # set on demand by _unsupported paths in reference scripts
+class _UnsupportedFleet:
+    """Every attribute access delivers the migration pointer instead of a
+    bare AttributeError."""
+
+    def __getattr__(self, name):
+        _unsupported()
+
+
+fleet = _UnsupportedFleet()

@@ -312,3 +312,104 @@ def bucket_by_sequence_length(reader, bucket_boundaries, batch_sizes,
                 yield _pad_batch(bucket, bounds[bi])
 
     return bucketed
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """reader/decorator.py multiprocess_reader: run each reader in its own
+    process, interleave results through a queue (order not preserved).
+
+    Workers ALWAYS enqueue a terminal sentinel — `None` on success, an
+    error marker on failure — so the consumer can't hang on a dead worker;
+    processes use the spawn context (fork would deadlock under the
+    JAX-threaded parent, Python 3.12 warns about exactly this)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+
+    def reader():
+        q = ctx.Queue(queue_size)
+        procs = [ctx.Process(target=_mp_reader_worker, args=(r, q),
+                             daemon=True) for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                # the timeout bounds the hang if a worker is SIGKILLed
+                # before it can enqueue its sentinel
+                sample = q.get(timeout=600)
+                if sample is None:
+                    finished += 1
+                elif isinstance(sample, _MpReaderError):
+                    raise RuntimeError(
+                        f"multiprocess_reader worker failed: {sample.msg}")
+                else:
+                    yield sample
+        finally:
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+    return reader
+
+
+class _MpReaderError:
+    def __init__(self, msg):
+        self.msg = msg
+
+
+def _mp_reader_worker(r, q):
+    try:
+        for sample in r():
+            q.put(sample)
+        q.put(None)
+    except BaseException as e:  # sentinel must reach the consumer
+        q.put(_MpReaderError(repr(e)))
+
+
+class Fake:
+    """reader/decorator.py Fake: replay the first epoch's samples forever —
+    the reference's data-independent throughput-testing reader."""
+
+    def __init__(self):
+        self.data = None
+
+    def __call__(self, reader, length):
+        def fake_reader():
+            if self.data is None:
+                self.data = list(reader())
+            total = 0
+            while total < length:
+                for sample in self.data:
+                    if total >= length:
+                        break
+                    total += 1
+                    yield sample
+
+        return fake_reader
+
+
+class _CreatorModule:
+    """paddle.reader.creator (reader/creator.py): readers from raw
+    sources."""
+
+    @staticmethod
+    def np_array(x):
+        def reader():
+            for row in x:
+                yield row
+
+        return reader
+
+    @staticmethod
+    def text_file(path):
+        def reader():
+            with open(path) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+        return reader
+
+
+creator = _CreatorModule()

@@ -168,3 +168,48 @@ def test_train_from_dataset_multithread_loader(tmp_path):
             last = exe.train_from_dataset(main, ds, thread=2,
                                           fetch_list=[loss])
         assert float(last[0]) < float(first[0]) * 0.5
+
+
+def test_remaining_dataset_modules_and_decorators():
+    """The full python/paddle/dataset module surface (conll05, imikolov,
+    wmt14, sentiment, mq2007, flowers, voc2012, image utils) + the last
+    reader decorators (multiprocess_reader, Fake, creator)."""
+    s = next(dataset.conll05.test()())
+    assert len(s) == 9 and len(s[0]) == len(s[-1])  # word + label aligned
+    w, p, l = dataset.conll05.get_dict()
+    assert len(l) == 19
+
+    gram = next(dataset.imikolov.train()())
+    assert len(gram) == 5
+
+    src, trg, nxt = next(dataset.wmt14.train()())
+    assert trg[0] == 0 and nxt[-1] == 1 and len(trg) == len(nxt)
+
+    ids, lab = next(dataset.sentiment.train()())
+    assert lab in (0, 1) and len(ids) >= 8
+
+    pw = next(dataset.mq2007.train()())
+    assert len(pw) == 3 and pw[1].shape == (46,)
+
+    img, label = next(dataset.flowers.train()())
+    assert img.shape == (3 * 32 * 32,) and 0 <= label < 102
+
+    im, seg = next(dataset.voc2012.train()())
+    assert im.shape == (3, 32, 32) and seg.shape == (32, 32)
+
+    # reference contract: HWC in (cv2 layout) → CHW float32 out
+    x = np.random.RandomState(0).rand(60, 40, 3).astype("float32")
+    out = dataset.image.simple_transform(x, 48, 32, is_train=False,
+                                         mean=[0.5, 0.5, 0.5])
+    assert out.shape == (3, 32, 32)
+
+    # decorators
+    fake = rd.Fake()(lambda: iter([1, 2]), length=5)
+    assert list(fake()) == [1, 2, 1, 2, 1]
+    r = rd.creator.np_array(np.arange(6).reshape(3, 2))
+    assert len(list(r())) == 3
+    mp_r = rd.multiprocess_reader(
+        [rd.creator.np_array(np.arange(4)),
+         rd.creator.np_array(np.arange(4, 8))])
+    got = sorted(int(v) for v in mp_r())
+    assert got == list(range(8))
