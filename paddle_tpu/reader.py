@@ -319,12 +319,16 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     process, interleave results through a queue (order not preserved).
 
     Workers ALWAYS enqueue a terminal sentinel — `None` on success, an
-    error marker on failure — so the consumer can't hang on a dead worker;
-    processes use the spawn context (fork would deadlock under the
-    JAX-threaded parent, Python 3.12 warns about exactly this)."""
+    error marker on failure — and the consumer polls with a bounded
+    timeout while checking worker liveness, so a dead worker can't hang
+    the loop. Fork context (readers are usually closures, which spawn
+    cannot pickle — same tradeoff as the reference); note Python 3.12
+    warns about forking a threaded (JAX) parent, hence the liveness
+    guard."""
     import multiprocessing as mp
+    import queue as _queue
 
-    ctx = mp.get_context("spawn")
+    ctx = mp.get_context("fork")
 
     def reader():
         q = ctx.Queue(queue_size)
@@ -335,9 +339,16 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         finished = 0
         try:
             while finished < len(readers):
-                # the timeout bounds the hang if a worker is SIGKILLed
-                # before it can enqueue its sentinel
-                sample = q.get(timeout=600)
+                try:
+                    sample = q.get(timeout=5)
+                except _queue.Empty:
+                    # a worker died without its sentinel (SIGKILL, fork
+                    # deadlock): fail loudly instead of hanging forever
+                    if all(not p.is_alive() for p in procs) and q.empty():
+                        raise RuntimeError(
+                            "multiprocess_reader: all workers exited "
+                            "without completing")
+                    continue
                 if sample is None:
                     finished += 1
                 elif isinstance(sample, _MpReaderError):
