@@ -17,8 +17,11 @@ WHITE_LIST = {"conv2d", "conv3d", "depthwise_conv2d", "conv2d_transpose",
 # batch_norm is gray (not listed): its kernel keeps x in the native dtype
 # and does the statistics in f32 internally — black-listing it would bounce
 # a bf16 conv trunk through f32 HBM at every layer.
+# layer_norm is gray (not listed): its kernel takes bf16 activations and
+# does the statistics in f32 internally (nn_ops._layer_norm) — black-listing
+# it would bounce the residual stream through f32 HBM at every layer.
 BLACK_LIST = {"cross_entropy", "mean",
-              "reduce_mean", "layer_norm", "softmax", "sum",
+              "reduce_mean", "softmax", "sum",
               "exp", "log", "rsqrt", "sqrt"}
 
 

@@ -256,21 +256,27 @@ def _batch_norm(ctx, inputs, attrs):
 
 @register_op("layer_norm")
 def _layer_norm(ctx, inputs, attrs):
+    """Gray-listed under AMP (like batch_norm): accepts bf16 activations and
+    computes the statistics/normalization in f32 internally, returning the
+    input dtype — black-listing it would bounce every residual-stream
+    activation through f32 HBM twice per layer."""
     (x,) = inputs["X"]
     scale = inputs.get("Scale", [None])[0]
     bias = inputs.get("Bias", [None])[0]
     eps = attrs.get("epsilon", 1e-5)
     bna = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(bna, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + eps)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
     norm_shape = (1,) * bna + x.shape[bna:]
     if scale is not None:
-        y = y * scale.reshape(norm_shape)
+        y = y * scale.astype(jnp.float32).reshape(norm_shape)
     if bias is not None:
-        y = y + bias.reshape(norm_shape)
-    return {"Y": [y], "Mean": [mean.squeeze(axes)], "Variance": [var.squeeze(axes)]}
+        y = y + bias.astype(jnp.float32).reshape(norm_shape)
+    return {"Y": [y.astype(x.dtype)], "Mean": [mean.squeeze(axes)],
+            "Variance": [var.squeeze(axes)]}
 
 
 @register_op("group_norm")
