@@ -19,6 +19,9 @@ from .checkpoint import (  # noqa: F401
     Checkpointer, load_checkpoint, save_checkpoint)
 from .env import get_rank, get_world_size, init_parallel_env  # noqa: F401
 from .mesh import DistributedStrategy, auto_mesh, make_mesh  # noqa: F401
+from .dgc import dgc_allreduce, sparse_allgather_exchange, top_k_sparsify  # noqa: F401
+from .local_sgd import (  # noqa: F401
+    average_params, local_sgd_step, replicate_params)
 from .moe import (  # noqa: F401
     init_moe_params, moe_ffn, moe_ffn_expert_parallel, top_k_gating)
 from .pipeline import GPipe, pipeline_step  # noqa: F401

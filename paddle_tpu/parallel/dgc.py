@@ -1,0 +1,85 @@
+"""Deep Gradient Compression — top-k sparsified gradient exchange.
+
+Reference analog: the DGC stack (``DGCMomentumOptimizer``
+python/paddle/fluid/optimizer.py:799, ``SparseAllReduceOpHandle``
+paddle/fluid/framework/details/sparse_all_reduce_op_handle.cc): keep the
+top k% of each gradient by magnitude, accumulate the rest locally as an
+error-feedback residual, exchange only the sparse entries. (The
+reference's additional momentum-correction of the residual is left to
+the caller's optimizer state.)
+
+TPU stance: on ICI, dense all-reduce usually wins (the framework's
+DGCMomentumOptimizer therefore behaves as Momentum, documented) — but the
+capability matters on DCN-connected multi-slice topologies, so the real
+algorithm is provided here as a functional transform over `shard_map`:
+
+- per device: residual += grad; pick top-k |residual|; zero them out of
+  the residual (the rest carries over — DGC's error feedback);
+- exchange: the sparse (values at fixed positions) contribution summed by
+  a dense `psum` over a masked tensor. XLA has no sparse collective; the
+  masked-dense psum moves the same bytes on wire only when the interconnect
+  compresses zeros, so the win here is the ERROR-FEEDBACK SEMANTICS (train
+  with 99% sparsified exchange) while staying static-shape. A gather-based
+  [k]-value exchange (true bandwidth saving, DCN path) is
+  `sparse_allgather_exchange` below.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collective import shard_map
+
+
+def top_k_sparsify(g, ratio: float) -> Tuple[jax.Array, jax.Array]:
+    """(sparse_grad, new_residual): keep the top `ratio` fraction of |g|,
+    the rest becomes the carried residual. Static shapes (k fixed)."""
+    flat = g.reshape(-1)
+    k = max(1, int(flat.shape[0] * ratio))
+    _, idx = lax.top_k(jnp.abs(flat), k)
+    mask = jnp.zeros_like(flat).at[idx].set(1.0)
+    sparse = (flat * mask).reshape(g.shape)
+    return sparse, g - sparse
+
+
+def dgc_allreduce(grad, residual, mesh: Mesh, axis: str = "dp",
+                  ratio: float = 0.01):
+    """One DGC exchange: error-feedback accumulate, top-k select, psum.
+
+    Returns (summed_sparse_grad, new_residual) — both per-device arrays
+    ([dp, ...] stacked outside shard_map, unsharded inside).
+    """
+
+    def f(g, r):
+        acc = g + r
+        sparse, new_r = top_k_sparsify(acc, ratio)
+        return lax.psum(sparse, axis), new_r
+
+    return shard_map(f, mesh, in_specs=(P(axis), P(axis)),
+                     out_specs=(P(), P(axis)))(grad, residual)
+
+
+def sparse_allgather_exchange(grad, residual, mesh: Mesh, axis: str = "dp",
+                              ratio: float = 0.01):
+    """The DCN-shaped variant: exchange only [k] values + [k] indices via
+    all_gather and scatter-add locally — wire bytes are O(k·world), the
+    reference SparseAllReduceOpHandle's encoded form."""
+
+    def f(g, r):
+        acc = (g + r).reshape(-1)
+        k = max(1, int(acc.shape[0] * ratio))
+        vals, idx = lax.top_k(jnp.abs(acc), k)
+        vals = acc[idx]
+        new_r = acc.at[idx].set(0.0).reshape(g.shape)
+        all_vals = lax.all_gather(vals, axis)     # [world, k]
+        all_idx = lax.all_gather(idx, axis)       # [world, k]
+        out = jnp.zeros_like(acc)
+        out = out.at[all_idx.reshape(-1)].add(all_vals.reshape(-1))
+        return out.reshape(g.shape), new_r
+
+    return shard_map(f, mesh, in_specs=(P(axis), P(axis)),
+                     out_specs=(P(), P(axis)))(grad, residual)

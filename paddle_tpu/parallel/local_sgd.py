@@ -1,0 +1,77 @@
+"""LocalSGD — periodically-averaged independent replicas.
+
+Reference analog: ``python/paddle/fluid/transpiler/collective.py:269``
+(LocalSGD transpiler: snapshot params, train without gradient sync,
+all-reduce-average the params every k steps).
+
+TPU-native redesign: GSPMD data parallelism keeps ONE logical replica
+(grads all-reduce implicitly), so LocalSGD's "divergent replicas" need the
+replica dimension to be explicit: parameters carry a leading [dp] axis and
+the whole train step runs under `shard_map` over the dp mesh axis — each
+device updates its own replica with NO cross-device traffic; every
+`k_steps` a `lax.pmean` averages the replicas (the only collective). This
+is the same trade the reference makes (comm every k steps instead of every
+step), expressed as sharding instead of graph rewriting.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collective import all_reduce, shard_map
+
+
+def replicate_params(params, n_replicas: int):
+    """Stack each param into [n_replicas, ...] (every replica starts
+    identical — the reference's init broadcast)."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.broadcast_to(p[None], (n_replicas,) + p.shape), params)
+
+
+def average_params(params, mesh: Mesh, axis: str = "dp"):
+    """The k-step synchronization: mean over the replica axis."""
+    return jax.tree_util.tree_map(
+        lambda p: all_reduce(p, mesh, axis, op="mean"), params)
+
+
+def local_sgd_step(grad_fn: Callable, mesh: Mesh, axis: str = "dp",
+                   k_steps: int = 4, lr: float = 0.1):
+    """Build a LocalSGD step.
+
+    grad_fn(params, batch) -> (loss, grads) for ONE replica's [...] params
+    and its [local_batch, ...] shard. Returns step(params, batch, i) over
+    stacked [dp, ...] params and [global_batch, ...] data; `i` must be a
+    python int — the sync decision is made at TRACE time, so two programs
+    are compiled and the local-steps program contains NO parameter
+    collective at all (only the scalar loss pmean). That is the point of
+    LocalSGD: wire traffic every k-th step only.
+    """
+
+    def per_replica(do_sync, params, batch):
+        loss, grads = grad_fn(params, batch)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        if do_sync:
+            new_params = jax.tree_util.tree_map(
+                lambda p: lax.pmean(p, axis), new_params)
+        return new_params, lax.pmean(loss, axis)
+
+    def _mapped(do_sync):
+        return jax.jit(shard_map(
+            partial(per_replica, do_sync), mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(axis), P())))
+
+    step_local, step_sync = _mapped(False), _mapped(True)
+
+    def step(params, batch, i):
+        if (int(i) + 1) % k_steps == 0:
+            return step_sync(params, batch)
+        return step_local(params, batch)
+
+    return step

@@ -1,0 +1,119 @@
+"""LocalSGD + DGC (reference transpiler/collective.py:269 LocalSGD,
+optimizer.py:799 DGCMomentumOptimizer + sparse_all_reduce_op_handle.cc):
+the TPU-native functional forms over shard_map replicas."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from paddle_tpu.parallel import (average_params, dgc_allreduce,
+                                 local_sgd_step, replicate_params,
+                                 sparse_allgather_exchange, top_k_sparsify)
+
+
+def _mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def test_local_sgd_diverges_then_syncs():
+    n = 4
+    mesh = _mesh(n)
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.rand(8, 1).astype("float32"))
+    params = replicate_params({"w": w}, n)
+    w_true = rng.rand(8, 1).astype("float32")
+    x = jnp.asarray(rng.rand(n * 8, 8).astype("float32"))
+    y = x @ w_true
+
+    def grad_fn(p, batch):
+        bx, by = batch
+        def loss(p):
+            return jnp.mean((bx @ p["w"] - by) ** 2)
+        l, g = jax.value_and_grad(loss)(p)
+        return l, g
+
+    step = local_sgd_step(grad_fn, mesh, k_steps=3, lr=0.2)
+    losses = []
+    for i in range(9):
+        params, loss = step(params, (x, y), i)
+        losses.append(float(loss))
+        ws = np.asarray(params["w"])
+        spread = np.abs(ws - ws.mean(0, keepdims=True)).max()
+        if (i + 1) % 3 == 0:
+            assert spread < 1e-6, f"step {i}: replicas should be synced"
+        else:
+            assert spread > 1e-8, f"step {i}: replicas should diverge"
+    assert losses[-1] < losses[0] * 0.5
+
+    # explicit average matches pmean
+    avg = average_params(params, mesh)
+    ws = np.asarray(avg["w"])
+    assert np.abs(ws - ws.mean(0, keepdims=True)).max() < 1e-6
+
+
+def test_top_k_sparsify_error_feedback():
+    g = jnp.asarray([1.0, -5.0, 0.1, 3.0])
+    sparse, resid = top_k_sparsify(g, ratio=0.5)
+    np.testing.assert_allclose(sparse, [0.0, -5.0, 0.0, 3.0])
+    np.testing.assert_allclose(resid, [1.0, 0.0, 0.1, 0.0])
+    np.testing.assert_allclose(sparse + resid, g)  # nothing lost
+
+
+def test_dgc_allreduce_matches_dense_sum_of_topk():
+    n = 4
+    mesh = _mesh(n)
+    rng = np.random.RandomState(1)
+    g = jnp.asarray(rng.randn(n, 64).astype("float32"))
+    r = jnp.zeros_like(g)
+    summed, new_r = dgc_allreduce(g, r, mesh, ratio=0.25)
+    # manual reference
+    exp_sum = np.zeros(64, "float32")
+    for d in range(n):
+        s, _ = top_k_sparsify(g[d], 0.25)
+        exp_sum += np.asarray(s)
+    got = np.asarray(summed)
+    assert got.shape == (1, 64)  # the replicated sum (out_specs=P())
+    np.testing.assert_allclose(got[0], exp_sum, rtol=1e-5, atol=1e-6)
+    # residual carries exactly the dropped mass
+    np.testing.assert_allclose(np.asarray(new_r) + np.vstack(
+        [np.asarray(top_k_sparsify(g[d], 0.25)[0]) for d in range(n)]),
+        np.asarray(g), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_allgather_exchange_equals_masked_psum():
+    n = 4
+    mesh = _mesh(n)
+    rng = np.random.RandomState(2)
+    g = jnp.asarray(rng.randn(n, 32).astype("float32"))
+    r = jnp.zeros_like(g)
+    dense_sum, _ = dgc_allreduce(g, r, mesh, ratio=0.25)
+    sparse_sum, _ = sparse_allgather_exchange(g, r, mesh, ratio=0.25)
+    np.testing.assert_allclose(np.asarray(sparse_sum)[0],
+                               np.asarray(dense_sum)[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dgc_training_converges_with_95pct_sparsity():
+    """Linear regression trained on DGC-exchanged grads at ratio=0.05 still
+    converges thanks to error feedback."""
+    n = 4
+    mesh = _mesh(n)
+    rng = np.random.RandomState(3)
+    w_true = rng.rand(32, 1).astype("float32")
+    x = rng.rand(n * 16, 32).astype("float32")
+    y = x @ w_true
+    xs = jnp.asarray(x.reshape(n, 16, 32))
+    ys = jnp.asarray(y.reshape(n, 16, 1))
+    w = jnp.zeros((32, 1), "float32")
+    resid = jnp.zeros((n, 32, 1), "float32")
+
+    def per_dev_grad(xb, yb, w):
+        return jax.grad(lambda w: jnp.mean((xb @ w - yb) ** 2))(w)
+
+    losses = []
+    for step in range(60):
+        grads = jnp.stack([per_dev_grad(xs[d], ys[d], w) for d in range(n)])
+        summed, resid = dgc_allreduce(grads, resid, mesh, ratio=0.05)
+        w = w - 0.3 * summed[0] / n
+        losses.append(float(jnp.mean((jnp.asarray(x) @ w - jnp.asarray(y)) ** 2)))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
