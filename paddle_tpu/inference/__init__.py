@@ -96,7 +96,8 @@ class Config:
         self._extra_passes.append(name)
 
     def pass_builder(self) -> PassBuilder:
-        names = ["delete_dropout_op_pass", "fc_fuse_pass",
+        names = ["delete_dropout_op_pass", "conv_bn_fuse_pass",
+                 "fc_fuse_pass",
                  "fuse_elewise_add_act_pass", "constant_folding_pass",
                  "dead_code_elimination_pass"]
         if self._memory_optim:
@@ -168,8 +169,10 @@ class Predictor:
         fetch_names = [v.name for v in fetch_vars]
         if cfg.ir_optim():
             builder = cfg.pass_builder()
-            program = builder.apply_all(program, keep=fetch_names,
-                                        fetch_names=fetch_names)
+            with scope_guard(scope):  # weight-folding passes edit the scope
+                program = builder.apply_all(program, keep=fetch_names,
+                                            fetch_names=fetch_names,
+                                            scope=scope)
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = fetch_names

@@ -363,3 +363,84 @@ class GraphVizPass(Pass):
                 f.write(dot)
         program._graphviz_dot = dot
         return program
+
+
+@register_pass
+class ConvBnFusePass(Pass):
+    """Fold inference-mode batch_norm into the preceding conv2d's weights
+    (reference ir/conv_bn_fuse_pass.cc): w' = w·γ/√(σ²+ε) per out channel,
+    b' = β − μ·γ/√(σ²+ε); the BN op is replaced by one bias add. Needs the
+    live scope (weights are folded in place), so it only runs when the
+    caller passes `scope=` — the inference Predictor does."""
+
+    name = "conv_bn_fuse_pass"
+
+    def apply_impl(self, program: Program, scope=None, **kw):
+        if scope is None:
+            return program
+        blk = program.global_block()
+        producer = {}
+        consumers: Dict[str, int] = {}
+        for i, op in enumerate(blk.ops):
+            for n in op.output_names():
+                producer[n] = i
+            for n in op.input_names():
+                consumers[n] = consumers.get(n, 0) + 1
+
+        fused = 0
+        new_ops: List[Operator] = []
+        for op in blk.ops:
+            if op.type == "batch_norm" and op.attrs.get("is_test"):
+                x = op.inputs["X"][0]
+                pi = producer.get(x)
+                conv = blk.ops[pi] if pi is not None else None
+                if conv is not None and conv.type == "conv2d" \
+                        and consumers.get(x, 0) == 1 \
+                        and not conv.inputs.get("Bias") \
+                        and consumers.get(conv.inputs["Filter"][0], 0) == 1:
+                    # the Filter-consumer guard keeps weight-shared convs
+                    # out: folding edits the weights in place
+                    w_name = conv.inputs["Filter"][0]
+                    names = {s2: op.inputs[s2][0]
+                             for s2 in ("Scale", "Bias", "Mean", "Variance")}
+                    if scope.has_var(w_name) and all(
+                            scope.has_var(n) for n in names.values()):
+                        w = np.asarray(scope.find_var(w_name))
+                        gamma = np.asarray(scope.find_var(names["Scale"]))
+                        beta = np.asarray(scope.find_var(names["Bias"]))
+                        mean = np.asarray(scope.find_var(names["Mean"]))
+                        var = np.asarray(scope.find_var(names["Variance"]))
+                        eps = op.attrs.get("epsilon", 1e-5)
+                        alpha = gamma / np.sqrt(var + eps)
+                        scope.set_var(
+                            w_name,
+                            (w * alpha.reshape(-1, 1, 1, 1)).astype(w.dtype))
+                        b_name = f"{w_name}@bn_folded_bias"
+                        blk.create_var(name=b_name, shape=(len(alpha),),
+                                       dtype=str(w.dtype), persistable=True)
+                        scope.set_var(
+                            b_name, (beta - mean * alpha).astype(w.dtype))
+                        # the conv (already emitted, in place) keeps its
+                        # output; a bias add writes the BN's Y in its stead
+                        y = op.outputs["Y"][0]
+                        new_ops.append(Operator(
+                            blk, "elementwise_add",
+                            {"X": [x], "Y": [b_name]},
+                            {"Out": [y]}, {"axis": 1}))
+                        fused += 1
+                        continue
+            new_ops.append(op)
+        blk.ops = new_ops
+        if fused:
+            # drop the now-dead BN parameter vars so the predictor doesn't
+            # upload four unread per-channel arrays per fused BN
+            read = {n for op2 in blk.ops for n in op2.input_names()}
+            for name in list(blk.vars):
+                v = blk.vars[name]
+                if getattr(v, "persistable", False) and name not in read \
+                        and name.count("@bn_folded_bias") == 0 \
+                        and name not in {n for op2 in blk.ops
+                                         for n in op2.output_names()}:
+                    del blk.vars[name]
+            program._bump_version()
+        return program

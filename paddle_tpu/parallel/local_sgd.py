@@ -53,12 +53,17 @@ def local_sgd_step(grad_fn: Callable, mesh: Mesh, axis: str = "dp",
     """
 
     def per_replica(do_sync, params, batch):
-        loss, grads = grad_fn(params, batch)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, params, grads)
+        # inside shard_map each leaf keeps a leading dp-extent-1 dim; strip
+        # it so grad_fn sees the true per-replica shapes the docstring
+        # promises, and restore it on the way out
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        loss, grads = grad_fn(local, batch)
+        new_local = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, local, grads)
         if do_sync:
-            new_params = jax.tree_util.tree_map(
-                lambda p: lax.pmean(p, axis), new_params)
+            new_local = jax.tree_util.tree_map(
+                lambda p: lax.pmean(p, axis), new_local)
+        new_params = jax.tree_util.tree_map(lambda p: p[None], new_local)
         return new_params, lax.pmean(loss, axis)
 
     def _mapped(do_sync):

@@ -270,3 +270,42 @@ def test_predictor_bf16_precision(tmp_path):
     f32 = inference.create_predictor(inference.Config(model_dir)).run({"x": xv})[0]
     bf16 = pred.run({"x": xv})[0]
     np.testing.assert_allclose(f32, np.asarray(bf16, np.float32), rtol=0.05, atol=0.05)
+
+
+def test_conv_bn_fuse_pass_numerics(tmp_path):
+    """conv_bn_fuse_pass (reference ir/conv_bn_fuse_pass.cc): inference
+    outputs are unchanged after BN is folded into the conv weights, and the
+    optimized program contains no batch_norm op."""
+    import paddle_tpu as fluid
+    from paddle_tpu import inference
+
+    rng = np.random.RandomState(7)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        h = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+        h = fluid.layers.batch_norm(h, act="relu")
+        h = fluid.layers.conv2d(h, 4, 3, padding=1, bias_attr=False)
+        h = fluid.layers.batch_norm(h)
+        out = fluid.layers.reduce_mean(h, dim=[2, 3])
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        # a couple of train steps so BN stats are non-trivial
+        for _ in range(3):
+            exe.run(main, feed={"x": rng.rand(4, 3, 8, 8).astype("float32")},
+                    fetch_list=[out.name])
+        xv = rng.rand(5, 3, 8, 8).astype("float32")
+        ref = exe.run(main.clone(for_test=True), feed={"x": xv},
+                      fetch_list=[out.name])[0]
+        model_dir = str(tmp_path / "convbn")
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+
+    config = inference.Config(model_dir)
+    pred = inference.create_predictor(config)
+    got = pred.run({"x": xv})[0]
+    np.testing.assert_allclose(ref, got, rtol=2e-5, atol=2e-5)
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "batch_norm" not in types, types
+    assert types.count("conv2d") == 2
