@@ -29,9 +29,6 @@ class DatasetBase:
         self._thread_num = 1
         self._use_var_names: List[str] = []
 
-    def set_filelist(self, filelist: Sequence[str]):
-        self._filelist = list(filelist)
-
     def set_batch_size(self, batch_size: int):
         self._batch_size = batch_size
 
@@ -47,12 +44,50 @@ class DatasetBase:
         self._slot_types = "".join(types)
 
     def set_pipe_command(self, cmd: str):
-        # pipe_command preprocessing (data_feed pipe) — files are expected
-        # pre-processed in the TPU build; kept for API compat
+        """data_feed.h pipe_command: each input file is streamed through
+        this shell command before MultiSlot parsing (the reference pipes
+        via framework/io/shell.cc; here the preprocessing runs ONCE into
+        temp files — cached across epochs — then the native loader parses
+        as usual)."""
         self._pipe_command = cmd
+        self._piped_cache = None
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+        self._piped_cache = None
+
+    def _piped_filelist(self):
+        cmd = getattr(self, "_pipe_command", None)
+        if not cmd or cmd.strip() == "cat":  # reference default: identity
+            return self._filelist
+        if getattr(self, "_piped_cache", None) is not None:
+            return self._piped_cache
+        import atexit
+        import shutil
+        import subprocess
+        import tempfile
+        d = tempfile.mkdtemp(prefix="paddle_tpu_pipe_")
+        atexit.register(shutil.rmtree, d, ignore_errors=True)
+        piped = []
+        try:
+            for i, f in enumerate(self._filelist):
+                out = f"{d}/part-{i}"
+                with open(f, "rb") as src, open(out, "wb") as dst:
+                    r = subprocess.run(cmd, shell=True, stdin=src,
+                                       stdout=dst)
+                if r.returncode != 0:
+                    raise RuntimeError(
+                        f"pipe_command {cmd!r} failed on {f} "
+                        f"(rc={r.returncode})")
+                piped.append(out)
+        except BaseException:
+            shutil.rmtree(d, ignore_errors=True)
+            raise
+        self._piped_cache = piped
+        return piped
 
     def _make_loader(self) -> NativeDataLoader:
-        return NativeDataLoader(self._filelist, self._slot_types,
+        return NativeDataLoader(self._piped_filelist(), self._slot_types,
                                 num_threads=self._thread_num)
 
 

@@ -1,0 +1,5 @@
+"""incubate/fleet/parameter_server/distribute_transpiler import-path parity
+(reference __init__.py:341 fleet instance): pserver mode is a non-goal —
+the proxy delivers the GSPMD migration pointer on any use."""
+from .. import fleet  # noqa: F401
+from ....fleet import DistributedOptimizer  # noqa: F401

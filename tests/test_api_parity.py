@@ -419,3 +419,20 @@ multi_slot_desc {
     assert d.batch_size == 128
     assert "batch_size: 128" in d.desc()  # desc() reflects mutations
     assert "MultiSlotDataFeed" in d.desc()
+
+
+def test_core_pybind_aliases():
+    """fluid.core pybind-name surface (pybind.cc): the names scripts touch
+    directly on core."""
+    from paddle_tpu import core
+    assert core.is_compiled_with_cuda() is False
+    assert core.is_compiled_with_dist() is True
+    assert core.op_support_gpu("relu") and not core.op_support_gpu("nope")
+    assert "relu" in core.get_all_op_names()
+    t = core.LoDTensor(np.ones((3, 2)), [[1, 2]])
+    assert t.recursive_sequence_lengths() == [[1, 2]]
+    # pserver transpiler import path resolves and points at GSPMD
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        distribute_transpiler as dt)
+    with pytest.raises(NotImplementedError, match="non-goal"):
+        dt.fleet.init(None)

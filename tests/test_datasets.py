@@ -213,3 +213,31 @@ def test_remaining_dataset_modules_and_decorators():
          rd.creator.np_array(np.arange(4, 8))])
     got = sorted(int(v) for v in mp_r())
     assert got == list(range(8))
+
+
+def test_pipe_command_preprocessing(tmp_path):
+    """data_feed pipe_command (reference data_feed.h:61 pipe protocol via
+    shell.cc): raw lines are transformed by the shell command before
+    MultiSlot parsing."""
+    from paddle_tpu.native import available as native_available
+    if not native_available():
+        pytest.skip("no native toolchain")
+
+    # raw CSV → awk rewrites into MultiSlot "1 <feat> 1 <label>"
+    raw = tmp_path / "raw.csv"
+    raw.write_text("0.5,1\n0.25,0\n0.75,1\n0.125,0\n")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist([str(raw)])
+    ds.set_batch_size(2)
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        f = layers.data("f", [1])
+        lab = layers.data("lab", [1], dtype="int64")
+    ds.set_use_var([f, lab])
+    ds.set_pipe_command("awk -F, '{print \"1 \" $1 \" 1 \" $2}'")
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 4
+    batch = next(ds.batches())
+    assert set(batch) == {"f", "lab"}
+    vals = sorted(float(v) for b in [batch] for v in b["f"].ravel())
+    assert all(v in (0.125, 0.25, 0.5, 0.75) for v in vals)
