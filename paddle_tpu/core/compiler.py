@@ -204,7 +204,8 @@ class CompiledProgram:
                 feed_vals[name] = jax.make_array_from_process_local_data(
                     self._feed_sharding(), local)
             else:
-                feed_vals[name] = jnp.asarray(val, dtype=dtype)
+                from .executor import convert_feed_value
+                feed_vals[name] = convert_feed_value(block, name, val)
 
         state_names = sorted(
             v.name for v in program.list_vars()

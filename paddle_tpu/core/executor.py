@@ -153,6 +153,35 @@ def _amp_cast(vals_by_slot, op_type, amp):
     return vals_by_slot
 
 
+def convert_feed_value(block, name: str, val):
+    """Convert one feed to a device array with feed-time validation: clear
+    errors for unconvertible values and declared-shape mismatches instead
+    of raw XLA errors deep in the traced step (reference PrepareData raised
+    at feed time too, operator.cc:1031)."""
+    var = block._find_var_recursive(name)
+    dtype = var.dtype if var is not None else None
+    try:
+        arr = jnp.asarray(val, dtype=dtype)
+    except (TypeError, ValueError) as e:
+        raise type(e)(
+            f"feed {name!r}: cannot convert value of type "
+            f"{type(val).__name__} to a {dtype or 'device'} array "
+            f"({e})") from e
+    want = getattr(var, "shape", None)
+    if want and len(want) == arr.ndim:
+        for axis, (w, got) in enumerate(zip(want, arr.shape)):
+            if w not in (-1, None) and w != got:
+                raise ValueError(
+                    f"feed {name!r}: shape mismatch at dim {axis}: "
+                    f"program declares {tuple(want)}, got {arr.shape}")
+    elif want and getattr(var, "is_data", False) and len(want) != arr.ndim:
+        raise ValueError(
+            f"feed {name!r}: rank mismatch: program declares "
+            f"{tuple(want)} ({len(want)}-d), got {arr.shape} "
+            f"({arr.ndim}-d)")
+    return arr
+
+
 def _run_op(op, env: Dict[str, object], ctx: ExecContext):
     opdef = registry.get_op(op.type)
     ctx.out_arity = {slot: len(names) for slot, names in op.outputs.items()}
@@ -405,12 +434,9 @@ class Executor:
         scope = scope or _scope()
 
         fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
-        feed_vals = {}
         block = program.global_block()
-        for name, val in feed.items():
-            var = block._find_var_recursive(name)
-            dtype = var.dtype if var is not None else None
-            feed_vals[name] = jnp.asarray(val, dtype=dtype)
+        feed_vals = {name: convert_feed_value(block, name, val)
+                     for name, val in feed.items()}
 
         state_names = self._state_names(program, scope)
         out_state_names = sorted({v.name for v in program.list_vars() if v.persistable})

@@ -190,3 +190,29 @@ def test_gradients_wrt_intermediate_var():
         (gv,) = exe.run(main, feed={"x": np.ones((1, 3), "float32")},
                         fetch_list=[gh])
     np.testing.assert_allclose(gv, np.ones((1, 3)))
+
+
+def test_feed_validation_errors():
+    """Bad feeds raise clear errors at feed time, not raw XLA errors inside
+    the traced step (reference PrepareData-time checks, operator.cc:1031)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        out = fluid.layers.fc(x, 2, bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    with pytest.raises(ValueError, match="shape mismatch at dim 1"):
+        exe.run(main, feed={"x": np.zeros((3, 5), "float32")},
+                fetch_list=[out])
+    with pytest.raises(ValueError, match="rank mismatch"):
+        exe.run(main, feed={"x": np.zeros((3,), "float32")},
+                fetch_list=[out])
+    with pytest.raises(TypeError, match="cannot convert"):
+        exe.run(main, feed={"x": object()}, fetch_list=[out])
+    # correct feed still works
+    got = exe.run(main, feed={"x": np.zeros((3, 4), "float32")},
+                  fetch_list=[out])
+    assert got[0].shape == (3, 2)
