@@ -19,6 +19,20 @@ def _peak_flops(on_tpu):
     return 197e12 if on_tpu else 1e12
 
 
+def _time_steps(exe, prog, feed, loss, iters):
+    """Shared measurement protocol: 2 compile/warmup runs, `iters` async
+    steps (return_numpy=False so dispatch overlaps device compute), one
+    trailing sync; returns seconds/step."""
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    t0 = time.time()
+    for _ in range(iters):
+        out = exe.run(prog, feed=feed, fetch_list=[loss],
+                      return_numpy=False)
+    np.asarray(out[0])
+    return (time.time() - t0) / iters
+
+
 def bench_resnet(on_tpu):
     """ResNet-50 train-step throughput (BASELINE config 2). Returns
     (imgs_per_sec, mfu).
@@ -64,20 +78,78 @@ def bench_resnet(on_tpu):
         "label": jnp.asarray(
             rng.randint(0, classes, (batch, 1)).astype("int32")),
     }
-    exe.run(main_prog, feed=feed, fetch_list=[loss])
-    exe.run(main_prog, feed=feed, fetch_list=[loss])
-    iters = 20 if on_tpu else 2
-    t0 = time.time()
-    for _ in range(iters):
-        out = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                      return_numpy=False)
-    np.asarray(out[0])
-    dt = (time.time() - t0) / iters
+    dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 2)
     imgs_per_sec = batch / dt
     # ResNet-50 @224²: ~4.1 GFLOP fwd; fwd+bwd ≈ 3×
     flops_per_img = 3 * 4.1e9 if hw == 224 else 3 * 4.1e9 * (hw / 224) ** 2
     mfu = imgs_per_sec * flops_per_img / _peak_flops(on_tpu)
     return round(imgs_per_sec, 2), round(mfu, 4), round(dt * 1e3, 2)
+
+
+def bench_deepfm(on_tpu):
+    """DeepFM CTR train-step (BASELINE config 5): Criteo-shaped 1M-vocab
+    sparse embedding, SelectedRows sparse grads. Returns (exs/s, ms)."""
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.models import deepfm
+
+    batch, vocab = (4096, 1_000_000) if on_tpu else (64, 10_000)
+    main_p, startup, feeds, loss, _ = deepfm.build_train_program(
+        vocab_size=vocab, is_sparse=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {
+            "sparse_ids": jnp.asarray(
+                rng.randint(0, vocab, (batch, 26)).astype("int32")),
+            "dense": jnp.asarray(rng.rand(batch, 13).astype("float32")),
+            "label": jnp.asarray(
+                rng.randint(0, 2, (batch, 1)).astype("float32")),
+        }
+        dt = _time_steps(exe, main_p, feed, loss, 20 if on_tpu else 2)
+    return round(batch / dt, 1), round(dt * 1e3, 2)
+
+
+def bench_nmt(on_tpu):
+    """Transformer-big NMT train-step (BASELINE config 4). Returns
+    (tokens/s, ms)."""
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.models import transformer_nmt as nmt
+
+    if on_tpu:
+        cfg = nmt.TransformerConfig()           # transformer-big
+        batch, ts, tt = 16, 128, 128
+    else:
+        cfg = nmt.TransformerConfig(d_model=64, n_heads=4, d_ff=128,
+                                    n_enc=2, n_dec=2, src_vocab=1000,
+                                    tgt_vocab=1000)
+        batch, ts, tt = 2, 16, 16
+    # same bf16 AMP regime as the BERT/ResNet benches (comparable numbers)
+    main_p, startup, feeds, loss = nmt.build_train_program(
+        cfg, ts, tt, optimizer_factory=lambda: mp.decorate(
+            fluid.optimizer.Adam(1e-4), dtype="bfloat16",
+            use_dynamic_loss_scaling=False))
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        causal = np.triu(np.full((tt, tt), -1e4, "float32"), 1)
+        feed = {
+            "src_ids": jnp.asarray(
+                rng.randint(1, cfg.src_vocab, (batch, ts)).astype("int32")),
+            "tgt_ids": jnp.asarray(
+                rng.randint(1, cfg.tgt_vocab, (batch, tt)).astype("int32")),
+            "lbl_ids": jnp.asarray(
+                rng.randint(1, cfg.tgt_vocab, (batch, tt, 1)).astype("int32")),
+            "src_mask": jnp.zeros((batch, 1, 1, ts), jnp.float32),
+            "tgt_mask": jnp.asarray(
+                np.broadcast_to(causal, (batch, 1, tt, tt)).copy()),
+        }
+        dt = _time_steps(exe, main_p, feed, loss, 10 if on_tpu else 2)
+    return round(batch * (ts + tt) / dt, 1), round(dt * 1e3, 2)
 
 
 def main():
@@ -119,20 +191,7 @@ def main():
         "mlm_labels": rng.randint(0, cfg.vocab_size, (batch, seq, 1)).astype("int32"),
     }
 
-    # warmup (compile)
-    exe.run(main_prog, feed=feed, fetch_list=[loss])
-    exe.run(main_prog, feed=feed, fetch_list=[loss])
-
-    iters = 20 if on_tpu else 3
-    # steps are queued async (return_numpy=False) so host dispatch overlaps
-    # device compute — the production input pipeline does the same; the
-    # trailing fetch syncs the whole pipeline
-    t0 = time.time()
-    for _ in range(iters):
-        out = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                      return_numpy=False)
-    out = [np.asarray(out[0])]
-    dt = (time.time() - t0) / iters
+    dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 3)
 
     tokens_per_sec = batch * seq / dt
     n_params = bert.param_count(cfg)
@@ -148,6 +207,19 @@ def main():
         rn_ips, rn_mfu, rn_ms = None, None, None
         rn_err = str(e)[:120]
 
+    # remaining BASELINE workload configs (4: Transformer-big NMT,
+    # 5: DeepFM CTR) — step-throughput evidence, same failure isolation
+    extras2 = {}
+    for key, fn in (("deepfm", bench_deepfm), ("nmt_big", bench_nmt)):
+        rate = ms = err = None
+        try:
+            rate, ms = fn(on_tpu)
+        except Exception as e:  # pragma: no cover
+            err = str(e)[:120]
+        extras2[f"{key}_rate"] = rate
+        extras2[f"{key}_step_ms"] = ms
+        extras2[f"{key}_error"] = err
+
     print(json.dumps({
         "metric": "ernie_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -161,7 +233,8 @@ def main():
                   "resnet50_step_ms": rn_ms,
                   "resnet50_error": rn_err,
                   "resnet50_vs_baseline": (round(rn_mfu / 0.35, 4)
-                                           if rn_mfu is not None else None)},
+                                           if rn_mfu is not None else None),
+                  **extras2},
     }))
 
 

@@ -139,7 +139,7 @@ def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False):
 
 
 def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
-                        lr=1e-3, is_test=False):
+                        lr=1e-3, is_test=False, optimizer_factory=None):
     """Masks are fed as additive float tensors (0 keep / -1e4 drop):
     src_mask [B,1,1,Ts]; tgt self-mask [B,1,Tt,Tt] (causal+pad);
     cross mask [B,1,1,Ts]."""
@@ -159,7 +159,9 @@ def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
         loss = layers.elementwise_div(
             layers.reduce_sum(layers.elementwise_mul(loss_tok, valid)),
             layers.reduce_sum(valid))
-        fluid.optimizer.Adam(lr).minimize(loss)
+        opt = (optimizer_factory() if optimizer_factory
+               else fluid.optimizer.Adam(lr))
+        opt.minimize(loss)
     return main, startup, ["src_ids", "tgt_ids", "lbl_ids", "src_mask", "tgt_mask"], loss
 
 
