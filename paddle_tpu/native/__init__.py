@@ -16,6 +16,7 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libpaddle_tpu_native.so")
 _SRC = os.path.join(_DIR, "src", "dataloader.cc")
+_SRC2 = os.path.join(_DIR, "src", "ckptio.cc")
 
 _lib = None
 _build_error: Optional[str] = None
@@ -27,7 +28,8 @@ def _ensure_built():
         return _lib
     try:
         if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC2)):
             subprocess.run(["make", "-C", _DIR], check=True,
                            capture_output=True, text=True)
         lib = ctypes.CDLL(_SO)
@@ -50,6 +52,27 @@ def _ensure_built():
                                 ctypes.c_longlong]
         lib.ptq_close.argtypes = [ctypes.c_void_p]
         lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptck_open.restype = ctypes.c_void_p
+        lib.ptck_open.argtypes = [ctypes.c_char_p]
+        lib.ptck_write_tensor.restype = ctypes.c_int
+        lib.ptck_write_tensor.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_uint64]
+        lib.ptck_close.restype = ctypes.c_int
+        lib.ptck_close.argtypes = [ctypes.c_void_p]
+        lib.ptck_read_open.restype = ctypes.c_void_p
+        lib.ptck_read_open.argtypes = [ctypes.c_char_p]
+        lib.ptck_count.restype = ctypes.c_int64
+        lib.ptck_count.argtypes = [ctypes.c_void_p]
+        lib.ptck_entry_meta.restype = ctypes.c_int64
+        lib.ptck_entry_meta.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.ptck_entry_data.restype = ctypes.c_int
+        lib.ptck_entry_data.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_void_p, ctypes.c_uint64]
+        lib.ptck_read_close.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception as e:  # no toolchain / build failure → python fallback
         _build_error = str(e)
@@ -156,3 +179,66 @@ class NativeDataLoader:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Native checkpoint bundle IO (src/ckptio.cc — save_combine_op.cc analog)
+# ---------------------------------------------------------------------------
+
+def write_bundle(path: str, arrays) -> bool:
+    """Write {name: np.ndarray} as one framed binary bundle via the C++
+    writer (buffered stdio + fsync). Returns False when the native lib is
+    unavailable or any write fails (caller falls back to pickle)."""
+    lib = _ensure_built()
+    if lib is None:
+        return False
+    h = lib.ptck_open(path.encode())
+    if not h:
+        return False
+    ok = True
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        dims = (ctypes.c_int64 * max(a.ndim, 1))(*(a.shape or (0,)))
+        rc = lib.ptck_write_tensor(
+            h, str(name).encode(), str(a.dtype).encode(), a.ndim, dims,
+            a.ctypes.data_as(ctypes.c_void_p), a.nbytes)
+        if rc != 0:
+            ok = False
+            break
+    if lib.ptck_close(h) != 0:
+        ok = False
+    return ok
+
+
+def read_bundle(path: str):
+    """Read a bundle back as {name: np.ndarray}; None if the native lib is
+    unavailable or the file isn't a PTCK bundle."""
+    lib = _ensure_built()
+    if lib is None:
+        return None
+    h = lib.ptck_read_open(path.encode())
+    if not h:
+        return None
+    try:
+        out = {}
+        n = lib.ptck_count(h)
+        name_buf = ctypes.create_string_buffer(4096)
+        dtype_buf = ctypes.create_string_buffer(64)
+        dims_buf = (ctypes.c_int64 * 16)()
+        ndim = ctypes.c_int()
+        for i in range(n):
+            nbytes = lib.ptck_entry_meta(h, i, name_buf, 4096, dtype_buf, 64,
+                                         dims_buf, 16, ctypes.byref(ndim))
+            if nbytes < 0:
+                return None
+            shape = tuple(dims_buf[d] for d in range(ndim.value))
+            arr = np.empty(shape, dtype=np.dtype(dtype_buf.value.decode()))
+            buf = arr if arr.nbytes else np.empty(1, np.uint8)
+            if lib.ptck_entry_data(
+                    h, i, buf.ctypes.data_as(ctypes.c_void_p),
+                    max(arr.nbytes, 1)) != 0:
+                return None
+            out[name_buf.value.decode()] = arr
+        return out
+    finally:
+        lib.ptck_read_close(h)

@@ -69,7 +69,17 @@ class Checkpointer:
         os.makedirs(dirname, exist_ok=True)
 
     def _path(self, step: int) -> str:
-        return os.path.join(self.dirname, f"ckpt-{step}.pkl")
+        # native bundle when the C++ writer is available, else pickle
+        from ..native import available as _native_available
+        ext = "ptck" if _native_available() else "pkl"
+        return os.path.join(self.dirname, f"ckpt-{step}.{ext}")
+
+    def _existing_path(self, step: int) -> Optional[str]:
+        for ext in ("ptck", "pkl"):
+            p = os.path.join(self.dirname, f"ckpt-{step}.{ext}")
+            if os.path.exists(p):
+                return p
+        return None
 
     def _write(self, step: int, vals: Dict[str, object]):
         try:
@@ -81,8 +91,16 @@ class Checkpointer:
         bundle = {n: np.asarray(v) for n, v in vals.items()}
         path = self._path(step)
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump({"step": step, "vars": bundle}, f, protocol=4)
+        if path.endswith(".ptck"):
+            # native framed writer (src/ckptio.cc — save_combine_op.cc
+            # analog): buffered stdio + fsync off the Python thread
+            from ..native import write_bundle
+            bundle["@step@"] = np.asarray(step, np.int64)
+            if not write_bundle(tmp, bundle):
+                raise RuntimeError(f"native checkpoint write failed: {tmp}")
+        else:
+            with open(tmp, "wb") as f:
+                pickle.dump({"step": step, "vars": bundle}, f, protocol=4)
         os.replace(tmp, path)  # atomic: never a half-written ckpt-N
         marker = os.path.join(self.dirname, "latest")
         with open(marker + ".tmp", "w") as f:
@@ -94,17 +112,20 @@ class Checkpointer:
         steps = sorted(self.all_steps())
         for s in steps[:-self.keep] if self.keep else []:
             if s != newest:
-                try:
-                    os.remove(self._path(s))
-                except OSError:
-                    pass
+                p = self._existing_path(s)
+                if p:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
 
     def all_steps(self):
         out = []
         for f in os.listdir(self.dirname):
-            if f.startswith("ckpt-") and f.endswith(".pkl"):
+            if f.startswith("ckpt-") and (f.endswith(".pkl")
+                                          or f.endswith(".ptck")):
                 try:
-                    out.append(int(f[5:-4]))
+                    out.append(int(f[5:].rsplit(".", 1)[0]))
                 except ValueError:
                     pass
         return out
@@ -114,7 +135,7 @@ class Checkpointer:
         if os.path.exists(marker):
             with open(marker) as f:
                 s = int(f.read().strip())
-            if os.path.exists(self._path(s)):
+            if self._existing_path(s):
                 return s
         steps = self.all_steps()
         return max(steps) if steps else None
@@ -166,8 +187,19 @@ class Checkpointer:
             step = self.latest_step()
         if step is None:
             return None
-        with open(self._path(step), "rb") as f:
-            payload = pickle.load(f)
+        path = self._existing_path(step)
+        if path is None:
+            return None
+        if path.endswith(".ptck"):
+            from ..native import read_bundle
+            bundle = read_bundle(path)
+            if bundle is None:
+                raise RuntimeError(f"cannot read native checkpoint {path}")
+            bundle.pop("@step@", None)
+            payload = {"step": step, "vars": bundle}
+        else:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
         names = {v.name for v in program.list_vars() if v.persistable}
         for n, arr in payload["vars"].items():
             if n in names:
