@@ -422,11 +422,21 @@ class ConvBnFusePass(Pass):
                             b_name, (beta - mean * alpha).astype(w.dtype))
                         # the conv (already emitted, in place) keeps its
                         # output; a bias add writes the BN's Y in its stead
+                        # (followed by the BN's folded activation, if any)
                         y = op.outputs["Y"][0]
+                        act = op.attrs.get("act", "")
+                        add_out = y if not act else f"{y}@bn_fold_preact"
+                        if act:
+                            ydt = (blk.vars[y].dtype if y in blk.vars
+                                   else "float32")
+                            blk.create_var(name=add_out, dtype=ydt)
                         new_ops.append(Operator(
                             blk, "elementwise_add",
                             {"X": [x], "Y": [b_name]},
-                            {"Out": [y]}, {"axis": 1}))
+                            {"Out": [add_out]}, {"axis": 1}))
+                        if act:
+                            new_ops.append(Operator(
+                                blk, act, {"X": [add_out]}, {"Out": [y]}, {}))
                         fused += 1
                         continue
             new_ops.append(op)

@@ -189,15 +189,19 @@ def batch_norm(input: Variable, act: Optional[str] = None, is_test: bool = False
                                                     shape=input.shape)
     saved_mean = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
     saved_var = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    # relu folds into the op itself (fused_bn_add_activation analog): the
+    # Pallas training-BN kernel applies it in the same HBM pass instead of a
+    # separate elementwise op the compiler can't fuse into the kernel.
+    fold_act = act if act == "relu" else None
     helper.append_op(
         type="batch_norm",
         inputs={"X": [input.name], "Scale": [scale.name], "Bias": [bias.name],
                 "Mean": [mean.name], "Variance": [var.name]},
         outputs={"Y": [out.name], "MeanOut": [mean.name], "VarianceOut": [var.name],
                  "SavedMean": [saved_mean.name], "SavedVariance": [saved_var.name]},
-        attrs={"momentum": momentum, "epsilon": epsilon,
+        attrs={"momentum": momentum, "epsilon": epsilon, "act": fold_act or "",
                "is_test": is_test or use_global_stats, "data_layout": data_layout})
-    return helper.append_activation(out, act)
+    return out if fold_act else helper.append_activation(out, act)
 
 
 def layer_norm(input: Variable, scale: bool = True, shift: bool = True,

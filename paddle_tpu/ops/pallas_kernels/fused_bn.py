@@ -1,0 +1,342 @@
+"""Pallas fused training-mode batch norm for TPU.
+
+Reference analog: batch_norm_op.cu:35 (cuDNN BatchNormalizationForwardTraining)
+plus fused_bn_add_activation semantics — one statistics pass + one apply pass,
+with relu (and the bottleneck residual add) foldable into the apply.
+
+Why this kernel exists (round-3 xplane profiling on v5e): the ResNet-50 train
+step is HBM-bound and XLA's per-channel BN reduction fusions sustain only
+~140 GB/s (1.5 ms for a 205 MB activation) vs ~450 GB/s for its elementwise
+fusions. The kernel streams the activation once for the statistics and once
+for the apply.
+
+Layout is the whole game here. XLA keeps conv activations physically
+channel-minor on TPU (e.g. bf16[128,256,56,56]{1,0,3,2} — NHWC bytes under an
+NCHW logical shape). A kernel that demands row-major NCHW forces a material
+transpose around every call (measured: 116 ms vs 54 ms full step — 2× WORSE).
+So these kernels operate on the (M, C) = (N·H·W, C) view with channel riding
+the lane axis: the logical NCHW→NHWC transpose then lines up with the bytes
+XLA already has, per-channel statistics become sublane-axis sums at streaming
+bandwidth, and every broadcast is a natural row broadcast.
+
+When C < 128 the (M, C) view would waste the lane axis (C=64 pads to 128 —
+half the bandwidth on exactly the stage-1 tensors that dominate traffic), so
+the view is folded to (M/k, k·C) with k = 128//C and the k per-channel
+partials are combined outside the kernel.
+
+Backward (custom_vjp): a reduction pass producing dbeta=Σg, dgamma=Σg·x̂
+(g = dy masked through the fused relu), then a dx pass
+`dx = inv·scale·(g − dbeta/m − x̂·dgamma/m)`, emitting dresidual=g for free
+when the residual add was fused.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas import is deferred-safe: CPU-only envs still import this module
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = pltpu = None
+    _HAVE_PALLAS = False
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# Tests may set this to run the kernels on CPU through the interpreter.
+FORCE_PALLAS_INTERPRET = False
+
+# Per-operand VMEM budget per grid step (bytes); the widest backward pass
+# streams five (BM, C) operands (dy, x, y in; dx, dres out) plus double
+# buffering inside ~16 MB.
+_MAX_BLOCK_BYTES = 1024 * 1024
+
+
+def supports(x_shape, dtype) -> bool:
+    """Static gate for the pallas path: 4-D, lane-friendly C, and enough
+    rows that kernel launch overhead amortizes."""
+    if not _HAVE_PALLAS:
+        return False
+    if len(x_shape) != 4:
+        return False
+    n, c, h, w = x_shape
+    if c < 8 or c > 8192 or (c < 128 and 128 % c != 0) or \
+            (c >= 128 and c % 128 != 0):
+        return False
+    m = n * h * w
+    k = 128 // c if c < 128 else 1
+    mk = m // k
+    return m % max(k, 1) == 0 and mk >= 1024 and mk % 8 == 0
+
+
+def _fold(c):
+    """Lane-fold factor k: view (M, C) as (M/k, kC) so the lane axis is
+    full when C < 128."""
+    return 128 // c if c < 128 else 1
+
+
+def _pick_bm(mk, ck, itemsize):
+    """Sublane block: largest power-of-two divisor of M/k within the
+    per-operand byte budget (dtype-aware — f32 blocks are half the rows of
+    bf16 ones)."""
+    cap = max(8, _MAX_BLOCK_BYTES // (ck * itemsize))
+    bm = 8
+    while bm * 2 <= cap and mk % (bm * 2) == 0:
+        bm *= 2
+    return bm
+
+
+def _nhwc_2d(x):
+    """(N, C, H, W) → (M/k, k·C) channel-minor view (bitcast against XLA's
+    preferred conv layout, not a material transpose)."""
+    n, c, h, w = x.shape
+    k = _fold(c)
+    return jnp.transpose(x, (0, 2, 3, 1)).reshape(n * h * w // k, k * c)
+
+
+def _un_nhwc(y2, shape):
+    n, c, h, w = shape
+    return jnp.transpose(y2.reshape(n, h, w, c), (0, 3, 1, 2))
+
+
+# ---------------------------------------------------------------------------
+# forward: statistics (one streaming pass)
+# ---------------------------------------------------------------------------
+
+def _stats_kernel(x_ref, sum_ref, ssq_ref):
+    mb = pl.program_id(0)
+
+    @pl.when(mb == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        ssq_ref[...] = jnp.zeros_like(ssq_ref)
+
+    xf = x_ref[...].astype(jnp.float32)                    # [BM, kC]
+    sum_ref[...] += jnp.sum(xf, axis=0, keepdims=True)
+    ssq_ref[...] += jnp.sum(xf * xf, axis=0, keepdims=True)
+
+
+def bn_stats(x, *, interpret=False):
+    """Per-channel (mean, var) of NCHW x in one HBM pass. f32 outputs [C].
+
+    One-pass E[x²]−E[x]² with f32 accumulators and a clamp at 0 — the same
+    trade cuDNN's training path makes; exactness on adversarially large-mean
+    inputs is traded for a single streaming read."""
+    n, c, h, w = x.shape
+    k = _fold(c)
+    x2 = _nhwc_2d(x)
+    mk, ck = x2.shape
+    bm = _pick_bm(mk, ck, x.dtype.itemsize)
+    s, ss = pl.pallas_call(
+        _stats_kernel,
+        grid=(mk // bm,),
+        in_specs=[pl.BlockSpec((bm, ck), lambda mb: (mb, 0))],
+        out_specs=[pl.BlockSpec((1, ck), lambda mb: (0, 0)),
+                   pl.BlockSpec((1, ck), lambda mb: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((1, ck), jnp.float32),
+                   jax.ShapeDtypeStruct((1, ck), jnp.float32)],
+        interpret=interpret,
+    )(x2)
+    m = float(n * h * w)
+    s = s.reshape(k, c).sum(axis=0)
+    ss = ss.reshape(k, c).sum(axis=0)
+    mean = s / m
+    var = jnp.maximum(ss / m - mean * mean, 0.0)
+    return mean, var
+
+
+# ---------------------------------------------------------------------------
+# forward: apply (+relu, +residual)
+# ---------------------------------------------------------------------------
+
+def _apply_kernel(x_ref, mean_ref, isc_ref, bias_ref, *rest, act, has_res):
+    if has_res:
+        res_ref, y_ref = rest
+    else:
+        (y_ref,) = rest
+    xf = x_ref[...].astype(jnp.float32)
+    y = (xf - mean_ref[...]) * isc_ref[...] + bias_ref[...]
+    if has_res:
+        y = y + res_ref[...].astype(jnp.float32)
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def bn_apply(x, mean, inv, scale, bias, *, act="", residual=None,
+             interpret=False):
+    n, c, h, w = x.shape
+    k = _fold(c)
+    x2 = _nhwc_2d(x)
+    mk, ck = x2.shape
+    bm = _pick_bm(mk, ck, x.dtype.itemsize)
+    isc = (inv * scale.astype(jnp.float32))
+    meanv = jnp.tile(mean.astype(jnp.float32), k).reshape(1, ck)
+    iscv = jnp.tile(isc, k).reshape(1, ck)
+    biasv = jnp.tile(bias.astype(jnp.float32), k).reshape(1, ck)
+    vec = pl.BlockSpec((1, ck), lambda mb: (0, 0))
+    big = pl.BlockSpec((bm, ck), lambda mb: (mb, 0))
+    args = [x2, meanv, iscv, biasv]
+    in_specs = [big, vec, vec, vec]
+    if residual is not None:
+        args.append(_nhwc_2d(residual))
+        in_specs.append(big)
+    y2 = pl.pallas_call(
+        functools.partial(_apply_kernel, act=act,
+                          has_res=residual is not None),
+        grid=(mk // bm,),
+        in_specs=in_specs,
+        out_specs=big,
+        out_shape=jax.ShapeDtypeStruct((mk, ck), x.dtype),
+        interpret=interpret,
+    )(*args)
+    return _un_nhwc(y2, x.shape)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_reduce_kernel(dy_ref, x_ref, *rest, act):
+    """dbeta = Σ g, dgamma = Σ g·x̂ in one streaming pass.
+    g = dy·(y>0) when relu was fused (y passed in), else dy."""
+    if act == "relu":
+        y_ref, mean_ref, inv_ref, dbeta_ref, dgamma_ref = rest
+    else:
+        mean_ref, inv_ref, dbeta_ref, dgamma_ref = rest
+    mb = pl.program_id(0)
+
+    @pl.when(mb == 0)
+    def _init():
+        dbeta_ref[...] = jnp.zeros_like(dbeta_ref)
+        dgamma_ref[...] = jnp.zeros_like(dgamma_ref)
+
+    g = dy_ref[...].astype(jnp.float32)
+    if act == "relu":
+        g = jnp.where(y_ref[...].astype(jnp.float32) > 0, g, 0.0)
+    xhat = (x_ref[...].astype(jnp.float32) - mean_ref[...]) * inv_ref[...]
+    dbeta_ref[...] += jnp.sum(g, axis=0, keepdims=True)
+    dgamma_ref[...] += jnp.sum(g * xhat, axis=0, keepdims=True)
+
+
+def _bwd_dx_kernel(dy_ref, x_ref, *rest, act, has_res, m):
+    if act == "relu":
+        y_ref = rest[0]
+        rest = rest[1:]
+    mean_ref, inv_ref, isc_ref, dbeta_ref, dgamma_ref = rest[:5]
+    outs = rest[5:]
+    g = dy_ref[...].astype(jnp.float32)
+    if act == "relu":
+        g = jnp.where(y_ref[...].astype(jnp.float32) > 0, g, 0.0)
+    xhat = (x_ref[...].astype(jnp.float32) - mean_ref[...]) * inv_ref[...]
+    dx = isc_ref[...] * (
+        g - dbeta_ref[...] * (1.0 / m) - xhat * (dgamma_ref[...] * (1.0 / m)))
+    outs[0][...] = dx.astype(outs[0].dtype)
+    if has_res:
+        outs[1][...] = g.astype(outs[1].dtype)
+
+
+# ---------------------------------------------------------------------------
+# public fused op with custom_vjp
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_bn_act(x, scale, bias, eps, act, residual_tag, residual=None):
+    """Training-mode fused BN: y = act(x̂·scale + bias [+ residual]).
+
+    Returns (y, mean, var) with mean/var the f32 batch statistics (for the
+    running-stat update). `residual_tag` statically records whether a
+    residual is fused (custom_vjp needs it nondiff)."""
+    y, mean, var, _ = _fwd(x, scale, bias, eps, act, residual)
+    return y, mean, var
+
+
+def _fwd(x, scale, bias, eps, act, residual):
+    interpret = FORCE_PALLAS_INTERPRET
+    mean, var = bn_stats(x, interpret=interpret)
+    inv = lax.rsqrt(var + eps)
+    y = bn_apply(x, mean, inv, scale, bias, act=act, residual=residual,
+                 interpret=interpret)
+    return y, mean, var, inv
+
+
+def _fused_fwd(x, scale, bias, eps, act, residual_tag, residual=None):
+    y, mean, var, inv = _fwd(x, scale, bias, eps, act, residual)
+    saved_y = y if act == "relu" else None
+    return (y, mean, var), (x, scale, mean, inv, saved_y)
+
+
+def _fused_bwd(eps, act, residual_tag, saved, cots):
+    x, scale, mean, inv, saved_y = saved
+    dy, _dmean, _dvar = cots  # mean/var feed stop-gradient running stats
+    interpret = FORCE_PALLAS_INTERPRET
+    n, c, h, w = x.shape
+    k = _fold(c)
+    m = float(n * h * w)
+    x2 = _nhwc_2d(x)
+    dy2 = _nhwc_2d(dy)
+    mk, ck = x2.shape
+    bm = _pick_bm(mk, ck, x.dtype.itemsize)
+    vec = pl.BlockSpec((1, ck), lambda mb: (0, 0))
+    big = pl.BlockSpec((bm, ck), lambda mb: (mb, 0))
+
+    meanv = jnp.tile(mean, k).reshape(1, ck)
+    invv = jnp.tile(inv, k).reshape(1, ck)
+
+    args = [dy2, x2]
+    in_specs = [big, big]
+    if act == "relu":
+        args.append(_nhwc_2d(saved_y))
+        in_specs.append(big)
+    args += [meanv, invv]
+    in_specs += [vec, vec]
+
+    dbeta2, dgamma2 = pl.pallas_call(
+        functools.partial(_bwd_reduce_kernel, act=act),
+        grid=(mk // bm,),
+        in_specs=in_specs,
+        out_specs=[vec, vec],
+        out_shape=[jax.ShapeDtypeStruct((1, ck), jnp.float32),
+                   jax.ShapeDtypeStruct((1, ck), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    dbeta = dbeta2.reshape(k, c).sum(axis=0)
+    dgamma = dgamma2.reshape(k, c).sum(axis=0)
+
+    has_res = residual_tag
+    isc = inv * scale.astype(jnp.float32)
+    args2 = args + [jnp.tile(isc, k).reshape(1, ck),
+                    jnp.tile(dbeta, k).reshape(1, ck),
+                    jnp.tile(dgamma, k).reshape(1, ck)]
+    in_specs2 = in_specs + [vec, vec, vec]
+    out_specs = [big]
+    out_shape = [jax.ShapeDtypeStruct((mk, ck), x.dtype)]
+    if has_res:
+        out_specs.append(big)
+        out_shape.append(jax.ShapeDtypeStruct((mk, ck), x.dtype))
+    outs = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, act=act, has_res=has_res, m=m),
+        grid=(mk // bm,),
+        in_specs=in_specs2,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args2)
+    dx = _un_nhwc(outs[0], x.shape)
+    dscale = dgamma.astype(scale.dtype)
+    dbias = dbeta.astype(scale.dtype)
+    dres = _un_nhwc(outs[1], x.shape) if has_res else None
+    return dx, dscale, dbias, dres
+
+
+fused_bn_act.defvjp(_fused_fwd, _fused_bwd)

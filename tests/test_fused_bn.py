@@ -1,0 +1,120 @@
+"""Pallas fused batch-norm kernel (interpret mode) vs the jnp reference, and
+the batch_norm layer's act-folding contract.
+
+The kernel is opt-in on TPU (PDTPU_BN_MODE=pallas; measured slower than the
+default one-pass XLA lowering on v5e, kept for other-chip experiments), but
+its numerics must stay correct either way.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.pallas_kernels import fused_bn
+
+
+def _ref_bn(x, scale, bias, eps, act, residual=None):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 2, 3))
+    var = jnp.var(xf, axis=(0, 2, 3))
+    sh = (1, x.shape[1], 1, 1)
+    y = ((xf - mean.reshape(sh)) * jax.lax.rsqrt(var.reshape(sh) + eps)
+         * scale.reshape(sh) + bias.reshape(sh))
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype), mean, var
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    fused_bn.FORCE_PALLAS_INTERPRET = True
+    yield
+    fused_bn.FORCE_PALLAS_INTERPRET = False
+
+
+@pytest.mark.parametrize("shape,act", [
+    ((4, 16, 8, 32), "relu"),
+    ((4, 16, 8, 32), ""),
+    ((2, 32, 16, 16), "relu"),
+])
+def test_fused_bn_forward_and_grads(shape, act):
+    rng = np.random.RandomState(0)
+    n, c, h, w = shape
+    x = jnp.asarray(rng.randn(*shape).astype("float32") * 1.5 + 0.3)
+    scale = jnp.asarray(rng.rand(c).astype("float32") + 0.5)
+    bias = jnp.asarray(rng.randn(c).astype("float32") * 0.2)
+    dy = jnp.asarray(rng.randn(*shape).astype("float32"))
+
+    def loss_p(x, s, b):
+        y, m, v = fused_bn.fused_bn_act(x, s, b, 1e-5, act, False)
+        return jnp.sum(y * dy), (y, m, v)
+
+    def loss_r(x, s, b):
+        y, m, v = _ref_bn(x, s, b, 1e-5, act)
+        return jnp.sum(y * dy), (y, m, v)
+
+    (lp, (yp, mp, vp)), gp = jax.value_and_grad(
+        loss_p, argnums=(0, 1, 2), has_aux=True)(x, scale, bias)
+    (lr, (yr, mr, vr)), gr = jax.value_and_grad(
+        loss_r, argnums=(0, 1, 2), has_aux=True)(x, scale, bias)
+
+    np.testing.assert_allclose(np.asarray(yp), np.asarray(yr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mp), np.asarray(mr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(vp), np.asarray(vr), atol=1e-4,
+                               rtol=1e-5)
+    for a, b, nm in zip(gp, gr, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   rtol=1e-4, err_msg=nm)
+
+
+def test_fused_bn_residual_grad():
+    rng = np.random.RandomState(1)
+    shape = (2, 16, 8, 16)
+    x = jnp.asarray(rng.randn(*shape).astype("float32"))
+    res = jnp.asarray(rng.randn(*shape).astype("float32"))
+    scale = jnp.asarray(rng.rand(16).astype("float32") + 0.5)
+    bias = jnp.zeros((16,), jnp.float32)
+    dy = jnp.asarray(rng.randn(*shape).astype("float32"))
+
+    def loss_p(x, s, b, r):
+        y, _, _ = fused_bn.fused_bn_act(x, s, b, 1e-5, "relu", True, r)
+        return jnp.sum(y * dy)
+
+    def loss_r(x, s, b, r):
+        y, _, _ = _ref_bn(x, s, b, 1e-5, "relu", residual=r)
+        return jnp.sum(y * dy)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2, 3))(x, scale, bias, res)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2, 3))(x, scale, bias, res)
+    for a, b, nm in zip(gp, gr, ("dx", "dscale", "dbias", "dres")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   rtol=1e-4, err_msg=nm)
+
+
+def test_batch_norm_layer_act_folding():
+    """batch_norm(act='relu') folds the relu into the op (no separate relu
+    op in the program) and still produces relu'd output on the default
+    lowering."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [8, 6, 6])
+        out = layers.batch_norm(xv, act="relu")
+        loss = layers.mean(out)
+    assert not any(op.type == "relu" for op in main.global_block().ops)
+    bn_ops = [op for op in main.global_block().ops if op.type == "batch_norm"]
+    assert bn_ops and bn_ops[0].attrs.get("act") == "relu"
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        x = np.random.RandomState(0).randn(4, 8, 6, 6).astype("float32")
+        got = exe.run(main, feed={"x": x}, fetch_list=[out])[0]
+    assert (got >= 0).all()
+    ref = x - x.mean(axis=(0, 2, 3), keepdims=True)
+    ref = ref / np.sqrt(x.var(axis=(0, 2, 3), keepdims=True) + 1e-5)
+    np.testing.assert_allclose(got, np.maximum(ref, 0), atol=1e-4)
