@@ -13,6 +13,7 @@ communication pattern, riding ICI.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Dict, Optional, Sequence, Tuple
 
 from ..core.program import Parameter, Program
@@ -30,19 +31,53 @@ MEGATRON_RULES: Sequence[Tuple[str, Tuple]] = (
     (r"mlm_out\.b$", ("tp",)),
 )
 
+# transformer_nmt (models/transformer_nmt.py) naming: separate q/k/v
+# projections, `o` attention output, shared ffn1/ffn2 naming, vocab-sharded
+# embeddings and output projection.
+NMT_RULES: Sequence[Tuple[str, Tuple]] = (
+    (r".*\.(q|k|v)\.w$", (None, "tp")),   # column parallel
+    (r".*\.o\.w$", ("tp", None)),         # row parallel
+    (r".*\.ffn1\.w$", (None, "tp")),
+    (r".*\.ffn1\.b$", ("tp",)),
+    (r".*\.ffn2\.w$", ("tp", None)),
+    (r"(src|tgt)_embedding$", ("tp", None)),
+    (r"out_proj\.w$", (None, "tp")),
+)
+
+# DeepFM (models/deepfm.py): the Criteo-scale tables are the only params
+# worth sharding — vocab(row)-split, the pserver-lookup-table replacement.
+DEEPFM_RULES: Sequence[Tuple[str, Tuple]] = (
+    (r"fm_emb$", ("tp", None)),
+    (r"fm_w1$", ("tp", None)),
+)
+
 
 def annotate_tp(program: Program, rules: Sequence[Tuple[str, Tuple]] = MEGATRON_RULES,
                 axis: str = "tp") -> int:
     """Attach shard_spec to matching parameters. Returns #annotated.
-    CompiledProgram.with_mesh then places them (compiler.py _state_sharding)."""
+    CompiledProgram.with_mesh then places them (compiler.py _state_sharding).
+
+    Build-time alternative: any layer accepts
+    ``param_attr=ParamAttr(shard_spec=(..., "tp"))`` — LayerHelper carries it
+    onto the Parameter directly, no rules needed (models/bert.py uses this)."""
     count = 0
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
-    for p in program.all_parameters():
+    params = list(program.all_parameters())
+    for p in params:
         for pat, spec in compiled:
             if pat.match(p.name):
                 p.shard_spec = tuple(axis if s == "tp" else s for s in spec)
                 count += 1
                 break
+    if count == 0 and params:
+        warnings.warn(
+            "annotate_tp matched ZERO of the program's "
+            f"{len(params)} parameters — the rules do not fit this model's "
+            "param names (first few: "
+            f"{[p.name for p in params[:5]]}); no tensor-parallel sharding "
+            "will be applied. Pass model-specific rules (e.g. NMT_RULES, "
+            "DEEPFM_RULES) or set ParamAttr(shard_spec=...) at build time.",
+            stacklevel=2)
     return count
 
 

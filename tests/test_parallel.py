@@ -265,3 +265,64 @@ def test_auto_mesh_shapes():
     assert m.shape["tp"] == 2 and m.shape["dp"] == 4
     m2 = auto_mesh(tp=2, pp=2)
     assert m2.shape["dp"] == 2
+
+
+def test_tensor_parallel_nmt_equality():
+    """TP=2 transformer_nmt step == unsharded step, via the generic
+    annotate_tp rules path (VERDICT r2: TP beyond the BERT regexes)."""
+    from paddle_tpu.models import transformer_nmt as nmt
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.tensor_parallel import NMT_RULES, annotate_tp
+
+    cfgkw = dict(d_model=32, n_heads=4, d_ff=64, n_enc=1, n_dec=1,
+                 src_vocab=64, tgt_vocab=64, dropout=0.0)
+    B, Ts, Tt = 4, 8, 8
+
+    def feed():
+        rng = np.random.RandomState(0)
+        causal = np.triu(np.full((Tt, Tt), -1e4, "float32"), 1)
+        return {
+            "src_ids": rng.randint(1, 64, (B, Ts)).astype("int64"),
+            "tgt_ids": rng.randint(1, 64, (B, Tt)).astype("int64"),
+            "lbl_ids": rng.randint(1, 64, (B, Tt, 1)).astype("int64"),
+            "src_mask": np.zeros((B, 1, 1, Ts), "float32"),
+            "tgt_mask": np.broadcast_to(causal, (B, 1, Tt, Tt)).copy(),
+        }
+
+    def run(tp):
+        cfg = nmt.TransformerConfig(**cfgkw)
+        main, startup, feeds, loss = nmt.build_train_program(
+            cfg, Ts, Tt, optimizer_factory=lambda: fluid.optimizer.SGD(0.05))
+        if tp:
+            n = annotate_tp(main, NMT_RULES)
+            assert n >= 8, f"NMT_RULES matched only {n} params"
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main.random_seed = 7
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            if tp:
+                mesh = make_mesh({"dp": 2, "tp": 2})
+                prog = fluid.CompiledProgram(main).with_mesh(mesh,
+                                                             data_axis="dp")
+            else:
+                prog = main
+            return [float(exe.run(prog, feed=feed(), fetch_list=[loss])[0])
+                    for _ in range(3)]
+
+    ref = run(False)
+    tp = run(True)
+    np.testing.assert_allclose(ref, tp, rtol=5e-3, atol=1e-4)
+
+
+def test_annotate_tp_warns_on_zero_matches():
+    from paddle_tpu.parallel.tensor_parallel import MEGATRON_RULES, annotate_tp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.fc(x, 4)
+    import pytest as _pytest
+    with _pytest.warns(UserWarning, match="matched ZERO"):
+        n = annotate_tp(main, MEGATRON_RULES)
+    assert n == 0
