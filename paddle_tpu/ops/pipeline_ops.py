@@ -14,9 +14,23 @@ op degrades to a sequential loop over stages (same math, no pipelining).
 """
 from __future__ import annotations
 
+import logging
+
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+
+logger = logging.getLogger(__name__)
+
+
+def _log_schedule(kind, n, m):
+    """Trace-time schedule report: GPipe ticks and bubble fraction (every
+    tick runs one full stage on every device, so idle fraction =
+    (n-1)/(m+n-1))."""
+    ticks = m + n - 1
+    logger.info("[pipeline] %s schedule: stages=%d microbatches=%d "
+                "ticks=%d bubble_fraction=%.3f", kind, n, m, ticks,
+                (n - 1) / ticks if ticks else 0.0)
 
 
 @register_op("pipeline")
@@ -104,6 +118,106 @@ def _pipeline(ctx, inputs, attrs):
         return a.reshape((m, b // m) + a.shape[1:])
 
     xs = (micro(x), *[micro(captures[i]) for i in batched])
+    _log_schedule("GPipe", n_stages, m)
     out = pipeline_step(staged_fn, stacked, xs, mesh, axis,
                         data_axis=data_axis)
     return {"Out": [out.reshape(x.shape)]}
+
+
+@register_op("pipeline_hetero")
+def _pipeline_hetero(ctx, inputs, attrs):
+    """Heterogeneous pipeline: per-stage sub-blocks with their own ops,
+    params, captures, and boundary shapes (reference heterogeneous sections,
+    section_worker.cc:141) — lowered to the lax.switch ppermute ring in
+    parallel/pipeline.pipeline_hetero, or a sequential stage loop without a
+    `pp` mesh axis."""
+    import jax as _jax
+
+    from ..core.executor import ExecContext, _run_block
+
+    (x,) = inputs["X"]
+    flat_params = inputs["Params"]
+    flat_caps = inputs.get("Captures", [])
+    blocks = attrs["sub_blocks"]
+    names = attrs["boundary_names"]
+    param_names = attrs["param_names"]      # list of per-stage name lists
+    cap_names = attrs["capture_names"]
+    n_stages = attrs["n_stages"]
+    m = attrs.get("num_microbatches", 1)
+    axis = attrs.get("axis", "pp")
+    spec = attrs.get("capture_spec") or {}
+    b = x.shape[0]
+
+    # split the flat input lists back per stage
+    ps, cs, pi, ci = [], [], 0, 0
+    for k in range(n_stages):
+        ps.append(list(flat_params[pi:pi + len(param_names[k])]))
+        cs.append(list(flat_caps[ci:ci + len(cap_names[k])]))
+        pi += len(param_names[k])
+        ci += len(cap_names[k])
+
+    def _is_batched(name, c):
+        if name in spec:
+            return spec[name] == "batched"
+        return getattr(c, "ndim", 0) >= 1 and c.shape[0] == b
+
+    base_key = ctx.rng() if not ctx.is_test else None
+
+    def make_stage(k, micro_caps: bool):
+        bnames = [n for n, c in zip(cap_names[k], cs[k]) if _is_batched(n, c)]
+        static = {n: c for n, c in zip(cap_names[k], cs[k])
+                  if n not in bnames}
+        key_k = (None if base_key is None
+                 else _jax.random.fold_in(base_key, k))
+
+        def fn(params_list, xin, cap_tuple):
+            env = dict(zip(param_names[k], params_list))
+            env.update(static)
+            env.update(zip(bnames, cap_tuple))
+            env[names[k]] = xin
+            sub = ExecContext(key_k, is_test=ctx.is_test, mesh=ctx.mesh,
+                              amp=ctx.amp)
+            _run_block(blocks[k], env, sub)
+            return env[names[k + 1]]
+        return fn, bnames
+
+    mesh = ctx.mesh
+    if mesh is None or axis not in mesh.axis_names:
+        y = x
+        for k in range(n_stages):
+            fn, bnames = make_stage(k, micro_caps=False)
+            bvals = tuple(c for n, c in zip(cap_names[k], cs[k])
+                          if n in bnames)
+            y = fn(ps[k], y, bvals)
+        return {"Out": [y]}
+
+    data_axis = attrs.get("data_axis")
+    if data_axis is not None and data_axis in mesh.axis_names \
+            and mesh.shape[data_axis] > 1:
+        import warnings
+        warnings.warn(
+            f"pipeline_hetero: heterogeneous stages run in a FULLY-manual "
+            f"shard_map, so the batch is replicated over the "
+            f"{data_axis!r}={mesh.shape[data_axis]} mesh axis (no data "
+            f"parallelism inside this pipeline). Use isomorphic stages for "
+            f"pp×dp composition, or shrink the mesh to the pp axis.",
+            stacklevel=2)
+    if b % m:
+        raise ValueError(f"pipeline_hetero: batch {b} not divisible by "
+                         f"num_microbatches {m}")
+
+    def micro(a):
+        return a.reshape((m, b // m) + a.shape[1:])
+
+    from ..parallel.pipeline import pipeline_hetero
+
+    stage_fns, caps_tree = [], []
+    for k in range(n_stages):
+        fn, bnames = make_stage(k, micro_caps=True)
+        stage_fns.append(fn)
+        caps_tree.append(tuple(
+            micro(c) for n, c in zip(cap_names[k], cs[k]) if n in bnames))
+    _log_schedule("GPipe-hetero", n_stages, m)
+    out = pipeline_hetero(stage_fns, tuple(ps), micro(x), mesh, axis,
+                          caps=tuple(caps_tree))
+    return {"Out": [jnp.reshape(out, (b,) + tuple(out.shape[2:]))]}

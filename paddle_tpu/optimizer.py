@@ -533,19 +533,58 @@ class FtrlOptimizer(Optimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """API-parity shim: inside one GSPMD program, deep gradient compression
-    (optimizer.py:799) is a bandwidth optimization for commodity
-    interconnects; on TPU ICI the dense all-reduce is already
-    near-roofline, so this behaves as Momentum. The REAL algorithm (top-k
-    select + error feedback + sparse exchange) is provided functionally for
-    DCN-connected topologies in `paddle_tpu.parallel.dgc`
-    (dgc_allreduce / sparse_allgather_exchange), convergence-tested at 95%
-    sparsity in tests/test_localsgd_dgc.py."""
+    """Deep Gradient Compression momentum (reference optimizer.py:799 +
+    sparse_all_reduce_op_handle.cc), wired into the PROGRAM path.
 
-    def __init__(self, learning_rate, momentum, rampup_begin_step=0, **kw):
-        kw.pop("rampup_step", None)
-        kw.pop("sparsity", None)
-        super().__init__(learning_rate, momentum, **kw)
+    Emits a `dgc_momentum` op per parameter implementing the reference's
+    update on the global gradient: momentum correction (u = mu·u + g;
+    v += u), top-k selection with error feedback (the unsent mass of v
+    carries over), and the sparse update p -= lr·topk(v). Before
+    `rampup_begin_step` it behaves as dense momentum; sparsity then ramps
+    through `sparsity` over `rampup_step` steps (reference schedule).
+
+    TPU note: under GSPMD the per-device partial gradients never exist as
+    program tensors (the data-parallel reduction happens inside XLA's
+    partitioned matmuls), so the sparsification applies to the GLOBAL
+    gradient — identical momentum-correction/error-feedback convergence
+    semantics, while the wire-level sparse exchange for DCN topologies
+    remains the functional `paddle_tpu.parallel.dgc` transforms
+    (dgc_allreduce / sparse_allgather_exchange)."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 clip_norm=1.0, **kw):
+        super().__init__(learning_rate, momentum,
+                         use_nesterov=use_nesterov, **kw)
+        self.type = "dgc_momentum"
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = list(sparsity)
+        self._clip_norm = float(clip_norm)  # 0 disables the local clip
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+            self._add_accumulator("dgc_residual", p)
+            self._add_accumulator("dgc_step", p, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        r = self._get_accumulator("dgc_residual", p)
+        step = self._get_accumulator("dgc_step", p)
+        return block.append_op(
+            type="dgc_momentum",
+            inputs={"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+                    "Residual": [r.name], "Step": [step.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name],
+                     "ResidualOut": [r.name], "StepOut": [step.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   "rampup_begin_step": self._rampup_begin,
+                   "rampup_step": self._rampup_step,
+                   "sparsity": self._sparsity,
+                   "clip_norm": self._clip_norm})
 
 
 class PipelineOptimizer:
@@ -611,27 +650,23 @@ class PipelineOptimizer:
                         for k in range(n_stages)]
         stage_ops = [ops[a:b] for a, b in stage_ranges]
 
-        sig0 = [op.type for op in stage_ops[0]]
-        for k, sops in enumerate(stage_ops[1:], 1):
-            if [op.type for op in sops] != sig0:
-                raise ValueError(
-                    f"pipeline stages must be isomorphic: stage {k} op "
-                    f"sequence differs from stage 0 ({[o.type for o in sops]}"
-                    f" vs {sig0})")
-            # attrs must match too — every stage executes with the stage-0
-            # template's attrs, so a per-stage dropout_prob/scale difference
-            # would be silently lost
-            for j, (o0, ok) in enumerate(zip(stage_ops[0], sops)):
-                a0 = {k2: v for k2, v in o0.attrs.items()}
-                ak = {k2: v for k2, v in ok.attrs.items()}
-                if a0.keys() != ak.keys() or any(
-                        not np.array_equal(a0[k2], ak[k2])
-                        if isinstance(a0[k2], np.ndarray)
-                        else a0[k2] != ak[k2] for k2 in a0):
-                    raise ValueError(
-                        f"pipeline stages must be isomorphic: op {j} "
-                        f"({o0.type}) attrs differ between stage 0 and "
-                        f"stage {k} — per-stage attrs cannot be pipelined")
+        # isomorphism probe (op-type sequence + attrs): isomorphic stages
+        # take the efficient stage-stacked template path; anything else
+        # lowers to the heterogeneous per-stage-sub-block path
+        # (reference section_worker.cc heterogeneous sections)
+        def _iso():
+            sig0 = [op.type for op in stage_ops[0]]
+            for sops in stage_ops[1:]:
+                if [op.type for op in sops] != sig0:
+                    return False
+                for o0, ok in zip(stage_ops[0], sops):
+                    a0, ak = o0.attrs, ok.attrs
+                    if a0.keys() != ak.keys() or any(
+                            not np.array_equal(a0[k2], ak[k2])
+                            if isinstance(a0[k2], np.ndarray)
+                            else a0[k2] != ak[k2] for k2 in a0):
+                        return False
+            return True
 
         def stage_params(sops):
             seen, out = set(), []
@@ -644,17 +679,17 @@ class PipelineOptimizer:
             return out
 
         per_stage_params = [stage_params(s) for s in stage_ops]
-        n_params = len(per_stage_params[0])
-        for k, ps in enumerate(per_stage_params):
-            if len(ps) != n_params:
-                raise ValueError(
-                    f"stage {k} has {len(ps)} params, stage 0 has {n_params}")
-            for j, (a, b) in enumerate(zip(per_stage_params[0], ps)):
-                va, vb = block.var(a), block.var(b)
-                if tuple(va.shape or ()) != tuple(vb.shape or ()):
-                    raise ValueError(
-                        f"param {j} shape mismatch across stages: "
-                        f"{a}:{va.shape} vs {b}:{vb.shape}")
+
+        def _stackable():
+            n_params = len(per_stage_params[0])
+            for ps in per_stage_params:
+                if len(ps) != n_params:
+                    return False
+                for a, b in zip(per_stage_params[0], ps):
+                    va, vb = block.var(a), block.var(b)
+                    if tuple(va.shape or ()) != tuple(vb.shape or ()):
+                        return False
+            return True
 
         # captured external activations (e.g. a shared attention mask built
         # in the prologue): read by stage ops, produced outside every stage
@@ -671,14 +706,17 @@ class PipelineOptimizer:
                 produced.update(op.output_names())
             return caps
 
-        captures = stage_captures(stage_ops[0],
-                                  set(per_stage_params[0]) | {names[0]})
-        for k, sops in enumerate(stage_ops[1:], 1):
-            got = stage_captures(sops, set(per_stage_params[k]) | {names[k]})
-            if got != captures:
-                raise ValueError(
-                    f"pipeline stages must share captured vars: stage {k} "
-                    f"captures {got}, stage 0 captures {captures}")
+        per_stage_caps = [
+            stage_captures(sops, set(per_stage_params[k]) | {names[k]})
+            for k, sops in enumerate(stage_ops)]
+        captures = per_stage_caps[0]
+
+        if not (_iso() and _stackable()
+                and all(c == captures for c in per_stage_caps[1:])):
+            return self._transform_hetero(program, block, names, stage_ops,
+                                          stage_ranges, per_stage_params,
+                                          per_stage_caps)
+        n_params = len(per_stage_params[0])
 
         # template sub-block = stage 0's ops, re-homed
         cur = program.current_block_idx
@@ -704,6 +742,45 @@ class PipelineOptimizer:
                    "in_name": names[0], "out_name": names[1],
                    "param_names": per_stage_params[0],
                    "capture_names": captures,
+                   "capture_spec": self._capture_spec})
+        block.ops[lo:hi] = [pipe_op]
+        program._bump_version()
+
+    def _transform_hetero(self, program, block, names, stage_ops,
+                          stage_ranges, per_stage_params, per_stage_caps):
+        """Non-isomorphic stages: one sub-block PER stage, lowered to the
+        lax.switch ring in parallel/pipeline.pipeline_hetero (reference
+        section_worker.cc:141 heterogeneous sections / trainer_desc.proto
+        per-section programs)."""
+        from .core.program import Operator
+
+        n_stages = len(names) - 1
+        subs = []
+        cur = program.current_block_idx
+        program.current_block_idx = block.idx
+        for sops in stage_ops:
+            sub = program.create_block()
+            program.rollback()
+            for op in sops:
+                op.block = sub
+                sub.ops.append(op)
+            subs.append(sub)
+        program.current_block_idx = cur
+
+        lo, hi = stage_ranges[0][0], stage_ranges[-1][1]
+        flat_params = [p for ps in per_stage_params for p in ps]
+        flat_caps = [c for cs in per_stage_caps for c in cs]
+        pipe_op = Operator(
+            block, "pipeline_hetero",
+            inputs={"X": [names[0]], "Params": flat_params,
+                    "Captures": flat_caps},
+            outputs={"Out": [names[-1]]},
+            attrs={"sub_blocks": subs, "n_stages": n_stages,
+                   "num_microbatches": self._m,
+                   "axis": self._axis, "data_axis": self._data_axis,
+                   "boundary_names": names,
+                   "param_names": per_stage_params,
+                   "capture_names": per_stage_caps,
                    "capture_spec": self._capture_spec})
         block.ops[lo:hi] = [pipe_op]
         program._bump_version()

@@ -26,7 +26,16 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
-def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+def shard_map(f, mesh, in_specs, out_specs, check_vma=False,
+              axis_names=None):
+    """Thin wrapper; `axis_names` (a subset of mesh axes) makes only those
+    axes manual — the rest stay under automatic GSPMD propagation inside the
+    body. That is how manual schedules (the GPipe ppermute ring) compose
+    with automatic dp/tp sharding in ONE program."""
+    if axis_names is not None:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma,
+                          axis_names=frozenset(axis_names))
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       check_vma=check_vma)
 

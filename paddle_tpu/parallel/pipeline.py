@@ -18,6 +18,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -69,7 +70,13 @@ def pipeline_step(stage_fn: Callable, stacked_params, xs, mesh: Mesh,
     == n_stages. Returns outputs [M, mb, ...].
 
     `data_axis` (optional): a mesh axis the per-microbatch batch dim is
-    sharded over — pp×dp composition; each dp shard runs its own pipeline.
+    sharded over — validated here, but the sharding itself rides GSPMD.
+
+    Only the pipeline axis is MANUAL in the shard_map; every other mesh axis
+    (dp, tp, ...) stays automatic inside the stage body, so GSPMD keeps the
+    batch dp-sharded and inserts the Megatron tp collectives for shard_spec
+    parameters — dp×tp×pp composes in one program instead of one segment
+    per axis.
 
     Constraint (GPipe over a ring): every stage's output shape must equal its
     input shape (standard for transformer blocks)."""
@@ -79,11 +86,243 @@ def pipeline_step(stage_fn: Callable, stacked_params, xs, mesh: Mesh,
             f"pipeline_step: data_axis {data_axis!r} is not a mesh axis "
             f"{mesh.axis_names} — a typo here would silently all-gather the "
             f"batch and lose data parallelism")
-    one_spec = P(None, data_axis) if data_axis is not None else P()
+    one_spec = P()
     xspec = jax.tree_util.tree_map(lambda _: one_spec, xs)
     fn = shard_map(partial(_pipe_local, stage_fn=stage_fn, axis=axis),
-                   mesh, in_specs=(pspec, xspec), out_specs=one_spec)
+                   mesh, in_specs=(pspec, xspec), out_specs=one_spec,
+                   axis_names={axis})
     return fn(stacked_params, xs)
+
+
+def _1f1b_local(params, x, caps, *, stage_fn, loss_fn, axis, n, m):
+    """Per-device 1F1B schedule (reference section_worker.cc:141's concurrent
+    sections, rebuilt as one lax.scan): each tick runs one forward microbatch
+    AND one backward microbatch (different indices), so at most 2n−1
+    microbatch activations are ever live per device — the 1F1B memory bound —
+    instead of the GPipe-through-autodiff O(m) carry.
+
+    Backward recomputes the stage forward from the saved stage INPUT
+    (activation recompute, the standard trade), so only ring inputs are
+    buffered. Timeline: device i fwds microbatch f at tick t=f+i and bwds
+    microbatch b at t=b+n+(n−1−i); total ticks m+2n−1.
+    """
+    tmap = jax.tree_util.tree_map
+    idx = lax.axis_index(axis)
+    K = 2 * n - 1                       # in-flight residual slots
+    params1 = tmap(lambda p: jnp.squeeze(p, 0), params)
+
+    def at(tree, i):
+        return tmap(lambda a: lax.dynamic_index_in_dim(a, i, 0,
+                                                       keepdims=False), tree)
+
+    def stage_x(p, xleaf, cap):
+        return stage_fn(p, (xleaf, *cap))[0]
+
+    mb_shape = jax.eval_shape(lambda a: at(a, 0), x)
+
+    def tick(carry, t):
+        fwd_in, cot_in, prev_out, resid, grads, loss_acc = carry
+
+        # ---- last stage turns yesterday's forward into a cotangent ----
+        lmb = t - n                       # prev_out's microbatch at stage n-1
+        lvalid = jnp.logical_and(lmb >= 0, lmb < m)
+        lval, dout = jax.value_and_grad(loss_fn)(prev_out)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(idx == n - 1, lvalid), lval / m, 0.0)
+
+        # ---- backward of microbatch b = t - n - (n-1-idx) ----
+        # (reads its residual BEFORE this tick's forward overwrites the
+        # slot: at device 0, microbatch f and f-K share a slot on the same
+        # tick — read-before-write keeps K at 2n-1)
+        b = t - n - (n - 1 - idx)
+        bvalid = jnp.logical_and(b >= 0, b < m)
+        bc = jnp.clip(b, 0, m - 1)
+        inp_b = lax.dynamic_index_in_dim(resid, bc % K, 0, keepdims=False)
+        cap_b = at(caps, bc)
+        cot = jnp.where(idx == n - 1, dout / m, cot_in)
+        _, vjp_fn = jax.vjp(stage_x, params1, inp_b, cap_b)
+        dparams, dinp, _ = vjp_fn(cot)
+        grads = tmap(lambda g, d: g + jnp.where(bvalid, d, 0.0),
+                     grads, dparams)
+
+        # ---- forward of microbatch f = t - idx ----
+        f = t - idx
+        fvalid = jnp.logical_and(f >= 0, f < m)
+        fc = jnp.clip(f, 0, m - 1)
+        inp = jnp.where(idx == 0, at(x, fc), fwd_in)
+        cap_f = at(caps, fc)
+        out = stage_x(params1, inp, cap_f)
+        upd = lax.dynamic_update_index_in_dim(resid, inp, fc % K, 0)
+        resid = jnp.where(fvalid, upd, resid)
+
+        # ---- rings: activations forward, cotangents backward ----
+        fwd_next = lax.ppermute(out, axis, [(i, (i + 1) % n)
+                                            for i in range(n)])
+        cot_next = lax.ppermute(dinp, axis, [(i, (i - 1) % n)
+                                             for i in range(n)])
+        return (fwd_next, cot_next, out, resid, grads, loss_acc), None
+
+    zeros_mb = jnp.zeros(mb_shape.shape, mb_shape.dtype)
+    init = (zeros_mb, zeros_mb, zeros_mb,
+            jnp.zeros((K,) + mb_shape.shape, mb_shape.dtype),
+            tmap(jnp.zeros_like, params1),
+            jnp.float32(0.0))
+    carry, _ = lax.scan(tick, init, jnp.arange(m + 2 * n - 1))
+    grads, loss_acc = carry[4], carry[5]
+    loss = lax.psum(jnp.where(idx == n - 1, loss_acc, 0.0), axis)
+    grads = tmap(lambda g: jnp.expand_dims(g, 0), grads)
+    return loss, grads
+
+
+def pipeline_1f1b(stage_fn, stacked_params, xs, loss_fn, mesh: Mesh,
+                  axis: str = "pp"):
+    """1F1B pipelined train step: returns (loss, grads, info).
+
+    stage_fn(params, payload) -> payload, payload = (x, *captures) with x
+    the [mb, ...] ring value (stage output shape == input shape, as for
+    GPipe). xs: payload pytree of [m, mb, ...] microbatch arrays — the
+    first leaf rides the ppermute ring; the remaining leaves (masks etc.)
+    are indexed per microbatch and do not travel. loss_fn maps the last
+    stage's [mb, ...] output to a scalar; total loss is the mean over
+    microbatches, and grads match stacked_params' [n_stages, ...] layout.
+
+    info reports the schedule: ticks = m+2n−1; every tick runs one masked
+    fwd + one masked bwd, so the bubble fraction is (2n−1)/(m+2n−1) and at
+    most 2n−1 microbatch inputs are resident per device (the 1F1B point —
+    GPipe-through-autodiff buffers all m).
+    """
+    n = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves(xs)
+    m = leaves[0].shape[0]
+    x, caps = leaves[0], tuple(leaves[1:])
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    fn = shard_map(
+        partial(_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn, axis=axis,
+                n=n, m=m),
+        mesh,
+        in_specs=(pspec, P(), jax.tree_util.tree_map(lambda _: P(), caps)),
+        out_specs=(P(), pspec),
+        axis_names={axis})
+    loss, grads = fn(stacked_params, x, caps)
+    info = {"ticks": m + 2 * n - 1,
+            "bubble_fraction": (2 * n - 1) / (m + 2 * n - 1),
+            "max_inflight_microbatches": 2 * n - 1}
+    return loss, grads, info
+
+
+def _flat_pad(v, pay):
+    """[mb, ...] -> [mb, pay] (zero-padded flat payload)."""
+    f = v.reshape(v.shape[0], -1)
+    return jnp.pad(f, ((0, 0), (0, pay - f.shape[1])))
+
+
+def _hetero_local(all_params, x, caps, *, stage_fns, in_shapes, out_shape,
+                  axis, n, m, pay):
+    """Per-device GPipe ring over NON-isomorphic stages: lax.switch picks
+    this device's stage; the ring payload is a flat zero-padded [mb, pay]
+    buffer so stages with different boundary shapes share one ppermute.
+
+    Reference analog: heterogeneous trainer sections with per-section
+    programs (section_worker.cc:141, trainer_desc.proto:66-84)."""
+    idx = lax.axis_index(axis)
+
+    def branch(i):
+        shp = in_shapes[i]
+        size = int(np.prod(shp[1:])) if len(shp) > 1 else 1
+
+        def run(operand):
+            buf, fc = operand
+            xin = buf[:, :size].reshape(shp)
+            cap_i = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, fc, 0, keepdims=False),
+                caps[i])
+            y = stage_fns[i](all_params[i], xin, cap_i)
+            return _flat_pad(y, pay)
+        return run
+
+    branches = [branch(i) for i in range(n)]
+
+    def tick(carry, t):
+        buf_in, outbuf = carry
+        fc = jnp.clip(t - idx, 0, m - 1)
+        x_t = lax.dynamic_index_in_dim(x, fc, 0, keepdims=False)
+        inp = jnp.where(idx == 0, _flat_pad(x_t, pay), buf_in)
+        out = lax.switch(idx, branches, (inp, fc))
+        pos = t - (n - 1)
+        osz = int(np.prod(out_shape[1:]))
+        write = jnp.logical_and(idx == n - 1, pos >= 0)
+        upd = lax.dynamic_update_index_in_dim(
+            outbuf, out[:, :osz].reshape(out_shape),
+            jnp.clip(pos, 0, m - 1), 0)
+        outbuf = jnp.where(write, upd, outbuf)
+        nxt = lax.ppermute(out, axis, [(i, (i + 1) % n) for i in range(n)])
+        return (nxt, outbuf), None
+
+    init = (jnp.zeros((in_shapes[0][0], pay), x.dtype),
+            jnp.zeros((m,) + out_shape, x.dtype))
+    (_, outbuf), _ = lax.scan(tick, init, jnp.arange(m + n - 1))
+    return lax.psum(jnp.where(idx == n - 1, outbuf,
+                              jnp.zeros_like(outbuf)), axis)
+
+
+def pipeline_hetero(stage_fns, per_stage_params, xs, mesh: Mesh,
+                    axis: str = "pp", caps=None):
+    """GPipe over heterogeneous stages (different ops, params, and boundary
+    shapes per stage — the reference's per-section programs).
+
+    stage_fns[i](params_i, x, caps_i) -> y; boundary shapes are inferred by
+    shape-chaining eval_shape through the stages. xs: [m, mb, ...]
+    microbatches of stage 0's input; caps (optional): per-stage pytrees of
+    [m, ...] per-microbatch side inputs (indexed, not ring-carried). All
+    boundary tensors must share xs' dtype (the flat ring payload). Params
+    ride replicated over the pipeline axis (capability over memory:
+    heterogeneous trees cannot be stage-stacked); other mesh axes stay
+    automatic, so dp/tp sharding still applies inside stages.
+
+    Differentiable end-to-end: grads of every stage's params flow through
+    the switch + ring via ordinary autodiff. The shard_map is FULLY manual
+    (transposes of partial-manual shard_maps with replicated params deadlock
+    XLA-CPU collectives as of jax 0.9), so non-pipeline mesh axes see
+    replicated compute here — compose dp by batching microbatches instead."""
+    n = len(stage_fns)
+    if n != mesh.shape[axis]:
+        raise ValueError(
+            f"pipeline_hetero: {n} stages but mesh axis {axis!r} has "
+            f"{mesh.shape[axis]} devices")
+    m = xs.shape[0]
+    if caps is None:
+        caps = tuple(() for _ in range(n))
+    mb_shape = tuple(xs.shape[1:])
+    shapes = [mb_shape]
+    for i in range(n):
+        cap0 = jax.tree_util.tree_map(
+            lambda a: jax.eval_shape(lambda v: v[0], a), caps[i])
+        out = jax.eval_shape(
+            lambda p, v, c, _i=i: stage_fns[_i](p, v, c),
+            per_stage_params[i],
+            jax.ShapeDtypeStruct(shapes[-1], xs.dtype), cap0)
+        if out.dtype != xs.dtype:
+            raise ValueError(
+                f"pipeline_hetero: stage {i} output dtype {out.dtype} != "
+                f"payload dtype {xs.dtype}")
+        if out.shape[0] != mb_shape[0]:
+            raise ValueError(
+                f"pipeline_hetero: stage {i} changed the microbatch dim "
+                f"({out.shape[0]} vs {mb_shape[0]})")
+        shapes.append(tuple(out.shape))
+    pay = max(int(np.prod(s[1:])) for s in shapes)
+    in_shapes = shapes[:-1]
+    out_shape = shapes[-1]
+
+    pspec = jax.tree_util.tree_map(lambda _: P(), per_stage_params)
+    cspec = jax.tree_util.tree_map(lambda _: P(), caps)
+    fn = shard_map(
+        partial(_hetero_local, stage_fns=stage_fns, in_shapes=in_shapes,
+                out_shape=out_shape, axis=axis, n=n, m=m, pay=pay),
+        mesh,
+        in_specs=(pspec, P(), cspec),
+        out_specs=P())
+    return fn(per_stage_params, xs, caps)
 
 
 class GPipe:

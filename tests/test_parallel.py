@@ -326,3 +326,75 @@ def test_annotate_tp_warns_on_zero_matches():
     with _pytest.warns(UserWarning, match="matched ZERO"):
         n = annotate_tp(main, MEGATRON_RULES)
     assert n == 0
+
+
+def test_composed_dp_tp_pp_single_program():
+    """ONE program over a dp×tp×pp mesh at 8 devices (VERDICT r2 #4): GPipe
+    ring manual on pp, GSPMD automatic dp batch sharding + Megatron tp on
+    the same step. Loss-equality vs the plain single-device program."""
+    from paddle_tpu import layers
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import make_mesh
+
+    micro = 2
+    B, T = 4, 8
+
+    def build(tp_axis):
+        cfg = bert.BertConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                              num_heads=2, ffn_size=32, max_position=16,
+                              hidden_dropout=0.0, attn_dropout=0.0,
+                              use_flash_attention=False, tp_axis=tp_axis)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            src = layers.data("src_ids", [T], dtype="int64")
+            pos = layers.data("pos_ids", [T], dtype="int64")
+            sent = layers.data("sent_ids", [T], dtype="int64")
+            mask = layers.data("input_mask", [T], dtype="float32")
+            lab = layers.data("mlm_labels", [T, 1], dtype="int64")
+            neg = layers.scale(layers.elementwise_add(
+                mask, layers.fill_constant([1], "float32", -1.0)),
+                scale=10000.0)
+            mask3 = layers.unsqueeze(neg, [1])
+            emb = bert.embeddings(cfg, src, pos, sent, is_test=False)
+            cuts = [emb]
+            x = emb
+            for i in range(cfg.num_layers):
+                x = bert.encoder_layer(cfg, x, mask3, i, is_test=False)
+                cuts.append(x)
+            loss = bert.bert_pretrain_loss(cfg, x, lab, mask)
+            if tp_axis:
+                opt = fluid.optimizer.PipelineOptimizer(
+                    fluid.optimizer.SGD(0.05), cut_list=cuts,
+                    num_microbatches=micro, data_axis="dp")
+            else:
+                opt = fluid.optimizer.SGD(0.05)
+            opt.minimize(loss)
+        return main, startup, loss
+
+    def feed():
+        rng = np.random.RandomState(0)
+        return {"src_ids": rng.randint(0, 64, (B, T)).astype("int64"),
+                "pos_ids": np.tile(np.arange(T), (B, 1)).astype("int64"),
+                "sent_ids": np.zeros((B, T), "int64"),
+                "input_mask": np.ones((B, T), "float32"),
+                "mlm_labels": rng.randint(0, 64, (B, T, 1)).astype("int64")}
+
+    def run(composed):
+        main, startup, loss = build("tp" if composed else None)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main.random_seed = 7
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            if composed:
+                mesh = make_mesh({"dp": 2, "tp": 2, "pp": 2})
+                prog = fluid.CompiledProgram(main).with_mesh(mesh,
+                                                             data_axis="dp")
+            else:
+                prog = main
+            return [float(exe.run(prog, feed=feed(), fetch_list=[loss])[0])
+                    for _ in range(3)]
+
+    ref = run(False)
+    got = run(True)
+    np.testing.assert_allclose(ref, got, rtol=5e-3, atol=1e-4)
