@@ -50,6 +50,86 @@ def _momentum(ctx, inputs, attrs):
     return {"ParamOut": [p_out], "VelocityOut": [v_out]}
 
 
+@register_op("dgc_momentum", differentiable=False)
+def _dgc_momentum(ctx, inputs, attrs):
+    """DGC momentum (reference optimizer.py:799 math, program path):
+    momentum-correct into the send buffer, top-k select with error
+    feedback, sparse parameter update. Dense momentum until
+    rampup_begin_step; sparsity then steps through attrs['sparsity'] over
+    rampup_step steps. Static shapes throughout: the top-k size is the
+    FINAL sparsity's k, with the looser early-rampup thresholds applied as
+    a magnitude cutoff mask (each compile sees one k)."""
+    (p,) = inputs["Param"]
+    (g,) = inputs["Grad"]
+    (v,) = inputs["Velocity"]
+    (r,) = inputs["Residual"]
+    (step,) = inputs["Step"]
+    mu = attrs["mu"]
+    lr = _lr(inputs)
+    sparsity = list(attrs.get("sparsity", [0.999]))
+    rampup_begin = attrs.get("rampup_begin_step", 0)
+    rampup_step = max(1, attrs.get("rampup_step", 1))
+    g = g.astype(p.dtype)
+    dense_phase = step.reshape(()) < rampup_begin
+
+    # DGC local gradient clipping (paper §3.2 / reference dgc_clip_by_norm):
+    # without it, coordinates that wait ~1/ratio steps between sends
+    # accumulate unbounded momentum mass and the sparse update diverges.
+    # SPARSE phase only — the dense rampup must behave exactly like plain
+    # momentum. clip_norm=0 disables.
+    clip = attrs.get("clip_norm", 1.0)
+    if clip:
+        gn = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        g_clipped = (g * jnp.minimum(1.0, clip / (gn + 1e-12))).astype(
+            p.dtype)
+        g = jnp.where(dense_phase, g, g_clipped)
+
+    # momentum correction: local momentum feeds the send buffer
+    v_out = mu * v + g
+    u = r + v_out
+
+    flat = u.reshape(-1)
+    n = flat.shape[0]
+    final_ratio = 1.0 - sparsity[-1]
+    k = max(1, int(n * final_ratio))
+    vals, idx = lax.top_k(jnp.abs(flat), k)
+
+    # rampup: current sparsity stage by step count (traced select over the
+    # static schedule keeps one compilation)
+    stage = jnp.clip((step.reshape(()) - rampup_begin)
+                     // max(1, rampup_step // max(1, len(sparsity))),
+                     0, len(sparsity) - 1).astype(jnp.int32)
+    ratios = jnp.asarray([1.0 - s for s in sparsity], jnp.float32)
+    cur_ratio = ratios[stage]
+    # keep the top cur_ratio·n entries of the top-k candidates: entries
+    # ranked beyond cur_ratio·n are masked out (vals is sorted descending)
+    rank = jnp.arange(k, dtype=jnp.float32)
+    keep = (rank < jnp.maximum(1.0, cur_ratio * n)).astype(p.dtype)
+
+    mask = jnp.zeros_like(flat).at[idx].set(keep)
+    mask = jnp.where(dense_phase, jnp.ones_like(mask), mask)
+    sparse = (flat * mask).reshape(p.shape)
+    r_out = (flat * (1.0 - mask)).reshape(p.shape)
+
+    # momentum factor masking (DGC paper §3.2 / reference dgc_op.cc): clear
+    # the velocity at SENT positions too, else stale momentum keeps pushing
+    # a coordinate long after its accumulated mass was applied — measured
+    # divergence without this. Dense phase keeps the full velocity.
+    vel_keep = jnp.where(dense_phase, jnp.ones_like(mask), 1.0 - mask)
+    v_out = (v_out.reshape(-1) * vel_keep).reshape(p.shape)
+
+    if attrs.get("use_nesterov", False):
+        # dense phase must match the momentum op's Nesterov exactly:
+        # p − lr·(g + mu·v'); sparse phase applies the selected mass only
+        # (Nesterov lookahead is undefined for coordinates not sent)
+        p_out = p - lr * jnp.where(dense_phase, g + mu * v_out, sparse)
+    else:
+        p_out = p - lr * sparse
+    return {"ParamOut": [p_out], "VelocityOut": [v_out],
+            "ResidualOut": [r_out],
+            "StepOut": [step + jnp.ones_like(step)]}
+
+
 @register_op("lars_momentum", differentiable=False)
 def _lars_momentum(ctx, inputs, attrs):
     """lars_momentum_op.cc: layer-wise adaptive rate scaling."""
