@@ -233,6 +233,11 @@ def read_bundle(path: str):
                 return None
             shape = tuple(dims_buf[d] for d in range(ndim.value))
             arr = np.empty(shape, dtype=np.dtype(dtype_buf.value.decode()))
+            if nbytes != arr.nbytes:
+                # truncated/corrupt entry: the C side only rejects
+                # nbytes > capacity, so a SHORT payload would otherwise
+                # fill part of np.empty and return uninitialized tail bytes
+                return None
             buf = arr if arr.nbytes else np.empty(1, np.uint8)
             if lib.ptck_entry_data(
                     h, i, buf.ctypes.data_as(ctypes.c_void_p),

@@ -1,0 +1,95 @@
+"""Shard-parallel checkpoint runner (VERDICT r2 #7).
+
+Modes:
+  --save DIR     train 2 steps with a tp-sharded parameter, save a sharded
+                 checkpoint (each process writes only its addressable
+                 replica-0 shards + a JSON index), print the full param sum.
+  --restore DIR  restore into a fresh scope, print the loaded param sum.
+
+Runs either single-process (8 local CPU devices) or as a 2-process
+jax.distributed cluster under paddle_tpu.distributed.launch — save under one
+topology, restore under the other (reshardable across process counts).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    import paddle_tpu as fluid
+    from paddle_tpu.initializer import NumpyArrayInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        w = (np.random.RandomState(5).rand(16, 8).astype("float32") - 0.5)
+        logits = fluid.layers.fc(
+            x, 8, bias_attr=False,
+            param_attr=ParamAttr(name="w_tp",
+                                 initializer=NumpyArrayInitializer(w),
+                                 shard_spec=(None, "tp")))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main_entry():
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.checkpoint import Checkpointer
+
+    mode = sys.argv[1]
+    ckdir = sys.argv[2]
+    multi = "PADDLE_TRAINER_ID" in os.environ
+    if multi:
+        from paddle_tpu.parallel import env as penv
+        penv.init_parallel_env()
+    rank = jax.process_index()
+
+    main, startup, loss = build()
+    mesh = make_mesh({"tp": 2, "dp": jax.device_count() // 2})
+    prog = fluid.CompiledProgram(main).with_mesh(mesh, data_axis="dp")
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 16).astype("float32"),
+            "y": rng.randint(0, 8, (8, 1)).astype("int64")}
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        ck = Checkpointer(ckdir, keep=0)
+        if mode == "--save":
+            for _ in range(2):
+                exe.run(prog, feed=feed, fetch_list=[loss])
+            w = scope.find_var("w_tp")
+            # a sharded array spanning processes can't be fetched directly —
+            # reduce on device (replicated scalar result)
+            import jax.numpy as jnp
+            wsum = float(jax.jit(lambda a: jnp.sum(a.astype(jnp.float64)))(w))
+            ck.save(7, program=main, scope=scope, blocking=True)
+            print(json.dumps({"rank": rank, "mode": "save", "wsum": wsum}))
+        else:
+            step = ck.restore(program=main, scope=scope)
+            w = np.asarray(scope.find_var("w_tp"), dtype=np.float64)
+            # run one step under THIS topology to prove the restored host
+            # arrays lift into the new mesh's shardings
+            out = exe.run(prog, feed=feed, fetch_list=[loss])
+            print(json.dumps({"rank": rank, "mode": "restore", "step": step,
+                              "wsum": float(w.sum()),
+                              "loss": float(np.asarray(out[0]))}))
+
+
+if __name__ == "__main__":
+    main_entry()

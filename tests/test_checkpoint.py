@@ -1,6 +1,8 @@
 """Reshardable + async checkpointing (beats the reference: io.py:487 has no
 resharding — SURVEY §5 bar). Save under mesh A (dp=8), restore under mesh B
 (dp=4 × tp=2), loss continuity vs an uninterrupted run."""
+import os
+
 import numpy as np
 
 import paddle_tpu as fluid
@@ -200,3 +202,74 @@ def test_native_bundle_backend(tmp_path):
         assert got == 9
         np.testing.assert_allclose(
             np.asarray(fluid.global_scope().find_var("w0")), w)
+
+
+def test_shard_parallel_checkpoint_across_process_counts(tmp_path):
+    """2-proc sharded save -> 1-proc restore and 1-proc save -> 2-proc
+    restore (VERDICT r2 #7): per-rank shard+index files, no full-array
+    gather on save, restore assembles under any topology."""
+    import json as _json
+    import socket
+    import subprocess
+    import sys as _sys
+
+    runner = os.path.join(os.path.dirname(__file__), "dist_ckpt_runner.py")
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def run_single(mode, ckdir):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        out = subprocess.run([_sys.executable, "-u", runner, mode, ckdir],
+                             capture_output=True, text=True, timeout=300,
+                             env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return _json.loads(out.stdout.strip().splitlines()[-1])
+
+    def run_cluster(mode, ckdir, logdir):
+        from paddle_tpu.distributed import launch
+        env_backup = dict(os.environ)
+        for k in list(os.environ):
+            if k.startswith(("PADDLE_", "XLA_", "JAX_")):
+                del os.environ[k]
+        try:
+            procs, fds = launch.start_procs(
+                2, runner, [mode, ckdir], started_port=free_port(),
+                log_dir=str(logdir))
+            rc = launch.wait_procs(procs, fds)
+        finally:
+            os.environ.clear()
+            os.environ.update(env_backup)
+        logs = {}
+        for rank in range(2):
+            text = (logdir / f"workerlog.{rank}").read_text()
+            assert rc == 0, f"rank{rank} log:\n{text[-2000:]}"
+            line = [l for l in text.splitlines() if l.startswith("{")][-1]
+            logs[rank] = _json.loads(line)
+        return logs
+
+    # --- 2-proc save -> 1-proc restore -----------------------------------
+    ck1 = tmp_path / "ck_2to1"
+    logs = run_cluster("--save", str(ck1), tmp_path / "log_save")
+    # both ranks wrote a shard file + index (tp axis spans the processes)
+    for r in range(2):
+        assert (ck1 / f"ckpt-7.shards-{r}.pkl").exists()
+        idx = _json.loads((ck1 / f"ckpt-7.index-{r}.json").read_text())
+        assert "w_tp" in idx and len(idx["w_tp"]["shards"]) >= 1
+    got = run_single("--restore", str(ck1))
+    assert got["step"] == 7
+    np.testing.assert_allclose(got["wsum"], logs[0]["wsum"], rtol=1e-6)
+    assert np.isfinite(got["loss"])
+
+    # --- 1-proc save -> 2-proc restore -----------------------------------
+    ck2 = tmp_path / "ck_1to2"
+    saved = run_single("--save", str(ck2))
+    logs2 = run_cluster("--restore", str(ck2), tmp_path / "log_restore")
+    for r in range(2):
+        assert logs2[r]["step"] == 7
+        np.testing.assert_allclose(logs2[r]["wsum"], saved["wsum"],
+                                   rtol=1e-6)
