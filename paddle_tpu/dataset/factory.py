@@ -137,10 +137,15 @@ class InMemoryDataset(QueueDataset):
 
     def global_shuffle(self, fleet=None, thread_num: int = 12):
         """Reference routes records between trainers via fleet RPC
-        (data_set.cc GlobalShuffle). TPU-native: each host keeps the hash-mod
-        shard of a deterministic permutation — no network hop, same
-        statistical effect. Sharding happens once; subsequent calls reshuffle
-        the local shard with an epoch-varied seed."""
+        (data_set.cc GlobalShuffle, data_set.h:165). Multi-trainer here does
+        the same over a TCP all-to-all shuffle service: every record is
+        hash-routed by content (+epoch salt) to its destination trainer, so
+        records a trainer never loaded can land on it — the true cross-
+        trainer semantics, not a local partition. Collective contract: all
+        trainers must call global_shuffle together (as in the reference).
+
+        Single-process falls back to keeping the hash-mod shard of a
+        deterministic permutation (no network hop, same statistics)."""
         if self._memory is None:
             raise RuntimeError("call load_into_memory() first")
         import jax
@@ -151,6 +156,26 @@ class InMemoryDataset(QueueDataset):
             nranks, rank = 1, 0
         self._shuffle_epoch = getattr(self, "_shuffle_epoch", 0) + 1
         rng = random.Random(12345 + self._shuffle_epoch)
+        if nranks > 1:
+            from .shuffle_service import exchange_records
+            import hashlib as _hl
+            import pickle as _pkl
+            # deterministic routing (md5, not the per-process-salted
+            # builtin hash) keyed by (content, local position, epoch) —
+            # duplicates spread instead of piling onto one trainer, and a
+            # relaunched job reproduces the same distribution
+            buckets = [[] for _ in range(nranks)]
+            for i, rec in enumerate(self._memory):
+                digest = _hl.md5(
+                    _pkl.dumps((rec, i, rank, self._shuffle_epoch),
+                               protocol=4)).digest()
+                h = int.from_bytes(digest[:8], "little")
+                buckets[h % nranks].append(rec)
+            self._memory = exchange_records(buckets, rank, nranks)
+            rng = random.Random(12345 + self._shuffle_epoch + rank)
+            rng.shuffle(self._memory)
+            self._sharded = True
+            return
         if not getattr(self, "_sharded", False):
             order = list(range(len(self._memory)))
             rng.shuffle(order)

@@ -1,0 +1,33 @@
+"""Cross-trainer global shuffle runner (VERDICT r2 #9): each rank loads a
+DISJOINT set of records; after global_shuffle every record must live on
+exactly one rank, chosen by content hash — records cross the process
+boundary, unlike a local partition."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    from paddle_tpu.dataset import factory
+    from paddle_tpu.parallel import env as penv
+
+    penv.init_parallel_env()
+    rank = jax.process_index()
+
+    ds = factory.InMemoryDataset()
+    # disjoint per-rank records: rank 0 loads 0..39, rank 1 loads 40..79
+    ds._memory = [(f"rec-{i}", i) for i in range(rank * 40, rank * 40 + 40)]
+    ds.global_shuffle()
+    ids = sorted(i for _, i in ds._memory)
+    print(json.dumps({"rank": rank, "ids": ids}))
+
+
+if __name__ == "__main__":
+    main()
