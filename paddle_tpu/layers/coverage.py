@@ -402,9 +402,11 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     w = helper.create_parameter(
         param_attr, shape=[c, num_filters // groups, fd, fh, fw],
         dtype=input.dtype)
-    out = _emit("conv3d_transpose", {"Input": input, "Filter": w},
-                {"strides": _t(stride), "paddings": _t(padding),
-                 "dilations": _t(dilation), "groups": groups})
+    attrs = {"strides": _t(stride), "paddings": _t(padding),
+             "dilations": _t(dilation), "groups": groups}
+    if output_size is not None:
+        attrs["output_size"] = _t(output_size)
+    out = _emit("conv3d_transpose", {"Input": input, "Filter": w}, attrs)
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, shape=[num_filters],
                                     dtype=input.dtype, is_bias=True)

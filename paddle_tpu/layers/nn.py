@@ -126,6 +126,26 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      stride=1, padding=0, dilation=1, groups=1, param_attr=None,
                      bias_attr=None, act=None, name=None) -> Variable:
     helper = LayerHelper("conv2d_transpose", name=name)
+    if filter_size is None:
+        # reference rule: infer the kernel from output_size
+        # (out = (in−1)·stride − 2·pad + dil·(f−1) + 1)
+        if output_size is None or input.shape is None or len(input.shape) != 4:
+            raise ValueError(
+                "conv2d_transpose: filter_size is required unless "
+                "output_size is given and the input has static NCHW shape "
+                "metadata to infer it from")
+        st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+        osz = _pair(output_size)
+        filter_size = []
+        for i in range(2):
+            num = osz[i] - (input.shape[2 + i] - 1) * st[i] + 2 * pd[i] - 1
+            if num % dl[i] or num < 0:
+                raise ValueError(
+                    f"conv2d_transpose: no integer filter_size yields "
+                    f"output_size[{i}]={osz[i]} from input "
+                    f"{input.shape[2 + i]} with stride {st[i]}, padding "
+                    f"{pd[i]}, dilation {dl[i]}")
+            filter_size.append(num // dl[i] + 1)
     fh, fw = _pair(filter_size)
     num_channels = input.shape[1]
     w = helper.create_parameter(param_attr, shape=[num_channels, num_filters // groups, fh, fw],
@@ -560,21 +580,32 @@ def nce(input: Variable, label: Variable, num_total_classes: int,
         num_neg_samples: int = 10, name=None, sampler: str = "uniform",
         custom_dist=None, seed: int = 0, is_sparse: bool = False) -> Variable:
     """Noise-contrastive estimation loss (reference layers/nn.py nce →
-    nce_op.cc). Uniform negative sampler; returns per-row cost [B, 1]."""
-    if sampler != "uniform":
-        raise NotImplementedError(
-            f"nce: only the uniform sampler is implemented (got "
-            f"{sampler!r}); log_uniform/custom_dist change the NCE noise "
-            f"correction and must not be silently substituted")
-    if custom_dist is not None or sample_weight is not None:
-        raise NotImplementedError(
-            "nce: custom_dist / sample_weight are not supported")
+    nce_op.cc). Samplers: uniform, log_uniform (Zipfian), custom_dist (a
+    probability list over classes) — each with its own noise correction
+    (nce_op.h:51). Returns per-row cost [B, 1]."""
+    samplers = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}
+    if sampler not in samplers:
+        raise ValueError(f"nce: unknown sampler {sampler!r}; "
+                         f"choose from {sorted(samplers)}")
+    if sampler == "custom_dist" and custom_dist is None:
+        raise ValueError("nce: sampler='custom_dist' needs custom_dist")
+    if custom_dist is not None and sampler != "custom_dist":
+        raise ValueError(
+            f"nce: custom_dist was given but sampler={sampler!r} — it "
+            f"would be silently ignored; pass sampler='custom_dist'")
+    if sample_weight is not None:
+        raise NotImplementedError("nce: sample_weight is not supported")
     helper = LayerHelper("nce", name=name)
     dim = input.shape[-1]
     w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
                                 dtype=input.dtype)
     inputs = {"Input": [input.name], "Weight": [w.name],
               "Label": [label.name]}
+    if sampler == "custom_dist":
+        from . import tensor as _tensor
+        probs = _tensor.assign(
+            np.asarray(custom_dist, dtype="float32").reshape(-1))
+        inputs["CustomDistProbs"] = [probs.name]
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, shape=[num_total_classes],
                                     dtype=input.dtype, is_bias=True)
@@ -590,7 +621,7 @@ def nce(input: Variable, label: Variable, num_total_classes: int,
                  "SampleLabels": [sample_labels.name]},
         attrs={"num_total_classes": num_total_classes,
                "num_neg_samples": num_neg_samples, "seed": seed,
-               "sampler": 0 if sampler == "uniform" else 1,
+               "sampler": samplers[sampler],
                "is_sparse": is_sparse})
     return cost
 
@@ -599,17 +630,21 @@ def hsigmoid(input: Variable, label: Variable, num_classes: int,
              param_attr=None, bias_attr=None, name=None,
              path_table=None, path_code=None, is_custom: bool = False,
              is_sparse: bool = False) -> Variable:
-    """Hierarchical sigmoid over the default complete binary tree
-    (reference layers/nn.py hsigmoid → hierarchical_sigmoid_op.cc)."""
-    if is_custom or path_table is not None or path_code is not None:
-        raise NotImplementedError(
-            "hsigmoid: custom trees (is_custom/path_table/path_code) are "
-            "not implemented — only the default complete binary tree")
+    """Hierarchical sigmoid (reference layers/nn.py hsigmoid →
+    hierarchical_sigmoid_op.cc): default complete binary tree, or a custom
+    tree via `path_table`/`path_code` [B, L] variables (node ids with −1
+    padding / branch bits — matrix_bit_code.h CustomCode)."""
+    if is_custom and (path_table is None or path_code is None):
+        raise ValueError("hsigmoid: is_custom=True needs both path_table "
+                         "and path_code")
     helper = LayerHelper("hierarchical_sigmoid", name=name)
     dim = input.shape[-1]
     w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
                                 dtype=input.dtype)
     inputs = {"X": [input.name], "W": [w.name], "Label": [label.name]}
+    if path_table is not None:
+        inputs["PathTable"] = [path_table.name]
+        inputs["PathCode"] = [path_code.name]
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, shape=[num_classes - 1],
                                     dtype=input.dtype, is_bias=True)

@@ -44,20 +44,33 @@ def _hsig_paths(num_classes: int):
     return jnp.asarray(idx), jnp.asarray(bit), jnp.asarray(msk)
 
 
-@register_op("hierarchical_sigmoid", nondiff_inputs=["Label"])
+@register_op("hierarchical_sigmoid",
+             nondiff_inputs=["Label", "PathTable", "PathCode"])
 def _hierarchical_sigmoid(ctx, inputs, attrs):
-    """hierarchical_sigmoid_op.cc (default complete tree): loss_i =
-    Σ_path softplus((1 − 2·bit)·(w_node·x_i + b_node))."""
+    """hierarchical_sigmoid_op.cc: loss_i =
+    Σ_path softplus((1 − 2·bit)·(w_node·x_i + b_node)).
+
+    Default complete binary tree from the label, OR a CUSTOM tree
+    (matrix_bit_code.h CustomCode) via PathTable [B, L] (node ids, −1 pad)
+    and PathCode [B, L] (branch bits)."""
     (x,) = inputs["X"]
     (w,) = inputs["W"]                     # [num_classes-1, D]
     (label,) = inputs["Label"]
     bias = inputs.get("Bias")
+    ptable = inputs.get("PathTable", [None])[0]
+    pcode = inputs.get("PathCode", [None])[0]
     num_classes = int(attrs["num_classes"])
-    idx_t, bit_t, msk_t = _hsig_paths(num_classes)
-    lab = label.reshape(-1).astype(jnp.int32)
-    node = idx_t[lab]                      # [B, L]
-    bit = bit_t[lab]
-    msk = msk_t[lab]
+    if ptable is not None:
+        node_raw = ptable.reshape(ptable.shape[0], -1).astype(jnp.int32)
+        msk = (node_raw >= 0).astype(jnp.float32)
+        node = jnp.maximum(node_raw, 0)
+        bit = pcode.reshape(node.shape).astype(jnp.float32)
+    else:
+        idx_t, bit_t, msk_t = _hsig_paths(num_classes)
+        lab = label.reshape(-1).astype(jnp.int32)
+        node = idx_t[lab]                  # [B, L]
+        bit = bit_t[lab]
+        msk = msk_t[lab]
     wn = w[node]                           # [B, L, D]
     logits = jnp.einsum("bld,bd->bl", wn, x)
     if bias:
@@ -81,23 +94,51 @@ def _nce(ctx, inputs, attrs):
     bias = inputs.get("Bias")
     num_total = int(attrs["num_total_classes"])
     k = int(attrs.get("num_neg_samples", 10))
+    sampler = int(attrs.get("sampler", 0))
     b = x.shape[0]
     lab = label.reshape(b, -1).astype(jnp.int32)
     num_true = lab.shape[1]
-    neg = jax.random.randint(ctx.rng(), (b, k), 0, num_total)
+    if sampler == 1:
+        # log_uniform (nce_op.h:51 LogUniformSampler): Zipfian
+        # P(c) = log((c+2)/(c+1)) / log(range+1); inverse-CDF draw
+        u = jax.random.uniform(ctx.rng(), (b, k))
+        rng_log = jnp.log(jnp.float32(num_total + 1))
+        neg = jnp.clip(
+            (jnp.exp(u * rng_log) - 1.0).astype(jnp.int32), 0,
+            num_total - 1)
+
+        def q_of(ids):
+            idf = ids.astype(jnp.float32)
+            return (jnp.log(idf + 2.0) - jnp.log(idf + 1.0)) / rng_log
+    elif sampler == 2:
+        # custom_dist: probabilities fed as CustomDistProbs
+        (probs,) = inputs["CustomDistProbs"]
+        probs = probs.reshape(-1).astype(jnp.float32)
+        neg = jax.random.categorical(
+            ctx.rng(), jnp.log(probs + 1e-20)[None, :], shape=(b, k)
+        ).astype(jnp.int32)
+
+        def q_of(ids):
+            return probs[ids]
+    else:
+        neg = jax.random.randint(ctx.rng(), (b, k), 0, num_total)
+
+        def q_of(ids):
+            return jnp.full(ids.shape, 1.0 / num_total, jnp.float32)
     samples = jnp.concatenate([lab, neg], axis=1)       # [B, T+k]
     ws = w[samples]                                     # [B, T+k, D]
     logits = jnp.einsum("btd,bd->bt", ws, x)
     if bias:
         logits = logits + bias[0].reshape(-1)[samples]
     p_true = 1.0 / num_true if num_true else 1.0
-    q = 1.0 / num_total                                 # uniform sampler prob
     lt = logits[:, :num_true]
     ln = logits[:, num_true:]
-    # P(D=1|x) = σ(logit − log(k·q))
-    shift = jnp.log(jnp.asarray(k * q, jnp.float32))
-    pos = jax.nn.softplus(-(lt - shift))
-    negl = jax.nn.softplus(ln - shift)
+    # P(D=1|x) = σ(logit − log(k·q(class))) — q varies per class for the
+    # log_uniform/custom samplers, so the correction is per-element
+    shift_t = jnp.log(k * q_of(samples[:, :num_true]) + 1e-20)
+    shift_n = jnp.log(k * q_of(samples[:, num_true:]) + 1e-20)
+    pos = jax.nn.softplus(-(lt - shift_t))
+    negl = jax.nn.softplus(ln - shift_n)
     cost = jnp.sum(pos, 1, keepdims=True) * p_true + jnp.sum(negl, 1, keepdims=True)
     return {"Cost": [cost],
             "SampleLogits": [lax.stop_gradient(logits)],

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..core.registry import register_op
 from .common import one
@@ -96,7 +97,24 @@ def _sequence_concat(ctx, inputs, attrs):
 
 @register_op("im2sequence")
 def _im2sequence(ctx, inputs, attrs):
-    raise NotImplementedError("im2sequence: use conv/patch extraction layers")
+    """im2sequence_op.h:33: extract kernel patches of NCHW images into
+    sequence rows — Out[N·OH·OW, C·kh·kw], rows scanning each image's
+    output positions row-major, each row the (C, kh, kw)-ordered patch
+    (the im2col layout). Every image yields the same static OH·OW rows —
+    the padded-world stand-in for the reference's per-image LoD."""
+    (x,) = inputs["X"]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = list(attrs.get("paddings", [0, 0, 0, 0]))  # up, left, down, right
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        [(pads[0], pads[2]), (pads[1], pads[3])],
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+    _, ckk, oh, ow = patches.shape            # feature dim = C·kh·kw
+    out = jnp.transpose(patches, (0, 2, 3, 1)).reshape(n * oh * ow, ckk)
+    return one(out)
 
 
 @register_op("sequence_pad", nondiff_inputs=["Length", "PadValue"])

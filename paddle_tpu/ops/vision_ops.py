@@ -27,15 +27,17 @@ def _tup(v, n=2):
 @register_op("conv3d_transpose")
 def _conv3d_transpose(ctx, inputs, attrs):
     """conv_transpose_op.cc 3-D case — shares the fractionally-strided
-    formulation with conv2d_transpose via nn_ops.conv_transpose_nd."""
-    from .nn_ops import conv_transpose_nd
+    formulation with conv2d_transpose via nn_ops.conv_transpose_nd
+    (including output_size → trailing output padding resolution)."""
+    from .nn_ops import _out_pads_from_output_size, conv_transpose_nd
     (x,) = inputs["Input"]
     (w,) = inputs["Filter"]        # [C_in, C_out/groups, D, H, W]
     return one(conv_transpose_nd(
         x, w, _tup(attrs.get("strides", [1, 1, 1]), 3),
         _tup(attrs.get("paddings", [0, 0, 0]), 3),
         _tup(attrs.get("dilations", [1, 1, 1]), 3),
-        int(attrs.get("groups", 1))))
+        int(attrs.get("groups", 1)),
+        out_pads=_out_pads_from_output_size(x, w, attrs, 3)))
 
 
 @register_op("depthwise_conv2d_transpose")

@@ -239,3 +239,142 @@ def test_mvn_diag_kl_covariance_convention():
             exe.run(startup)
             (k,) = exe.run(main, feed={}, fetch_list=[kl])
     np.testing.assert_allclose(k, 0.5 * (4 - 1 - np.log(4.0)), rtol=1e-5)
+
+
+class TestIm2SequencePlacement:
+    pass  # im2sequence tests live in test_sequence_ops.py
+
+
+def _hsig_ref_tables(num_classes):
+    from paddle_tpu.ops.sampled_ops import _hsig_paths
+    return _hsig_paths(num_classes)
+
+
+def test_hsigmoid_custom_tree_matches_default():
+    """A custom PathTable/PathCode encoding the DEFAULT complete tree must
+    reproduce the default path's loss exactly (VERDICT r2 #8)."""
+    import jax.numpy as jnp
+    import paddle_tpu.ops as ops
+
+    rng = np.random.RandomState(0)
+    b, d, nc = 6, 8, 10
+    x = jnp.asarray(rng.randn(b, d).astype("float32"))
+    w = jnp.asarray(rng.randn(nc - 1, d).astype("float32") * 0.3)
+    lab = jnp.asarray(rng.randint(0, nc, (b, 1)).astype("int64"))
+    bias = jnp.asarray(rng.randn(nc - 1).astype("float32") * 0.1)
+
+    default = ops.eager_call(
+        "hierarchical_sigmoid",
+        {"X": [x], "W": [w], "Label": [lab], "Bias": [bias]},
+        {"num_classes": nc})
+
+    idx_t, bit_t, msk_t = _hsig_ref_tables(nc)
+    labels = np.asarray(lab).reshape(-1)
+    ptable = np.asarray(idx_t)[labels].astype("int64")
+    pcode = np.asarray(bit_t)[labels].astype("int64")
+    ptable = np.where(np.asarray(msk_t)[labels] > 0, ptable, -1)
+
+    custom = ops.eager_call(
+        "hierarchical_sigmoid",
+        {"X": [x], "W": [w], "Label": [lab], "Bias": [bias],
+         "PathTable": [jnp.asarray(ptable)],
+         "PathCode": [jnp.asarray(pcode)]},
+        {"num_classes": nc})
+    np.testing.assert_allclose(np.asarray(default["Out"][0]),
+                               np.asarray(custom["Out"][0]), rtol=1e-6)
+
+
+def test_hsigmoid_custom_tree_layer_and_grad():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8])
+        lab = layers.data("lab", [1], dtype="int64")
+        pt = layers.data("pt", [3], dtype="int64")
+        pc = layers.data("pc", [3], dtype="int64")
+        loss = layers.mean(layers.hsigmoid(
+            x, lab, 10, is_custom=True, path_table=pt, path_code=pc,
+            param_attr=fluid.ParamAttr(name="hw")))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(4, 8).astype("float32"),
+            "lab": rng.randint(0, 10, (4, 1)).astype("int64"),
+            "pt": np.array([[0, 2, -1]] * 4, "int64"),
+            "pc": np.array([[1, 0, 0]] * 4, "int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        for _ in range(10):
+            l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    assert np.isfinite(l0) and l1 < l0  # custom-tree loss trains
+
+
+def test_nce_log_uniform_sampler_statistics():
+    """log_uniform negatives follow the Zipfian P(c) ∝ log((c+2)/(c+1))."""
+    import jax.numpy as jnp
+    import paddle_tpu.ops as ops
+
+    rng = np.random.RandomState(0)
+    b, d, nc, k = 256, 4, 50, 20
+    x = jnp.asarray(rng.randn(b, d).astype("float32"))
+    w = jnp.asarray(rng.randn(nc, d).astype("float32") * 0.1)
+    lab = jnp.asarray(rng.randint(0, nc, (b, 1)).astype("int64"))
+    out = ops.eager_call(
+        "nce", {"Input": [x], "Weight": [w], "Label": [lab]},
+        {"num_total_classes": nc, "num_neg_samples": k, "sampler": 1})
+    assert np.isfinite(np.asarray(out["Cost"][0])).all()
+    neg = np.asarray(out["SampleLabels"][0])[:, 1:].reshape(-1)
+    counts = np.bincount(neg, minlength=nc) / neg.size
+    expect = (np.log(np.arange(nc) + 2) - np.log(np.arange(nc) + 1)) \
+        / np.log(nc + 1)
+    # low classes must dominate; loose distributional agreement
+    assert counts[:5].sum() > 0.3
+    np.testing.assert_allclose(counts[:10], expect[:10], atol=0.03)
+
+
+def test_nce_custom_dist_sampler():
+    import jax.numpy as jnp
+    import paddle_tpu.ops as ops
+
+    rng = np.random.RandomState(0)
+    b, d, nc, k = 64, 4, 12, 8
+    probs = np.zeros(nc, "float32")
+    probs[[2, 5, 7]] = [0.5, 0.3, 0.2]
+    x = jnp.asarray(rng.randn(b, d).astype("float32"))
+    w = jnp.asarray(rng.randn(nc, d).astype("float32") * 0.1)
+    lab = jnp.asarray(rng.randint(0, nc, (b, 1)).astype("int64"))
+    out = ops.eager_call(
+        "nce", {"Input": [x], "Weight": [w], "Label": [lab],
+                "CustomDistProbs": [jnp.asarray(probs)]},
+        {"num_total_classes": nc, "num_neg_samples": k, "sampler": 2})
+    assert np.isfinite(np.asarray(out["Cost"][0])).all()
+    neg = np.asarray(out["SampleLabels"][0])[:, 1:].reshape(-1)
+    assert set(np.unique(neg)) <= {2, 5, 7}
+
+
+def test_nce_layer_sampler_plumbing():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    for sampler, kw in (("log_uniform", {}),
+                        ("custom_dist",
+                         {"custom_dist": [0.1] * 10})):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8])
+            lab = layers.data("lab", [1], dtype="int64")
+            cost = layers.nce(x, lab, 10, num_neg_samples=4,
+                              sampler=sampler, **kw)
+            loss = layers.mean(cost)
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(8, 8).astype("float32"),
+                "lab": rng.randint(0, 10, (8, 1)).astype("int64")}
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            out = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(out[0]).all(), sampler

@@ -305,3 +305,40 @@ class TestSequenceLayers:
                   for _ in range(5)]
         assert float(losses[-1]) < float(losses[0]), \
             f"sequence pipeline did not train: {losses}"
+
+
+class TestIm2Sequence(OpTest):
+    op_type = "im2sequence"
+
+    @staticmethod
+    def _ref(x, kh, kw, sh, sw, pads):
+        n, c, h, w = x.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                        (pads[1], pads[3])))
+        hh, ww = xp.shape[2], xp.shape[3]
+        oh = (hh - kh) // sh + 1
+        ow = (ww - kw) // sw + 1
+        rows = []
+        for i in range(n):
+            for oy in range(oh):
+                for ox in range(ow):
+                    patch = xp[i, :, oy * sh:oy * sh + kh,
+                               ox * sw:ox * sw + kw]
+                    rows.append(patch.reshape(-1))  # (C, kh, kw) order
+        return np.stack(rows)
+
+    def test_numeric(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 7, 5).astype("float32")
+        attrs = {"kernels": [3, 2], "strides": [2, 1],
+                 "paddings": [1, 0, 1, 0]}
+        exp = self._ref(x, 3, 2, 2, 1, [1, 0, 1, 0])
+        self.check_output({"X": x}, attrs, {"Out": exp})
+
+    def test_grad(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 5, 4).astype("float32")
+        self.check_grad({"X": x},
+                        {"kernels": [2, 2], "strides": [1, 1],
+                         "paddings": [0, 0, 0, 0]},
+                        grad_input_slot="X")
