@@ -34,6 +34,24 @@ def convert_dtype(dtype):
     return jnp.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
 
 
+def canonical_dtype(dtype):
+    """convert_dtype + the x32 policy applied EXPLICITLY: 64-bit ints
+    canonicalize to 32-bit when jax runs in x32 mode, instead of letting
+    every jnp.full/asarray emit its own truncation UserWarning (the policy
+    message lives in executor.convert_feed_value)."""
+    import jax
+
+    d = convert_dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        if d in (jnp.int64, np.int64):
+            return jnp.int32
+        if d in (jnp.uint64, np.uint64):
+            return jnp.uint32
+        if d in (jnp.float64, np.float64):
+            return jnp.float32
+    return d
+
+
 def dtype_str(dtype) -> str:
     return np.dtype(convert_dtype(dtype)).name if convert_dtype(dtype) is not jnp.bfloat16 else "bfloat16"
 

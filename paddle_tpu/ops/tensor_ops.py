@@ -229,7 +229,8 @@ def _shape(ctx, inputs, attrs):
 @register_op("fill_constant", differentiable=False)
 def _fill_constant(ctx, inputs, attrs):
     shape = attrs.get("shape", [1])
-    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    from ..core.dtypes import canonical_dtype
+    dtype = canonical_dtype(attrs.get("dtype", "float32"))
     return one(jnp.full(shape, attrs.get("value", 0.0), dtype=dtype))
 
 
