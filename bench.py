@@ -37,18 +37,24 @@ def bench_resnet(on_tpu):
     """ResNet-50 train-step throughput (BASELINE config 2). Returns
     (imgs_per_sec, mfu).
 
-    Measured ceiling note (round 2 profiling, xplane trace on the bench
-    chip): the step is HBM-bound, not lowering-bound — a hand-written
-    pure-JAX NHWC/bf16 replica of this exact recipe lands within 2% of the
-    framework's step time (63.7 vs 65.1 ms), conv fusions account for only
-    ~15 ms, and the remaining ~36 ms is batch-norm statistics + apply
-    traffic. This chip sustains ~200 GB/s elementwise and ~61-82 GB/s for
-    cross-batch reductions (measured), so training-mode BN floors the step
-    near ~40 ms regardless of layout (NCHW==NHWC measured), batch size
-    (128==256), ghost-batch stats, or MXU-contraction stats (tried; reads
-    twice, nets slower). The 0.35-MFU bar is reachable for matmul-bound
-    workloads (see the BERT number) but not for BN-heavy convnets at this
-    memory bandwidth."""
+    Round-3 roofline (xplane-traced on the bench chip; supersedes the
+    round-2 note). Step = 51.98 ms at batch 128 after two wins: one-pass BN
+    statistics (58.96→53.81) and XLA-chosen parameter layouts held across
+    steps (53.81→51.98). Where the 52 ms goes (trace): ~31 ms conv+BN
+    fusions, ~11 ms of 157 per-parameter update kernels (~70 µs launch
+    latency each on this runtime — every horizontal-fusion variant measured
+    SLOWER, see executor._fuse_updates_mode), ~3 ms async copies, ~0.7 ms
+    maxpool backward. Floors: pure-MXU conv time ≈ 15-21 ms (1.57 TFLOP
+    fwd+bwd at the 74-106 TFLOP/s this chip sustains on hot chained convs);
+    HBM traffic ≈ 13 activation passes × 2.33 GB at the measured 450 GB/s
+    elementwise / ~140 GB/s per-channel-reduction fusion rates ≈ 40+ ms —
+    the step is HBM-bound within ~25% of its own roofline. Dead ends
+    (measured, kept out): Pallas fused BN in any layout loses the conv
+    layout fight (activations live channel-minor {1,0,3,2}; the forced
+    material transposes take the step to 116 ms), batch 256 is
+    throughput-neutral, ghost-batch/MXU-contraction stats lose. The
+    0.35-MFU bar is reachable for matmul-bound workloads (see BERT at
+    0.415) but not for BN-heavy convnets at this memory bandwidth."""
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
