@@ -73,18 +73,23 @@ def bench_resnet(on_tpu):
         opt.minimize(loss)
 
     exe = fluid.Executor(fluid.TPUPlace())
-    exe.run(startup)
-    rng = np.random.RandomState(0)
-    # stage the batch on device once (a production input pipeline keeps
-    # batches prefetched in HBM; the 77 MB host→device transfer per step
-    # would otherwise dominate the measurement)
-    import jax.numpy as jnp
-    feed = {
-        "img": jnp.asarray(rng.randn(batch, 3, hw, hw).astype("float32")),
-        "label": jnp.asarray(
-            rng.randint(0, classes, (batch, 1)).astype("int32")),
-    }
-    dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 2)
+    # own scope: params/optimizer state free when the bench returns —
+    # otherwise earlier models' live HBM pushes later benches into XLA
+    # rematerialization (measured: NMT MFU 0.324 alone vs 0.079 after
+    # BERT+ResNet buffers were left resident)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        # stage the batch on device once (a production input pipeline keeps
+        # batches prefetched in HBM; the 77 MB host→device transfer per step
+        # would otherwise dominate the measurement)
+        import jax.numpy as jnp
+        feed = {
+            "img": jnp.asarray(rng.randn(batch, 3, hw, hw).astype("float32")),
+            "label": jnp.asarray(
+                rng.randint(0, classes, (batch, 1)).astype("int32")),
+        }
+        dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 2)
     imgs_per_sec = batch / dt
     # ResNet-50 @224²: ~4.1 GFLOP fwd; fwd+bwd ≈ 3×
     flops_per_img = 3 * 4.1e9 if hw == 224 else 3 * 4.1e9 * (hw / 224) ** 2
@@ -282,20 +287,23 @@ def main():
         cfg, batch, seq, optimizer_factory=_opt)
 
     exe = fluid.Executor(fluid.TPUPlace())
-    exe.run(startup)
+    # own scope, like every sub-bench: BERT's ~2 GB of params + Adam state
+    # must not stay resident while the later configs run
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
 
-    # int32 ids: JAX x32 mode truncates int64 feeds anyway — avoid the
-    # per-step host-side conversion (VERDICT r1 weak #1)
-    rng = np.random.RandomState(0)
-    feed = {
-        "src_ids": rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"),
-        "pos_ids": np.tile(np.arange(seq), (batch, 1)).astype("int32"),
-        "sent_ids": np.zeros((batch, seq), dtype="int32"),
-        "input_mask": np.ones((batch, seq), dtype="float32"),
-        "mlm_labels": rng.randint(0, cfg.vocab_size, (batch, seq, 1)).astype("int32"),
-    }
+        # int32 ids: JAX x32 mode truncates int64 feeds anyway — avoid the
+        # per-step host-side conversion (VERDICT r1 weak #1)
+        rng = np.random.RandomState(0)
+        feed = {
+            "src_ids": rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"),
+            "pos_ids": np.tile(np.arange(seq), (batch, 1)).astype("int32"),
+            "sent_ids": np.zeros((batch, seq), dtype="int32"),
+            "input_mask": np.ones((batch, seq), dtype="float32"),
+            "mlm_labels": rng.randint(0, cfg.vocab_size, (batch, seq, 1)).astype("int32"),
+        }
 
-    dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 3)
+        dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 3)
 
     tokens_per_sec = batch * seq / dt
     n_params = bert.param_count(cfg)

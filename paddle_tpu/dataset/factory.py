@@ -28,9 +28,16 @@ class DatasetBase:
         self._batch_size = 1
         self._thread_num = 1
         self._use_var_names: List[str] = []
+        # XLA compiles one program per batch SHAPE: a ragged epoch-tail
+        # batch costs a full extra compilation. Default keeps the tail
+        # (reference semantics); set_drop_last(True) for shape stability.
+        self._drop_last = False
 
     def set_batch_size(self, batch_size: int):
         self._batch_size = batch_size
+
+    def set_drop_last(self, drop_last: bool):
+        self._drop_last = bool(drop_last)
 
     def set_thread(self, thread_num: int):
         self._thread_num = thread_num
@@ -103,7 +110,7 @@ class QueueDataset(DatasetBase):
             if len(batch) == self._batch_size:
                 yield self._collate(batch)
                 batch = []
-        if batch:
+        if batch and not self._drop_last:
             yield self._collate(batch)
         loader.close()
 
@@ -194,7 +201,10 @@ class InMemoryDataset(QueueDataset):
         if self._memory is None:
             yield from super().batches()
             return
-        for i in range(0, len(self._memory), self._batch_size):
+        n = len(self._memory)
+        if self._drop_last:
+            n -= n % self._batch_size
+        for i in range(0, n, self._batch_size):
             yield self._collate(self._memory[i:i + self._batch_size])
 
 
