@@ -436,9 +436,16 @@ _FUSABLE_UPDATES = {
 
 
 def _attrs_sig(attrs):
+    """Fusion-group attr signature. Any non-scalar attr (list/array) makes
+    the op not-fusable (None): silently dropping it from the key would let
+    two ops differing only in that attr fuse and run with ops[0]'s attrs."""
     try:
-        return tuple(sorted((k, v) for k, v in attrs.items()
-                            if isinstance(v, (int, float, bool, str))))
+        sig = []
+        for k, v in attrs.items():
+            if not isinstance(v, (int, float, bool, str)):
+                return None
+            sig.append((k, v))
+        return tuple(sorted(sig))
     except Exception:
         return None
 

@@ -76,12 +76,29 @@ def exchange_records(buckets, rank: int, nranks: int,
     srv.settimeout(timeout)
 
     def serve():
+        # Trust model (matches the reference's fleet RPC): the endpoint
+        # list is cluster-internal; payloads are pickled, so the port range
+        # must not be reachable by untrusted hosts. Headers are still
+        # validated so a stray/misconfigured peer fails loudly instead of
+        # corrupting this rank's buckets.
         try:
             for _ in range(nranks - 1):
                 conn, _addr = srv.accept()
                 with conn:
                     hdr = _recv_exact(conn, 12)
                     src, ln = struct.unpack("<iq", hdr)
+                    if not (0 <= src < nranks) or src == rank:
+                        raise RuntimeError(
+                            f"global_shuffle: bad peer header src={src} "
+                            f"(rank={rank}, nranks={nranks})")
+                    if not (0 <= ln <= (1 << 34)):  # 16 GiB sanity bound
+                        raise RuntimeError(
+                            f"global_shuffle: bad peer header len={ln} "
+                            f"from trainer {src}")
+                    if received[src] is not None:
+                        raise RuntimeError(
+                            f"global_shuffle: duplicate payload from "
+                            f"trainer {src}")
                     received[src] = pickle.loads(_recv_exact(conn, ln))
         except BaseException as e:  # surfaced after join
             errors.append(e)

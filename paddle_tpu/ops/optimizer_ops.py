@@ -57,8 +57,8 @@ def _dgc_momentum(ctx, inputs, attrs):
     feedback, sparse parameter update. Dense momentum until
     rampup_begin_step; sparsity then steps through attrs['sparsity'] over
     rampup_step steps. Static shapes throughout: the top-k size is the
-    FINAL sparsity's k, with the looser early-rampup thresholds applied as
-    a magnitude cutoff mask (each compile sees one k)."""
+    LOOSEST sparsity's k (the schedule's largest keep-set), with tighter
+    stages applied as a rank-cutoff mask (each compile sees one k)."""
     (p,) = inputs["Param"]
     (g,) = inputs["Grad"]
     (v,) = inputs["Velocity"]
@@ -90,9 +90,37 @@ def _dgc_momentum(ctx, inputs, attrs):
 
     flat = u.reshape(-1)
     n = flat.shape[0]
-    final_ratio = 1.0 - sparsity[-1]
-    k = max(1, int(n * final_ratio))
-    vals, idx = lax.top_k(jnp.abs(flat), k)
+    # size k from the LOOSEST sparsity in the schedule (smallest sparsity →
+    # largest keep ratio) so ascending rampup stages (e.g. [0.75, ...,
+    # 0.999]) can keep more entries than the final stage; the per-stage
+    # mask below then trims to the current stage's ratio. Sizing from the
+    # final stage would clamp every rampup stage to the final k, collapsing
+    # the documented gradual ramp (reference optimizer.py rampup semantics).
+    # Steady state must NOT pay the loose k forever, so post-rampup steps
+    # take a lax.cond branch that runs top_k at the final (small) k and
+    # pads the index list — rampup is a sliver of training; the final
+    # sparsity is the hot path.
+    loosest_ratio = 1.0 - min(sparsity)
+    k = max(1, int(n * loosest_ratio))
+    k_final = max(1, int(n * (1.0 - sparsity[-1])))
+    absflat = jnp.abs(flat)
+    if k_final == k:
+        idx = lax.top_k(absflat, k)[1]
+    else:
+        in_rampup = step.reshape(()) < (rampup_begin + rampup_step)
+
+        def _loose(_):
+            return lax.top_k(absflat, k)[1]
+
+        def _final(_):
+            # pad with duplicates of the best index; the padded ranks get
+            # keep=0 below and the scatter uses .max(), so duplicate
+            # writes cannot clear a kept position
+            idx_f = lax.top_k(absflat, k_final)[1]
+            return jnp.concatenate(
+                [idx_f, jnp.broadcast_to(idx_f[:1], (k - k_final,))])
+
+        idx = lax.cond(in_rampup, _loose, _final, None)
 
     # rampup: current sparsity stage by step count (traced select over the
     # static schedule keeps one compilation)
@@ -102,11 +130,11 @@ def _dgc_momentum(ctx, inputs, attrs):
     ratios = jnp.asarray([1.0 - s for s in sparsity], jnp.float32)
     cur_ratio = ratios[stage]
     # keep the top cur_ratio·n entries of the top-k candidates: entries
-    # ranked beyond cur_ratio·n are masked out (vals is sorted descending)
+    # ranked beyond cur_ratio·n are masked out (idx is sorted by |u| desc)
     rank = jnp.arange(k, dtype=jnp.float32)
     keep = (rank < jnp.maximum(1.0, cur_ratio * n)).astype(p.dtype)
 
-    mask = jnp.zeros_like(flat).at[idx].set(keep)
+    mask = jnp.zeros_like(flat).at[idx].max(keep)
     mask = jnp.where(dense_phase, jnp.ones_like(mask), mask)
     sparse = (flat * mask).reshape(p.shape)
     r_out = (flat * (1.0 - mask)).reshape(p.shape)

@@ -72,8 +72,9 @@ def _pipeline(ctx, inputs, attrs):
 
     # one subkey per step from the threaded stream; stages fold in their
     # stage index so dropout masks differ per stage AND advance per step.
-    # (Known limitation: within one step, a stage reuses its mask across
-    # microbatches — acceptable GPipe approximation.)
+    # Each microbatch additionally carries its OWN key through the ring
+    # (raw key data rides the payload like a batched capture), so masks
+    # differ per (stage, microbatch) — ADVICE r3.
     import jax as _jax
     from jax import lax as _lax
     base_key = ctx.rng() if not ctx.is_test else None
@@ -100,10 +101,24 @@ def _pipeline(ctx, inputs, attrs):
                 flat_params[s * n_params:(s + 1) * n_params], payload, sk)
         return {"Out": [payload[0]]}
 
+    if base_key is not None:
+        _typed = _jax.dtypes.issubdtype(getattr(base_key, "dtype", None),
+                                        _jax.dtypes.prng_key)
+        _impl = str(_jax.random.key_impl(base_key)) if _typed else None
+        _mkeys = _jax.random.split(base_key, m)
+        _mdata = _jax.random.key_data(_mkeys) if _typed else _mkeys
+
     def staged_fn(params_list, payload):
-        sk = (None if base_key is None
-              else _jax.random.fold_in(base_key, _lax.axis_index(axis)))
-        return stage_fn(params_list, payload, sk)
+        if base_key is None:
+            return stage_fn(params_list, payload, None)
+        # last payload element = this microbatch's raw key data; wrap it,
+        # fold in the stage index, and pass the data through unchanged so
+        # the NEXT stage sees the same microbatch key after the ppermute
+        inp_caps, kd = payload[:-1], payload[-1]
+        mk = (_jax.random.wrap_key_data(kd, impl=_impl) if _impl else kd)
+        sk = _jax.random.fold_in(mk, _lax.axis_index(axis))
+        out = stage_fn(params_list, inp_caps, sk)
+        return (*out, kd)
 
     from ..parallel.pipeline import pipeline_step
 
@@ -118,6 +133,8 @@ def _pipeline(ctx, inputs, attrs):
         return a.reshape((m, b // m) + a.shape[1:])
 
     xs = (micro(x), *[micro(captures[i]) for i in batched])
+    if base_key is not None:
+        xs = xs + (_mdata,)
     _log_schedule("GPipe", n_stages, m)
     out = pipeline_step(staged_fn, stacked, xs, mesh, axis,
                         data_axis=data_axis)
@@ -169,23 +186,34 @@ def _pipeline_hetero(ctx, inputs, attrs):
                   if n not in bnames}
         key_k = (None if base_key is None
                  else _jax.random.fold_in(base_key, k))
+        # ADVICE r3: each microbatch must see a distinct RNG key, or every
+        # scan tick reuses the stage key and dropout masks repeat across
+        # microbatches. The pipeline path threads a per-microbatch key in
+        # as the LAST capture (split from key_k); the sequential path runs
+        # the whole batch once so key_k alone is correct there.
+        keyed = micro_caps and key_k is not None
 
         def fn(params_list, xin, cap_tuple):
+            if keyed:
+                *cap_vals, mkey = cap_tuple
+            else:
+                cap_vals, mkey = cap_tuple, key_k
             env = dict(zip(param_names[k], params_list))
             env.update(static)
-            env.update(zip(bnames, cap_tuple))
+            env.update(zip(bnames, cap_vals))
             env[names[k]] = xin
-            sub = ExecContext(key_k, is_test=ctx.is_test, mesh=ctx.mesh,
+            sub = ExecContext(mkey, is_test=ctx.is_test, mesh=ctx.mesh,
                               amp=ctx.amp)
             _run_block(blocks[k], env, sub)
             return env[names[k + 1]]
-        return fn, bnames
+        micro_keys = _jax.random.split(key_k, m) if keyed else None
+        return fn, bnames, micro_keys
 
     mesh = ctx.mesh
     if mesh is None or axis not in mesh.axis_names:
         y = x
         for k in range(n_stages):
-            fn, bnames = make_stage(k, micro_caps=False)
+            fn, bnames, _ = make_stage(k, micro_caps=False)
             bvals = tuple(c for n, c in zip(cap_names[k], cs[k])
                           if n in bnames)
             y = fn(ps[k], y, bvals)
@@ -213,10 +241,13 @@ def _pipeline_hetero(ctx, inputs, attrs):
 
     stage_fns, caps_tree = [], []
     for k in range(n_stages):
-        fn, bnames = make_stage(k, micro_caps=True)
+        fn, bnames, micro_keys = make_stage(k, micro_caps=True)
         stage_fns.append(fn)
-        caps_tree.append(tuple(
-            micro(c) for n, c in zip(cap_names[k], cs[k]) if n in bnames))
+        stage_caps = tuple(
+            micro(c) for n, c in zip(cap_names[k], cs[k]) if n in bnames)
+        if micro_keys is not None:
+            stage_caps = stage_caps + (micro_keys,)
+        caps_tree.append(stage_caps)
     _log_schedule("GPipe-hetero", n_stages, m)
     out = pipeline_hetero(stage_fns, tuple(ps), micro(x), mesh, axis,
                           caps=tuple(caps_tree))

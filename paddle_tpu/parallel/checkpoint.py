@@ -242,6 +242,21 @@ class Checkpointer:
         self.wait()  # one write in flight at a time
         vals, shards = _snapshot(program, scope)
         rank = jax.process_index()
+        if rank == 0:
+            # manifest of every sharded var name (ADVICE r3): rank 0 sees
+            # the GLOBAL sharding of each array even though it holds only
+            # its own addressable shards, so it can record which vars must
+            # be fully assembled from the per-rank shard files on restore.
+            # Without this, a rank whose index file is missing entirely
+            # (crash between rank-0's marker write and a slow rank's
+            # background write — there is no cross-rank barrier) could
+            # leave a var it exclusively held at its init value, silently.
+            sharded = [v.name for v in program.list_vars() if v.persistable
+                       and isinstance(scope.find_var(v.name), jax.Array)
+                       and not _is_replicated(scope.find_var(v.name))]
+            if sharded:
+                vals["@shard_manifest@"] = np.asarray(
+                    "\n".join(sorted(sharded)))
         rng = scope.find_var(_RNG_STATE)
         if rng is not None:
             if jax.dtypes.issubdtype(getattr(rng, "dtype", None),
@@ -335,10 +350,24 @@ class Checkpointer:
             with open(path, "rb") as f:
                 payload = pickle.load(f)
         names = {v.name for v in program.list_vars() if v.persistable}
+        manifest_raw = payload["vars"].pop("@shard_manifest@", None)
         for n, arr in payload["vars"].items():
             if n in names:
                 scope.set_var(n, arr)
-        for n, arr in self._assemble_shards(step).items():
+        assembled = self._assemble_shards(step)
+        if manifest_raw is not None:
+            # backends may round-trip the string as a 0-d or 1-element array
+            raw = np.asarray(manifest_raw).ravel()
+            expected = set("\n".join(str(x) for x in raw).split("\n"))
+            missing = sorted((expected & names) - set(assembled))
+            if missing:
+                raise RuntimeError(
+                    f"checkpoint step {step}: sharded vars {missing} are in "
+                    "the save-time manifest but absent from every rank's "
+                    "index file — a rank's shard/index files are missing "
+                    "(e.g. crash between rank-0's marker write and that "
+                    "rank's background shard write)")
+        for n, arr in assembled.items():
             if n in names:
                 scope.set_var(n, arr)
         if "@rng@" in payload["vars"]:  # resume the random stream too

@@ -273,3 +273,33 @@ def test_shard_parallel_checkpoint_across_process_counts(tmp_path):
         assert logs2[r]["step"] == 7
         np.testing.assert_allclose(logs2[r]["wsum"], saved["wsum"],
                                    rtol=1e-6)
+
+def test_restore_raises_on_missing_rank_shard_files(tmp_path):
+    """ADVICE r3: a var whose shard/index files are entirely missing must
+    fail restore loudly (manifest check), not silently keep init values."""
+    import pytest
+
+    main, startup, feed, loss = _build(tp_axis="tp")
+    ck = Checkpointer(str(tmp_path / "mk"))
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prog = _compiled(main, make_mesh({"dp": 4, "tp": 2}))
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        ck.save(5, program=main, blocking=True)
+
+    # the sharded w0 landed in per-rank shard files; wipe them all to
+    # simulate the crash window where rank-0's marker is durable but a
+    # rank's background shard write never finished
+    removed = 0
+    for f in os.listdir(tmp_path / "mk"):
+        if ".shards-" in f or ".index-" in f:
+            os.remove(tmp_path / "mk" / f)
+            removed += 1
+    assert removed >= 2  # shard pkl + index json existed
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="manifest"):
+            ck.restore(program=main)

@@ -119,6 +119,40 @@ def test_dgc_training_converges_with_95pct_sparsity():
     assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
 
 
+def test_dgc_momentum_multistage_rampup_keep_counts():
+    """ADVICE r3: with an ascending sparsity schedule the keep-set must
+    actually shrink through the stages (k sized from the LOOSEST sparsity,
+    masked down per stage) — not jump straight to the final sparsity."""
+    from paddle_tpu.ops.optimizer_ops import _dgc_momentum
+
+    n = 1000
+    rng = np.random.RandomState(7)
+    p = jnp.asarray(rng.randn(n).astype("float32"))
+    sparsity = [0.75, 0.9375, 0.999]
+    rampup_begin, rampup_step = 4, 30  # 3 stages of 10 steps each
+
+    def nnz_update(step):
+        g = jnp.asarray(rng.randn(n).astype("float32"))
+        out = _dgc_momentum(
+            None,
+            {"Param": [p], "Grad": [g],
+             "Velocity": [jnp.zeros_like(p)],
+             "Residual": [jnp.zeros_like(p)],
+             "Step": [jnp.asarray([float(step)], "float32")],
+             "LearningRate": [jnp.asarray(0.1, "float32")]},
+            {"mu": 0.9, "sparsity": sparsity, "clip_norm": 0.0,
+             "rampup_begin_step": rampup_begin, "rampup_step": rampup_step})
+        return int(jnp.sum(out["ParamOut"][0] != p))
+
+    assert nnz_update(0) == n  # dense phase
+    stage_nnz = [nnz_update(rampup_begin + 10 * s) for s in range(3)]
+    # expected keep counts: 250, ~62, 1
+    assert 200 <= stage_nnz[0] <= 260, stage_nnz
+    assert 40 <= stage_nnz[1] <= 70, stage_nnz
+    assert 1 <= stage_nnz[2] <= 3, stage_nnz
+    assert stage_nnz[0] > stage_nnz[1] > stage_nnz[2]
+
+
 def test_program_path_dgc_converges():
     """Program-level DGCMomentumOptimizer (VERDICT r2 #6): dgc_momentum ops
     in the program, 99% sparsity after a short dense rampup, convergence

@@ -302,3 +302,76 @@ def test_pipeline_optimizer_hetero_program():
     comp = fluid.CompiledProgram(main).with_mesh(mesh, data_axis=None)
     pp = _run(main, startup, feeds, loss, compiled=comp)
     np.testing.assert_allclose(ref, pp, rtol=1e-4, atol=1e-5)
+
+def test_pipeline_hetero_distinct_dropout_per_microbatch():
+    """ADVICE r3: every microbatch must draw a fresh dropout mask — with a
+    shared stage key the mask repeats across microbatches (identical rows
+    for identical inputs)."""
+    from paddle_tpu.parallel import make_mesh
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 11
+        x = layers.data("x", [64])
+        h0 = layers.scale(x, scale=1.0)
+        h1 = layers.dropout(h0, 0.5,
+                            dropout_implementation="upscale_in_train")
+        h2 = layers.scale(h1, scale=1.0)
+        logits = layers.fc(h2, 4, param_attr=fluid.ParamAttr(name="hd.w"))
+        lab = layers.data("label", [1], dtype="int64")
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, lab))
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), cut_list=[h0, h1, h2],
+            num_microbatches=2)
+        opt.minimize(loss)
+
+    feeds = {"x": np.ones((8, 64), "float32"),
+             "label": np.zeros((8, 1), "int64")}
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    comp = fluid.CompiledProgram(main).with_mesh(mesh, data_axis=None)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        (out,) = exe.run(comp, feed=feeds, fetch_list=[h2])
+    out = np.asarray(out)
+    # identical all-ones inputs: microbatch 0 (rows 0-3) and microbatch 1
+    # (rows 4-7) must see DIFFERENT masks
+    assert not np.array_equal(out[:4], out[4:]), "masks repeat across microbatches"
+    # and the dropout itself really fired (about half the entries zeroed)
+    frac = (out == 0).mean()
+    assert 0.3 < frac < 0.7, frac
+
+def test_pipeline_isomorphic_distinct_dropout_per_microbatch():
+    """ADVICE r3, isomorphic path: each microbatch carries its own RNG key
+    through the GPipe ring, so dropout masks differ across microbatches."""
+    from paddle_tpu.parallel import make_mesh
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        main.random_seed = startup.random_seed = 13
+        x = layers.data("x", [64])
+        cuts = [x]
+        h = x
+        for i in range(2):
+            h = layers.scale(h, scale=1.0)
+            h = layers.dropout(h, 0.5,
+                               dropout_implementation="upscale_in_train")
+            cuts.append(h)
+        loss = layers.reduce_mean(h)
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.0), cut_list=cuts, num_microbatches=2)
+        opt.minimize(loss)
+        assert any(op.type == "pipeline" for op in main.global_block().ops)
+
+    feeds = {"x": np.ones((8, 64), "float32")}
+    mesh = make_mesh({"pp": 2})
+    prog = fluid.CompiledProgram(main).with_mesh(mesh, data_axis=None)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        (out,) = exe.run(prog, feed=feeds, fetch_list=[cuts[-1]])
+    out = np.asarray(out)
+    assert not np.array_equal(out[:4], out[4:]), \
+        "masks repeat across microbatches"
+    frac = (out == 0).mean()
+    assert 0.5 < frac < 0.9, frac  # two dropout layers compose
