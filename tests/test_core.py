@@ -216,3 +216,29 @@ def test_feed_validation_errors():
     got = exe.run(main, feed={"x": np.zeros((3, 4), "float32")},
                   fetch_list=[out])
     assert got[0].shape == (3, 2)
+
+
+def test_state_var_shape_swap_falls_back_to_retrace():
+    """Checkpoint surgery: swapping a persistable var for a DIFFERENT
+    shape via scope.set_var must retrace (plain jit path), not crash the
+    AOT executable — jax Format equality ignores shape, so the fast path
+    needs its own shape check (review r4)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        emb = fluid.layers.embedding(ids, [8, 16],
+                                     param_attr=fluid.ParamAttr(name="sw.emb"))
+        loss = fluid.layers.reduce_mean(emb)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    feed = {"ids": np.zeros((2, 4), "int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        exe.run(main, feed=feed, fetch_list=[loss])  # steady state
+        # grow the vocab: same rank/dtype, new shape
+        fluid.global_scope().set_var("sw.emb", np.zeros((32, 16), "float32"))
+        l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        assert np.isfinite(l1)
+        grown = fluid.global_scope().find_var("sw.emb")
+        assert tuple(np.asarray(grown).shape) == (32, 16)
