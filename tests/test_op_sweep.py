@@ -1,0 +1,1013 @@
+"""Registry-wide OpTest sweep (VERDICT r3 #3: per-op numeric/grad breadth).
+
+The reference ships ~400 per-op ``test_*_op.py`` suites
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:135 —
+check_output — and :736 — check_grad). The dedicated suites here
+(test_ops_numeric, test_parity_ops, ...) hand-check ~150 op types against
+numpy references; this sweep closes the long tail with an auto-generated
+fixture per registered op:
+
+- every swept op RUNS through its registered kernel on real inputs and
+  must return finite outputs of a sane shape;
+- every DIFFERENTIABLE swept op gets a directional finite-difference
+  gradient check: jax.grad of the kernel vs (f(x+dv)-f(x-dv))/2d along
+  random directions — the cheap O(2-eval) form of op_test.py:46's
+  get_numeric_gradient, which still catches a broken custom vjp;
+- non-differentiable ops assert their registry flag;
+- ops that need heavy infrastructure (a mesh, a cluster, TensorArrays,
+  file IO, the program executor) are EXEMPT here with the test file that
+  does cover them named in EXEMPT — and the coverage counter at the
+  bottom fails if swept fixtures drop below 340 op types or
+  swept+exempt coverage drops below 400 of the 405 registered op types.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # registers all ops
+from paddle_tpu.core import registry
+
+RNG = np.random.RandomState(7)
+
+
+def f32(*shape, lo=0.1, hi=1.0):
+    return (RNG.rand(*shape) * (hi - lo) + lo).astype("float32")
+
+
+def sym(*shape, scale=1.0):
+    """Zero-centered floats (for ops fine with negatives)."""
+    return ((RNG.rand(*shape) - 0.5) * 2 * scale).astype("float32")
+
+
+def i64(*shape, hi=8):
+    return RNG.randint(0, hi, shape).astype("int64")
+
+
+class Fx:
+    """One op fixture: inputs, attrs, expected output slots, grad spec."""
+
+    def __init__(self, inputs, attrs=None, outs=("Out",), counts=None,
+                 grad="X", gout=None, atol_grad=5e-2, delta=3e-2):
+        self.inputs = {s: (v if isinstance(v, list) else [v])
+                       for s, v in inputs.items()}
+        self.attrs = attrs or {}
+        self.outs = outs
+        self.counts = counts or {}
+        self.grad = grad          # input slot for the grad check; None = skip
+        self.gout = gout or outs[0]
+        self.atol_grad = atol_grad
+        self.delta = delta
+
+
+FIXTURES: dict = {}
+
+# ---------------------------------------------------------------- families
+for _a in ["relu", "sigmoid", "tanh", "gelu", "elu", "leaky_relu",
+           "softplus", "softsign", "swish", "silu", "mish", "hard_swish",
+           "hard_sigmoid", "logsigmoid", "tanh_shrink", "stanh",
+           "thresholded_relu", "relu6", "softmax", "log_softmax",
+           "hard_shrink", "softshrink", "exp_act", "brelu", "selu"]:
+    FIXTURES[_a] = Fx({"X": sym(3, 8) + 0.05})
+FIXTURES["prelu"] = Fx({"X": sym(3, 8), "Alpha": f32(1)},
+                       {"mode": "all"})
+FIXTURES["maxout"] = Fx({"X": f32(2, 8, 4, 4)}, {"groups": 2})
+
+for _e in ["elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "elementwise_max", "elementwise_min",
+           "elementwise_pow"]:
+    FIXTURES[_e] = Fx({"X": f32(3, 4), "Y": f32(3, 4)}, {"axis": -1})
+FIXTURES["elementwise_mod"] = Fx(
+    {"X": i64(3, 4, hi=17), "Y": i64(3, 4, hi=5) + 1}, {"axis": -1},
+    grad=None)
+FIXTURES["elementwise_floordiv"] = Fx(
+    {"X": i64(3, 4, hi=17), "Y": i64(3, 4, hi=5) + 1}, {"axis": -1},
+    grad=None)
+
+for _m in ["abs", "ceil", "floor", "round", "sign", "exp", "log", "log1p",
+           "sqrt", "rsqrt", "reciprocal", "square", "sin", "cos", "tan",
+           "sinh", "cosh", "erf", "cumsum"]:
+    # positive inputs keep log/sqrt/rsqrt in-domain; ceil/floor/round/sign
+    # are piecewise-constant → no grad check
+    FIXTURES[_m] = Fx({"X": f32(3, 5, lo=0.5, hi=1.5)},
+                      grad=None if _m in ("ceil", "floor", "round", "sign")
+                      else "X",
+                      delta=1e-3 if _m in ("reciprocal", "rsqrt", "log",
+                                           "log1p", "exp") else 3e-2)
+for _m in ["acos", "asin", "atan"]:
+    FIXTURES[_m] = Fx({"X": sym(3, 5, scale=0.7)})
+# tan explodes near pi/2: keep inputs well inside (0, 1) with a small step
+FIXTURES["tan"] = Fx({"X": f32(3, 5, lo=0.1, hi=0.8)}, delta=1e-3)
+FIXTURES["pow"] = Fx({"X": f32(3, 4)}, {"factor": 2.5})
+FIXTURES["scale"] = Fx({"X": sym(3, 4)}, {"scale": 2.0, "bias": 1.0})
+FIXTURES["clip"] = Fx({"X": sym(3, 4)}, {"min": -0.3, "max": 0.3})
+FIXTURES["clip_by_norm"] = Fx({"X": sym(3, 4)}, {"max_norm": 1.0})
+FIXTURES["matmul"] = Fx({"X": f32(3, 4), "Y": f32(4, 5)})
+FIXTURES["mul"] = Fx({"X": f32(3, 4), "Y": f32(4, 5)})
+FIXTURES["dot"] = Fx({"X": f32(3, 4), "Y": f32(3, 4)})
+FIXTURES["sum"] = Fx({"X": [f32(3, 4), f32(3, 4), f32(3, 4)]})
+FIXTURES["p_norm"] = Fx({"X": f32(3, 4)}, {"porder": 2.0, "axis": 1})
+FIXTURES["squared_l2_norm"] = Fx({"X": sym(3, 4)})
+FIXTURES["minus"] = Fx({"X": f32(3, 4), "Y": f32(3, 4)})
+FIXTURES["l1_norm"] = Fx({"X": sym(3, 4)})
+
+for _c in ["equal", "not_equal", "less_than", "less_equal", "greater_than",
+           "greater_equal"]:
+    FIXTURES[_c] = Fx({"X": i64(3, 4), "Y": i64(3, 4)}, grad=None)
+for _c in ["logical_and", "logical_or", "logical_xor"]:
+    FIXTURES[_c] = Fx({"X": i64(3, 4, hi=2).astype(bool),
+                       "Y": i64(3, 4, hi=2).astype(bool)}, grad=None)
+FIXTURES["logical_not"] = Fx({"X": i64(3, 4, hi=2).astype(bool)}, grad=None)
+for _c in ["isfinite", "isinf", "isnan"]:
+    FIXTURES[_c] = Fx({"X": sym(3, 4)}, grad=None)
+
+for _r in ["reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+           "reduce_prod", "logsumexp", "frobenius_norm"]:
+    FIXTURES[_r] = Fx({"X": f32(3, 4, 5)}, {"dim": [1]})
+FIXTURES["max"] = Fx({"X": f32(3, 4)}, {"dim": [1]})
+FIXTURES["mean"] = Fx({"X": f32(3, 4)})
+for _r in ["reduce_all", "reduce_any"]:
+    FIXTURES[_r] = Fx({"X": i64(3, 4, hi=2).astype(bool)}, {"dim": [1]},
+                      grad=None)
+for _r in ["arg_max", "arg_min"]:
+    FIXTURES[_r] = Fx({"X": f32(3, 4)}, {"axis": 1}, grad=None)
+FIXTURES["argsort"] = Fx({"X": f32(3, 4)}, {"axis": 1},
+                         outs=("Out", "Indices"), grad=None)
+FIXTURES["top_k"] = Fx({"X": f32(3, 8)}, {"k": 3}, outs=("Out", "Indices"),
+                       grad=None)
+
+# ------------------------------------------------------------- tensor ops
+FIXTURES["assign"] = Fx({"X": f32(3, 4)})
+FIXTURES["cast"] = Fx({"X": f32(3, 4)}, {"out_dtype": "float64"}, grad=None)
+FIXTURES["concat"] = Fx({"X": [f32(2, 3), f32(2, 3)]}, {"axis": 0})
+FIXTURES["diag"] = Fx({"Diagonal": f32(4)}, grad=None)
+FIXTURES["expand"] = Fx({"X": f32(2, 3)}, {"expand_times": [2, 1]})
+FIXTURES["expand_as"] = Fx({"X": f32(2, 3), "target_tensor": f32(4, 3)})
+FIXTURES["flatten"] = Fx({"X": f32(2, 3, 4)}, {"axis": 1})
+FIXTURES["flatten2"] = Fx({"X": f32(2, 3, 4)}, {"axis": 1},
+                          outs=("Out", "XShape"), grad=None)
+FIXTURES["gather"] = Fx({"X": f32(6, 3), "Index": i64(4, hi=6)})
+FIXTURES["gather_nd"] = Fx({"X": f32(4, 5), "Index": i64(3, 2, hi=4)})
+FIXTURES["pad"] = Fx({"X": f32(2, 3)}, {"paddings": [1, 1, 0, 2],
+                                        "pad_value": 0.0})
+FIXTURES["pad2d"] = Fx({"X": f32(2, 3, 4, 4)},
+                       {"paddings": [1, 1, 2, 2], "mode": "constant"})
+FIXTURES["reshape"] = Fx({"X": f32(2, 6)}, {"shape": [3, 4]})
+FIXTURES["reshape2"] = Fx({"X": f32(2, 6)}, {"shape": [3, 4]},
+                          outs=("Out", "XShape"), grad=None)
+FIXTURES["scatter"] = Fx({"X": f32(5, 3), "Ids": np.array([1, 3], "int64"),
+                          "Updates": f32(2, 3)})
+FIXTURES["scatter_nd_add"] = Fx(
+    {"X": f32(5, 3), "Index": i64(2, 1, hi=5), "Updates": f32(2, 3)})
+FIXTURES["scatter_nd"] = Fx(
+    {"Index": i64(3, 1, hi=5), "Updates": f32(3)}, {"shape": [5]},
+    grad=None)
+FIXTURES["shape"] = Fx({"Input": f32(3, 4)}, grad=None)
+FIXTURES["shard_index"] = Fx({"X": i64(4, 1, hi=16)},
+                             {"index_num": 16, "nshards": 2, "shard_id": 0},
+                             grad=None)
+FIXTURES["slice"] = Fx({"Input": f32(4, 5)},
+                       {"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]},
+                       grad="Input")
+FIXTURES["split"] = Fx({"X": f32(4, 6)}, {"num": 2, "axis": 1},
+                       counts={"Out": 2})
+FIXTURES["squeeze"] = Fx({"X": f32(3, 1, 4)}, {"axes": [1]})
+FIXTURES["squeeze2"] = Fx({"X": f32(3, 1, 4)}, {"axes": [1]},
+                          outs=("Out", "XShape"), grad=None)
+FIXTURES["stack"] = Fx({"X": [f32(3, 4), f32(3, 4)]}, {"axis": 0},
+                       outs=("Y",))
+FIXTURES["strided_slice"] = Fx(
+    {"Input": f32(6, 5)},
+    {"axes": [0], "starts": [0], "ends": [6], "strides": [2]}, grad="Input")
+FIXTURES["tile"] = Fx({"X": f32(2, 3)}, {"repeat_times": [2, 2]})
+FIXTURES["transpose"] = Fx({"X": f32(2, 3, 4)}, {"axis": [0, 2, 1]})
+FIXTURES["transpose2"] = Fx({"X": f32(2, 3, 4)}, {"axis": [0, 2, 1]},
+                            outs=("Out", "XShape"), grad=None)
+FIXTURES["unsqueeze"] = Fx({"X": f32(3, 4)}, {"axes": [1]})
+FIXTURES["unsqueeze2"] = Fx({"X": f32(3, 4)}, {"axes": [1]},
+                            outs=("Out", "XShape"), grad=None)
+FIXTURES["unstack"] = Fx({"X": f32(3, 4)}, {"axis": 0, "num": 3},
+                         counts={"Y": 3}, outs=("Y",))
+FIXTURES["where"] = Fx({"Condition": i64(3, 4, hi=2).astype(bool),
+                        "X": f32(3, 4), "Y": f32(3, 4)})
+FIXTURES["where_index"] = Fx({"Condition": np.array([0, 1, 1, 0], bool)},
+                             grad=None)
+FIXTURES["eye"] = Fx({}, {"num_rows": 4, "num_columns": 4,
+                          "dtype": "float32"}, grad=None)
+FIXTURES["fill_constant"] = Fx({}, {"shape": [2, 3], "value": 1.5,
+                                    "dtype": "float32"}, grad=None)
+FIXTURES["fill_zeros_like"] = Fx({"X": f32(3, 4)}, grad=None)
+FIXTURES["fill_any_like"] = Fx({"X": f32(3, 4)}, {"value": 2.0}, grad=None)
+FIXTURES["fill_zeros_like2"] = Fx({"X": f32(3, 4)}, grad=None)
+FIXTURES["fill"] = Fx({}, {"shape": [3], "value": [2.0, 1.0, 3.0],
+                          "dtype": "float32"}, grad=None)
+FIXTURES["fill_constant_batch_size_like"] = Fx(
+    {"Input": f32(5, 2)}, {"shape": [-1, 3], "value": 0.5,
+                           "dtype": "float32"}, grad=None)
+FIXTURES["increment"] = Fx({"X": np.array([3.0], "float32")},
+                           {"step": 1.0}, grad=None)
+FIXTURES["linspace"] = Fx({"Start": np.array([0.0], "float32"),
+                           "Stop": np.array([1.0], "float32"),
+                           "Num": np.array([5], "int32")}, grad=None)
+FIXTURES["range"] = Fx({"Start": np.array([0.0], "float32"),
+                        "End": np.array([5.0], "float32"),
+                        "Step": np.array([1.0], "float32")}, grad=None)
+FIXTURES["assign_value"] = Fx(
+    {}, {"shape": [2, 2], "dtype": "float32",
+         "values": [1.0, 2.0, 3.0, 4.0]}, grad=None)
+FIXTURES["gaussian_random"] = Fx({}, {"shape": [3, 4], "mean": 0.0,
+                                      "std": 1.0}, grad=None)
+FIXTURES["uniform_random"] = Fx({}, {"shape": [3, 4], "min": -1.0,
+                                     "max": 1.0}, grad=None)
+FIXTURES["truncated_gaussian_random"] = Fx(
+    {}, {"shape": [3, 4], "mean": 0.0, "std": 1.0}, grad=None)
+FIXTURES["randint"] = Fx({}, {"shape": [3, 4], "low": 0, "high": 7},
+                         grad=None)
+
+# ----------------------------------------------------------- nn / conv ops
+FIXTURES["conv2d"] = Fx({"Input": f32(2, 3, 8, 8), "Filter": sym(4, 3, 3, 3)},
+                        {"strides": [1, 1], "paddings": [1, 1]},
+                        grad="Input")
+FIXTURES["depthwise_conv2d"] = Fx(
+    {"Input": f32(2, 4, 8, 8), "Filter": sym(4, 1, 3, 3)},
+    {"strides": [1, 1], "paddings": [1, 1], "groups": 4},
+    grad="Input")
+FIXTURES["conv3d"] = Fx({"Input": f32(1, 2, 4, 6, 6),
+                         "Filter": sym(3, 2, 3, 3, 3)},
+                        {"strides": [1, 1, 1], "paddings": [1, 1, 1]},
+                        grad="Input")
+FIXTURES["conv2d_transpose"] = Fx(
+    {"Input": f32(2, 4, 5, 5), "Filter": sym(4, 3, 3, 3)},
+    {"strides": [2, 2], "paddings": [1, 1]}, grad="Input")
+FIXTURES["conv3d_transpose"] = Fx(
+    {"Input": f32(1, 2, 3, 4, 4), "Filter": sym(2, 3, 3, 3, 3)},
+    {"strides": [2, 2, 2], "paddings": [1, 1, 1]}, grad="Input")
+FIXTURES["depthwise_conv2d_transpose"] = Fx(
+    {"Input": f32(2, 4, 5, 5), "Filter": sym(4, 1, 3, 3)},
+    {"strides": [2, 2], "paddings": [1, 1], "groups": 4}, grad="Input")
+FIXTURES["conv2d_fusion"] = Fx(
+    {"Input": f32(2, 3, 8, 8), "Filter": sym(4, 3, 3, 3)},
+    {"strides": [1, 1], "paddings": [1, 1], "activation": "relu"},
+    outs=("Output",), grad=None)
+FIXTURES["pool2d"] = Fx({"X": f32(2, 3, 8, 8)},
+                        {"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [0, 0], "pooling_type": "max"})
+FIXTURES["pool3d"] = Fx({"X": f32(1, 2, 4, 4, 4)},
+                        {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                         "paddings": [0, 0, 0], "pooling_type": "avg"})
+FIXTURES["adaptive_pool2d"] = Fx({"X": f32(2, 3, 8, 8)},
+                                 {"pooling_size": [2, 2],
+                                  "pooling_type": "avg"})
+FIXTURES["adaptive_pool3d"] = Fx({"X": f32(1, 2, 4, 4, 4)},
+                                 {"pooling_size": [2, 2, 2],
+                                  "pooling_type": "avg"})
+FIXTURES["max_pool2d_with_index"] = Fx(
+    {"X": f32(2, 3, 8, 8)}, {"ksize": [2, 2], "strides": [2, 2],
+                             "paddings": [0, 0]},
+    outs=("Out", "Mask"), grad=None)
+FIXTURES["max_pool3d_with_index"] = Fx(
+    {"X": f32(1, 2, 4, 4, 4)}, {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                                "paddings": [0, 0, 0]},
+    outs=("Out", "Mask"), grad=None)
+FIXTURES["spp"] = Fx({"X": f32(1, 2, 8, 8)},
+                     {"pyramid_height": 2, "pooling_type": "max"},
+                     grad=None)
+FIXTURES["unpool"] = Fx(
+    {"X": f32(1, 2, 2, 2),
+     "Indices": np.array([[[[0, 3], [8, 11]], [[0, 3], [8, 11]]]], "int32")},
+    {"unpooled_size": [4, 4]}, grad=None)
+FIXTURES["batch_norm"] = Fx(
+    {"X": f32(4, 3, 5, 5), "Scale": f32(3), "Bias": f32(3),
+     "Mean": f32(3), "Variance": f32(3)},
+    {"epsilon": 1e-5, "momentum": 0.9, "is_test": False},
+    outs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+FIXTURES["sync_batch_norm"] = Fx(
+    {"X": f32(4, 3, 5, 5), "Scale": f32(3), "Bias": f32(3),
+     "Mean": f32(3), "Variance": f32(3)},
+    {"epsilon": 1e-5, "momentum": 0.9, "is_test": False},
+    outs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+FIXTURES["layer_norm"] = Fx({"X": f32(3, 8), "Scale": f32(8), "Bias": f32(8)},
+                            {"begin_norm_axis": 1},
+                            outs=("Y", "Mean", "Variance"), delta=1e-3)
+FIXTURES["group_norm"] = Fx(
+    {"X": f32(2, 4, 5, 5), "Scale": f32(4), "Bias": f32(4)},
+    {"groups": 2, "epsilon": 1e-5}, outs=("Y", "Mean", "Variance"))
+FIXTURES["instance_norm"] = Fx(
+    {"X": f32(2, 3, 5, 5), "Scale": f32(3), "Bias": f32(3)},
+    {"epsilon": 1e-5}, outs=("Y",))
+FIXTURES["data_norm"] = Fx(
+    {"X": f32(4, 3), "BatchSize": f32(3) + 5, "BatchSum": f32(3),
+     "BatchSquareSum": f32(3) + 5},
+    {"epsilon": 1e-4}, outs=("Y",))
+FIXTURES["dropout"] = Fx({"X": f32(3, 8)},
+                         {"dropout_prob": 0.5, "is_test": True},
+                         outs=("Out",))
+FIXTURES["lrn"] = Fx({"X": f32(2, 4, 5, 5)},
+                     {"n": 3, "alpha": 1e-4, "beta": 0.75, "k": 1.0})
+FIXTURES["l2_normalize"] = Fx({"X": f32(3, 8)}, {"axis": 1})
+FIXTURES["norm"] = Fx({"X": f32(3, 8)}, {"axis": 1}, outs=("Out", "Norm"),
+                      delta=1e-3)
+FIXTURES["lookup_table"] = Fx({"W": f32(10, 4), "Ids": i64(3, 1, hi=10)},
+                              {}, grad="W")
+FIXTURES["lookup_table_v2"] = Fx({"W": f32(10, 4), "Ids": i64(3, hi=10)},
+                                 {}, grad="W")
+FIXTURES["one_hot"] = Fx({"X": i64(4, 1, hi=6)}, {"depth": 6}, grad=None)
+FIXTURES["cross_entropy"] = Fx(
+    {"X": f32(4, 5, lo=0.05, hi=0.9) / 2, "Label": i64(4, 1, hi=5)},
+    {"soft_label": False}, grad=None)
+FIXTURES["cross_entropy2"] = Fx(
+    {"X": f32(4, 5, lo=0.05, hi=0.9) / 2, "Label": i64(4, 1, hi=5)},
+    {}, outs=("Y",), grad=None)
+FIXTURES["softmax_with_cross_entropy"] = Fx(
+    {"Logits": sym(4, 5), "Label": i64(4, 1, hi=5)},
+    {"soft_label": False}, outs=("Loss", "Softmax"), grad="Logits",
+    gout="Loss")
+FIXTURES["sigmoid_cross_entropy_with_logits"] = Fx(
+    {"X": sym(4, 5), "Label": f32(4, 5, lo=0.0, hi=1.0)}, {})
+FIXTURES["square_error_cost"] = Fx({"X": f32(4, 3), "Label": f32(4, 3)})
+FIXTURES["smooth_l1_loss"] = Fx({"X": f32(4, 3), "Y": f32(4, 3)},
+                                {"sigma": 1.0}, outs=("Out", "Diff"))
+FIXTURES["huber_loss"] = Fx({"X": f32(4, 3), "Y": f32(4, 3)},
+                            {"delta": 0.5}, outs=("Out", "Residual"))
+FIXTURES["kldiv_loss"] = Fx(
+    {"X": np.log(f32(4, 5, lo=0.1, hi=0.9)), "Target": f32(4, 5)},
+    {"reduction": "mean"})
+FIXTURES["log_loss"] = Fx(
+    {"Predicted": f32(4, 1, lo=0.3, hi=0.7),
+     "Labels": i64(4, 1, hi=2).astype("float32")},
+    {"epsilon": 1e-4}, outs=("Loss",), grad="Predicted", delta=1e-3)
+FIXTURES["hinge_loss"] = Fx(
+    {"Logits": sym(4, 1), "Labels": i64(4, 1, hi=2).astype("float32")},
+    {}, outs=("Loss",), grad=None)  # kink at the margin
+FIXTURES["bpr_loss"] = Fx({"X": f32(4, 5), "Label": i64(4, 1, hi=5)},
+                          {}, outs=("Y",), grad=None)
+FIXTURES["rank_loss"] = Fx(
+    {"Label": i64(4, 1, hi=2).astype("float32"),
+     "Left": sym(4, 1), "Right": sym(4, 1)}, {}, grad="Left")
+FIXTURES["margin_rank_loss"] = Fx(
+    {"Label": (i64(4, 1, hi=2) * 2 - 1).astype("float32"),
+     "X1": sym(4, 1), "X2": sym(4, 1)},
+    {"margin": 0.1}, outs=("Out", "Activated"), grad=None)
+FIXTURES["modified_huber_loss"] = Fx(
+    {"X": sym(4, 1), "Y": i64(4, 1, hi=2).astype("float32")},
+    {}, outs=("Out", "IntermediateVal"), grad=None)
+FIXTURES["teacher_student_sigmoid_loss"] = Fx(
+    {"X": sym(4, 1), "Label": f32(4, 1, lo=0.0, hi=1.0)},
+    {}, outs=("Y",), grad=None)
+FIXTURES["squared_l2_distance"] = Fx(
+    {"X": f32(4, 3), "Y": f32(4, 3)}, {}, outs=("Out", "sub_result"))
+FIXTURES["cos_sim"] = Fx({"X": f32(4, 3), "Y": f32(4, 3)},
+                         {}, outs=("Out", "XNorm", "YNorm"))
+FIXTURES["bilinear_tensor_product"] = Fx(
+    {"X": f32(3, 4), "Y": f32(3, 5), "Weight": sym(2, 4, 5)}, {})
+FIXTURES["affine_channel"] = Fx(
+    {"X": f32(2, 3, 4, 4), "Scale": f32(3), "Bias": f32(3)},
+    {"data_layout": "NCHW"})
+FIXTURES["cvm"] = Fx({"X": f32(4, 6)}, {"use_cvm": True}, outs=("Y",),
+                     grad=None)
+
+# ------------------------------------------------------ interp/vision misc
+FIXTURES["bilinear_interp"] = Fx({"X": f32(2, 3, 4, 4)},
+                                 {"out_h": 8, "out_w": 8})
+FIXTURES["nearest_interp"] = Fx({"X": f32(2, 3, 4, 4)},
+                                {"out_h": 8, "out_w": 8})
+FIXTURES["trilinear_interp"] = Fx({"X": f32(1, 2, 3, 4, 4)},
+                                  {"out_d": 6, "out_h": 8, "out_w": 8})
+FIXTURES["pixel_shuffle"] = Fx({"X": f32(2, 8, 3, 3)},
+                               {"upscale_factor": 2})
+FIXTURES["space_to_depth"] = Fx({"X": f32(2, 3, 4, 4)}, {"blocksize": 2})
+FIXTURES["shuffle_channel"] = Fx({"X": f32(2, 4, 3, 3)}, {"group": 2})
+FIXTURES["temporal_shift"] = Fx({"X": f32(4, 4, 3, 3)},
+                                {"seg_num": 2, "shift_ratio": 0.25})
+FIXTURES["reverse"] = Fx({"X": f32(3, 4)}, {"axis": [0]})
+FIXTURES["crop"] = Fx({"X": f32(4, 5)}, {"offsets": [1, 1],
+                                         "shape": [2, 3]})
+FIXTURES["pad_constant_like"] = Fx({"X": f32(4, 5), "Y": f32(2, 3)},
+                                   {"pad_value": 0.0}, grad="Y")
+FIXTURES["grid_sampler"] = Fx(
+    {"X": f32(1, 2, 4, 4), "Grid": sym(1, 3, 3, 2, scale=0.9)},
+    {}, outs=("Output",))
+FIXTURES["affine_grid"] = Fx(
+    {"Theta": sym(1, 2, 3)}, {"output_shape": [1, 1, 4, 4]},
+    outs=("Output",), grad="Theta")
+FIXTURES["unfold"] = Fx({"X": f32(1, 2, 5, 5)},
+                        {"kernel_sizes": [2, 2], "strides": [1, 1],
+                         "paddings": [0, 0, 0, 0], "dilations": [1, 1]},
+                        outs=("Y",))
+FIXTURES["fsp"] = Fx({"X": f32(2, 3, 4, 4), "Y": f32(2, 5, 4, 4)})
+FIXTURES["similarity_focus"] = Fx({"X": f32(2, 3, 4, 4)},
+                                  {"axis": 1, "indexes": [0]}, grad=None)
+FIXTURES["random_crop"] = Fx({"X": f32(3, 6, 6)}, {"shape": [4, 4]},
+                             grad=None)
+FIXTURES["row_conv"] = Fx({"X": f32(1, 5, 4), "Filter": sym(3, 4)}, {})
+FIXTURES["conv_shift"] = Fx({"X": f32(2, 6), "Y": sym(2, 3)}, {})
+FIXTURES["spectral_norm"] = Fx(
+    {"Weight": sym(4, 5), "U": sym(4), "V": sym(5)},
+    {"dim": 0, "power_iters": 1, "eps": 1e-12}, grad=None)
+FIXTURES["add_position_encoding"] = Fx({"X": f32(2, 5, 6)},
+                                       {"alpha": 1.0, "beta": 1.0})
+FIXTURES["multiplex"] = Fx(
+    {"Ids": i64(3, 1, hi=2), "X": [f32(3, 4), f32(3, 4)]}, {}, grad=None)
+FIXTURES["label_smooth"] = Fx({"X": f32(4, 5, lo=0.0, hi=1.0)},
+                              {"epsilon": 0.1})
+FIXTURES["mean_iou"] = Fx(
+    {"Predictions": i64(8, hi=3).astype("int32"),
+     "Labels": i64(8, hi=3).astype("int32")},
+    {"num_classes": 3}, outs=("OutMeanIou",), grad=None)
+FIXTURES["is_empty"] = Fx({"X": f32(3)}, grad=None)
+FIXTURES["size"] = Fx({"Input": f32(3, 4)}, grad=None)
+FIXTURES["sampling_id"] = Fx({"X": f32(4, 5, lo=0.05)}, grad=None)
+FIXTURES["gaussian_random_batch_size_like"] = Fx(
+    {"Input": f32(5, 2)}, {"shape": [-1, 3], "mean": 0.0, "std": 1.0},
+    grad=None)
+FIXTURES["uniform_random_batch_size_like"] = Fx(
+    {"Input": f32(5, 2)}, {"shape": [-1, 3], "min": -1.0, "max": 1.0},
+    grad=None)
+FIXTURES["ones_like"] = Fx({"X": f32(3, 4)}, grad=None)
+FIXTURES["hash"] = Fx({"X": i64(4, 1, hi=100)},
+                      {"num_hash": 2, "mod_by": 1000}, grad=None)
+FIXTURES["unique"] = Fx({"X": np.array([2, 3, 2, 5], "int64")},
+                        {"dtype": "int32"}, outs=("Out", "Index"),
+                        grad=None)
+FIXTURES["unique_with_counts"] = Fx(
+    {"X": np.array([2, 3, 2, 5], "int64")}, {"dtype": "int32"},
+    outs=("Out", "Index", "Count"), grad=None)
+FIXTURES["has_inf"] = Fx({"X": f32(3, 4)}, grad=None)
+FIXTURES["has_nan"] = Fx({"X": f32(3, 4)}, grad=None)
+FIXTURES["get_tensor_from_selected_rows"] = Fx({"X": f32(3, 4)}, grad=None)
+FIXTURES["merge_selected_rows"] = Fx({"X": f32(3, 4)}, grad=None)
+
+# ----------------------------------------------------------- quantization
+FIXTURES["fake_quantize_abs_max"] = Fx(
+    {"X": sym(3, 4)}, {"bit_length": 8}, outs=("Out", "OutScale"),
+    grad=None)
+FIXTURES["fake_channel_wise_quantize_abs_max"] = Fx(
+    {"X": sym(3, 4)}, {"bit_length": 8}, outs=("Out", "OutScale"),
+    grad=None)
+FIXTURES["fake_dequantize_max_abs"] = Fx(
+    {"X": sym(3, 4), "Scale": f32(1)}, {"max_range": 127.0}, grad=None)
+FIXTURES["fake_channel_wise_dequantize_max_abs"] = Fx(
+    {"X": sym(3, 4), "Scales": [f32(3)]}, {"quant_bits": [8]}, grad=None)
+FIXTURES["fake_quantize_moving_average_abs_max"] = Fx(
+    {"X": sym(3, 4), "InScale": f32(1)},
+    {"bit_length": 8, "is_test": True, "moving_rate": 0.9},
+    outs=("Out",), grad=None)
+FIXTURES["fake_quantize_range_abs_max"] = Fx(
+    {"X": sym(3, 4), "InScale": f32(1)},
+    {"bit_length": 8, "is_test": True}, outs=("Out",), grad=None)
+FIXTURES["fake_quantize_dequantize_moving_average_abs_max"] = Fx(
+    {"X": sym(3, 4), "InScale": f32(1)},
+    {"bit_length": 8, "is_test": True, "moving_rate": 0.9},
+    outs=("Out",), grad=None)
+FIXTURES["moving_average_abs_max_scale"] = Fx(
+    {"X": sym(3, 4), "InScale": f32(1)}, {"moving_rate": 0.9},
+    outs=("Out", "OutScale"), grad=None)
+FIXTURES["quantize"] = Fx({"Input": sym(3, 4)},
+                          {"Scale": 64.0, "Shift": 0.0},
+                          outs=("Output",), grad=None)
+FIXTURES["dequantize"] = Fx(
+    {"Input": (sym(3, 4) * 60).astype("int8")},
+    {"Scale": 64.0, "Shift": 0.0}, outs=("Output",), grad=None)
+FIXTURES["requantize"] = Fx(
+    {"Input": (sym(3, 4) * 60).astype("int8")},
+    {"Scale_in": 64.0, "Scale_out": 32.0, "Shift_in": 0.0,
+     "Shift_out": 0.0}, outs=("Output",), grad=None)
+
+# ------------------------------------------------------------- optimizers
+def _opt(name, extra_in, attrs, outs, lr=True):
+    ins = {"Param": f32(4, 3), "Grad": sym(4, 3)}
+    if lr:
+        ins["LearningRate"] = np.array([0.1], "float32")
+    for s, v in extra_in.items():
+        ins[s] = v
+    FIXTURES[name] = Fx(ins, attrs, outs=outs, grad=None)
+
+
+_opt("sgd", {}, {}, ("ParamOut",))
+_opt("momentum", {"Velocity": sym(4, 3)}, {"mu": 0.9},
+     ("ParamOut", "VelocityOut"))
+_opt("lars_momentum", {"Velocity": sym(4, 3)},
+     {"mu": 0.9, "lars_coeff": 1e-3, "lars_weight_decay": 1e-4},
+     ("ParamOut", "VelocityOut"))
+_opt("adam", {"Moment1": sym(4, 3), "Moment2": f32(4, 3),
+              "Beta1Pow": np.array([0.9], "float32"),
+              "Beta2Pow": np.array([0.999], "float32")},
+     {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+     ("ParamOut", "Moment1Out", "Moment2Out"))
+_opt("adamw", {"Moment1": sym(4, 3), "Moment2": f32(4, 3),
+               "Beta1Pow": np.array([0.9], "float32"),
+               "Beta2Pow": np.array([0.999], "float32")},
+     {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "coeff": 0.01},
+     ("ParamOut", "Moment1Out", "Moment2Out"))
+_opt("adamax", {"Moment": sym(4, 3), "InfNorm": f32(4, 3),
+                "Beta1Pow": np.array([0.9], "float32")},
+     {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+     ("ParamOut", "MomentOut", "InfNormOut"))
+_opt("adagrad", {"Moment": f32(4, 3)}, {"epsilon": 1e-6},
+     ("ParamOut", "MomentOut"))
+_opt("decayed_adagrad", {"Moment": f32(4, 3)},
+     {"decay": 0.95, "epsilon": 1e-6}, ("ParamOut", "MomentOut"))
+_opt("adadelta", {"AvgSquaredGrad": f32(4, 3),
+                  "AvgSquaredUpdate": f32(4, 3)},
+     {"rho": 0.95, "epsilon": 1e-6},
+     ("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"), lr=False)
+_opt("rmsprop", {"Moment": sym(4, 3), "MeanSquare": f32(4, 3),
+                 "MeanGrad": sym(4, 3)},
+     {"decay": 0.9, "epsilon": 1e-6, "momentum": 0.9, "centered": False},
+     ("ParamOut", "MomentOut", "MeanSquareOut"))
+_opt("ftrl", {"SquaredAccumulator": f32(4, 3),
+              "LinearAccumulator": sym(4, 3)},
+     {"l1": 0.1, "l2": 0.1, "lr_power": -0.5},
+     ("ParamOut", "SquaredAccumOut", "LinearAccumOut"))
+_opt("lamb", {"Moment1": sym(4, 3), "Moment2": f32(4, 3),
+              "Beta1Pow": np.array([0.9], "float32"),
+              "Beta2Pow": np.array([0.999], "float32")},
+     {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6, "weight_decay": 0.01},
+     ("ParamOut", "Moment1Out", "Moment2Out"))
+_opt("proximal_gd", {}, {"l1": 0.01, "l2": 0.01}, ("ParamOut",))
+_opt("proximal_adagrad", {"Moment": f32(4, 3)},
+     {"l1": 0.01, "l2": 0.01}, ("ParamOut", "MomentOut"))
+_opt("dgc_momentum", {"Velocity": sym(4, 3), "Residual": sym(4, 3),
+                      "Step": np.array([0.0], "float32")},
+     {"mu": 0.9, "sparsity": [0.9], "rampup_begin_step": 100,
+      "rampup_step": 1, "clip_norm": 1.0},
+     ("ParamOut", "VelocityOut", "ResidualOut", "StepOut"))
+FIXTURES["average_accumulates"] = Fx(
+    {"param": f32(4, 3), "in_sum_1": sym(4, 3), "in_sum_2": sym(4, 3),
+     "in_sum_3": sym(4, 3), "in_num_accumulates": np.array([1], "int64"),
+     "in_old_num_accumulates": np.array([1], "int64"),
+     "in_num_updates": np.array([1], "int64")},
+    {"average_window": 10, "max_average_window": 20,
+     "min_average_window": 5},
+    outs=("out_sum_1", "out_num_accumulates"), grad=None)
+FIXTURES["update_loss_scaling"] = Fx(
+    {"Grads": [sym(3, 4)], "LossScaling": np.array([1024.0], "float32"),
+     "GoodSteps": np.array([0], "int32"),
+     "BadSteps": np.array([0], "int32")},
+    {"incr_every_n_steps": 100, "decr_every_n_nan_or_inf": 2,
+     "incr_ratio": 2.0, "decr_ratio": 0.5},
+    outs=("LossScalingOut",), grad=None)
+FIXTURES["lr_schedule"] = Fx(
+    {"Base": np.array([0.1], "float32"), "Step": np.array([3.0], "float32")},
+    {"kind": "exponential", "decay_steps": 10, "decay_rate": 0.9},
+    outs=("Out",), grad=None)
+
+# ------------------------------------------------------------- rnn family
+FIXTURES["lstm"] = Fx(
+    {"Input": f32(2, 5, 16), "Weight": sym(4, 16)},
+    {"gate_activation": "sigmoid", "cell_activation": "tanh",
+     "candidate_activation": "tanh"},
+    outs=("Hidden",), grad=None)
+FIXTURES["gru"] = Fx(
+    {"Input": f32(2, 5, 12), "Weight": sym(4, 12)},
+    {"gate_activation": "sigmoid", "activation": "tanh"},
+    outs=("Hidden",), grad=None)
+FIXTURES["lstm_unit"] = Fx(
+    {"X": sym(3, 16), "C_prev": sym(3, 4)}, {"forget_bias": 0.0},
+    outs=("C", "H"), grad=None)
+FIXTURES["gru_unit"] = Fx(
+    {"Input": sym(3, 12), "HiddenPrev": sym(3, 4), "Weight": sym(4, 12)},
+    {"gate_activation": "sigmoid", "activation": "tanh"},
+    outs=("Hidden",), grad=None)
+FIXTURES["cudnn_lstm"] = Fx(
+    {"Input": f32(5, 2, 8), "WeightX": sym(8, 16), "WeightH": sym(4, 16),
+     "Bias": sym(16)},
+    {"hidden_size": 4, "num_layers": 1, "is_bidirec": False,
+     "dropout_prob": 0.0},
+    outs=("Out",), grad=None)
+
+# --------------------------------------------------------- sequence (LoD)
+_seq_len = np.array([3, 2], "int64")
+FIXTURES["sequence_pool"] = Fx(
+    {"X": f32(2, 4, 3), "Length": _seq_len}, {"pooltype": "SUM"},
+    grad=None)
+FIXTURES["sequence_softmax"] = Fx(
+    {"X": f32(2, 4), "Length": _seq_len}, {}, grad=None)
+FIXTURES["sequence_reverse"] = Fx(
+    {"X": f32(2, 4, 3), "Length": _seq_len}, {}, outs=("Y",), grad=None)
+FIXTURES["sequence_mask"] = Fx(
+    {"X": _seq_len}, {"maxlen": 5, "out_dtype": "float32"}, outs=("Y",),
+    grad=None)
+FIXTURES["sequence_erase"] = Fx(
+    {"X": i64(2, 4, hi=5), "Length": _seq_len}, {"tokens": [1]},
+    grad=None)
+FIXTURES["sequence_enumerate"] = Fx(
+    {"X": i64(2, 4, hi=9), "Length": _seq_len},
+    {"win_size": 2, "pad_value": 0}, grad=None)
+FIXTURES["sequence_reshape"] = Fx(
+    {"X": f32(2, 4, 6), "Length": _seq_len}, {"new_dim": 3}, grad=None)
+FIXTURES["sequence_concat"] = Fx(
+    {"X": [f32(2, 3, 4), f32(2, 3, 4)],
+     "Length": [np.array([2, 3], "int64"), np.array([1, 2], "int64")]},
+    {}, grad=None)
+FIXTURES["sequence_expand"] = Fx(
+    {"X": f32(2, 3), "Y": f32(2, 2, 3)}, {}, grad=None)
+FIXTURES["sequence_expand_as"] = Fx(
+    {"X": f32(2, 3), "Y": f32(2, 4, 3),
+     "Length": np.array([4, 2], "int64")}, {}, grad=None)
+FIXTURES["sequence_pad"] = Fx(
+    {"X": f32(2, 4, 3), "Length": _seq_len,
+     "PadValue": np.zeros((1,), "float32")},
+    {"padded_length": 4}, outs=("Out",), grad=None)
+FIXTURES["sequence_unpad"] = Fx(
+    {"X": f32(2, 4, 3), "Length": _seq_len}, {}, grad=None)
+FIXTURES["sequence_slice"] = Fx(
+    {"X": f32(2, 4, 3), "Length": _seq_len,
+     "Offset": np.array([[0], [1]], "int64")},
+    {}, grad=None)
+FIXTURES["sequence_scatter"] = Fx(
+    {"X": f32(2, 6), "Ids": i64(2, 3, hi=6), "Updates": f32(2, 3),
+     "Length": np.array([3, 3], "int64")}, {}, grad=None)
+FIXTURES["sequence_conv"] = Fx(
+    {"X": f32(2, 4, 3), "Filter": sym(3 * 3, 5),
+     "Length": _seq_len},
+    {"contextLength": 3, "contextStart": -1}, grad=None)
+FIXTURES["sequence_topk_avg_pooling"] = Fx(
+    {"X": f32(2, 4, 6), "Length": _seq_len}, {"topks": [2]}, grad=None)
+FIXTURES["im2sequence"] = Fx(
+    {"X": f32(1, 2, 6, 6)},
+    {"kernels": [2, 2], "strides": [2, 2], "paddings": [0, 0, 0, 0]},
+    grad=None)
+FIXTURES["lod_reset"] = Fx(
+    {"X": f32(5, 3), "Y": np.array([0, 2, 5], "int64")}, {}, grad=None)
+FIXTURES["warpctc"] = Fx(
+    {"Logits": sym(2, 4, 6), "Label": i64(2, 2, hi=5) + 0},
+    {"blank": 0, "norm_by_times": False}, outs=("Loss",), grad=None)
+FIXTURES["ctc_align"] = Fx(
+    {"Input": i64(2, 5, hi=4).astype("int32")}, {"blank": 0}, grad=None)
+FIXTURES["edit_distance"] = Fx(
+    {"Hyps": i64(2, 4, hi=5), "Refs": i64(2, 4, hi=5)},
+    {"normalized": False}, outs=("Out",), grad=None)
+
+# ----------------------------------------------------- fusion / heavyweight
+FIXTURES["fc"] = Fx({"Input": f32(3, 4), "W": sym(4, 5)}, {},
+                    grad="Input")
+FIXTURES["fused_fc"] = Fx({"Input": f32(3, 4), "W": sym(4, 5)},
+                          {"activation_type": "relu",
+                           "in_num_col_dims": 1}, grad=None)  # relu kink
+FIXTURES["fused_elemwise_activation"] = Fx(
+    {"X": f32(3, 4), "Y": f32(3, 4)},
+    {"functor_list": ["elementwise_add", "relu"], "axis": -1}, grad="X")
+FIXTURES["flash_attention"] = Fx(
+    {"Q": sym(2, 8, 16), "K": sym(2, 8, 16), "V": sym(2, 8, 16)},
+    {"num_heads": 2, "causal": False, "dropout_prob": 0.0,
+     "is_test": True}, grad=None)
+FIXTURES["fusion_repeated_fc_relu"] = Fx(
+    {"X": f32(3, 4), "W": [sym(4, 6), sym(6, 5)],
+     "Bias": [sym(6), sym(5)]}, {}, grad=None)
+FIXTURES["fusion_squared_mat_sub"] = Fx(
+    {"X": f32(3, 4), "Y": f32(4, 5)}, {"scalar": 0.5}, grad=None)
+FIXTURES["fusion_transpose_flatten_concat"] = Fx(
+    {"X": [f32(2, 3, 4), f32(2, 3, 4)]},
+    {"trans_axis": [0, 2, 1], "flatten_axis": 1, "concat_axis": 0},
+    grad=None)
+FIXTURES["fused_embedding_seq_pool"] = Fx(
+    {"W": f32(10, 4), "Ids": i64(2, 3, 1, hi=10)},
+    {"combiner": "sum"}, grad=None)
+FIXTURES["fusion_gru"] = Fx(
+    {"X": f32(2, 5, 12), "WeightX": sym(12, 12), "WeightH": sym(4, 12)},
+    {"gate_activation": "sigmoid", "activation": "tanh"},
+    outs=("Hidden",), grad=None)
+FIXTURES["fusion_lstm"] = Fx(
+    {"X": f32(2, 5, 8), "WeightX": sym(8, 16), "WeightH": sym(4, 16)},
+    {"gate_activation": "sigmoid", "cell_activation": "tanh",
+     "candidate_activation": "tanh"},
+    outs=("Hidden",), grad=None)
+FIXTURES["lstmp"] = Fx(
+    {"Input": f32(2, 5, 16), "Weight": sym(3, 16),
+     "ProjWeight": sym(4, 3)},
+    {"gate_activation": "sigmoid", "cell_activation": "tanh",
+     "candidate_activation": "tanh", "proj_activation": "tanh"},
+    outs=("Projection",), grad=None)
+FIXTURES["attention_lstm"] = Fx(
+    {"X": f32(2, 5, 8), "AttentionWeight": sym(12, 1),
+     "LSTMWeight": sym(12, 16)},
+    {"gate_activation": "sigmoid", "cell_activation": "tanh",
+     "candidate_activation": "tanh"},
+    outs=("Hidden",), grad=None)
+FIXTURES["fusion_seqconv_eltadd_relu"] = Fx(
+    {"X": f32(2, 4, 3), "Filter": sym(9, 5), "Bias": sym(5),
+     "Length": _seq_len},
+    {"contextLength": 3, "contextStart": -1}, grad=None)
+FIXTURES["fusion_seqpool_concat"] = Fx(
+    {"X": [f32(2, 4, 3), f32(2, 4, 3)],
+     "Length": [_seq_len, _seq_len]}, {"pooltype": "SUM"}, grad=None)
+FIXTURES["fusion_seqpool_cvm_concat"] = Fx(
+    {"X": [f32(2, 4, 3), f32(2, 4, 3)],
+     "Length": [_seq_len, _seq_len]},
+    {"pooltype": "SUM", "use_cvm": True}, grad=None)
+FIXTURES["fusion_seqexpand_concat_fc"] = Fx(
+    {"X": [f32(2, 4, 3), f32(2, 3)], "FCWeight": sym(6, 5)},
+    {"fc_activation": "relu"}, grad=None)
+FIXTURES["match_matrix_tensor"] = Fx(
+    {"X": f32(2, 4, 3), "Y": f32(2, 5, 3), "W": sym(3, 2, 3)},
+    {}, outs=("Out",), grad=None)
+FIXTURES["var_conv_2d"] = Fx(
+    {"X": f32(2, 1, 6, 6), "W": sym(3, 1, 3, 3)},
+    {"kernel_h": 3, "kernel_w": 3, "stride_h": 1, "stride_w": 1},
+    grad=None)
+FIXTURES["tree_conv"] = Fx(
+    {"NodesVector": f32(1, 5, 4), "EdgeSet": i64(1, 4, 2, hi=5),
+     "Filter": sym(4, 3, 2)}, {}, grad=None)
+FIXTURES["filter_by_instag"] = Fx(
+    {"Ins": f32(4, 3),
+     "Ins_tag": np.array([[1], [2], [1], [3]], "int64"),
+     "Filter_tag": np.array([1], "int64")}, {}, grad=None)
+FIXTURES["moe_ffn"] = Fx(
+    {"X": f32(4, 8), "GateW": sym(8, 2), "W1": sym(2, 8, 16),
+     "B1": sym(2, 16), "W2": sym(2, 16, 8), "B2": sym(2, 8)},
+    {"k": 1, "capacity_factor": 2.0, "act": "relu"},
+    outs=("Out", "AuxLoss"), grad=None)
+
+# ------------------------------------------------------- sampled / sparse
+FIXTURES["nce"] = Fx(
+    {"Input": f32(3, 4), "Label": i64(3, 1, hi=6), "Weight": sym(6, 4),
+     "Bias": sym(6)},
+    {"num_total_classes": 6, "num_neg_samples": 2, "sampler": 0},
+    outs=("Cost",), grad=None)
+FIXTURES["hierarchical_sigmoid"] = Fx(
+    {"X": f32(3, 4), "W": sym(5, 4), "Label": i64(3, 1, hi=6),
+     "Bias": sym(5)},
+    {"num_classes": 6}, outs=("Out",), grad=None)
+FIXTURES["sample_logits"] = Fx(
+    {"Logits": sym(3, 6), "Labels": i64(3, 1, hi=6)},
+    {"num_samples": 3, "remove_accidental_hits": False},
+    outs=("SampledLogits",), grad=None)
+FIXTURES["split_ids"] = Fx({"Ids": i64(6, 1, hi=100)}, {"num_shards": 2},
+                           counts={"Out": 2}, grad=None)
+FIXTURES["merge_ids"] = Fx(
+    {"Ids": i64(4, hi=10), "X": [f32(4, 3), f32(4, 3)]}, {}, grad=None)
+FIXTURES["split_selected_rows"] = Fx(
+    {"X": f32(6, 3)}, {"height_sections": [3, 3]}, counts={"Out": 2},
+    grad=None)
+FIXTURES["split_byref"] = Fx({"X": f32(6, 3)},
+                             {"height_sections": [3, 3]},
+                             counts={"Out": 2}, grad=None)
+
+# -------------------------------------------------------------- detection
+FIXTURES["iou_similarity"] = Fx(
+    {"X": np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "float32"),
+     "Y": np.array([[0, 0, 2, 2]], "float32")}, {}, grad=None)
+_pb = np.array([[0, 0, 2, 2], [1, 1, 4, 3], [2, 0, 5, 2]], "float32")
+FIXTURES["box_coder"] = Fx(
+    {"PriorBox": _pb, "TargetBox": _pb + 0.5},
+    {"code_type": "encode_center_size"}, outs=("OutputBox",), grad=None)
+FIXTURES["box_clip"] = Fx(
+    {"Input": f32(3, 4) * 8,
+     "ImInfo": np.array([[6.0, 6.0, 1.0]], "float32")},
+    {}, outs=("Output",), grad=None)
+FIXTURES["prior_box"] = Fx(
+    {"Input": f32(1, 2, 3, 3), "Image": f32(1, 3, 9, 9)},
+    {"min_sizes": [2.0], "aspect_ratios": [1.0],
+     "variances": [0.1, 0.1, 0.2, 0.2], "flip": False, "offset": 0.5},
+    outs=("Boxes", "Variances"), grad=None)
+FIXTURES["density_prior_box"] = Fx(
+    {"Input": f32(1, 2, 3, 3), "Image": f32(1, 3, 9, 9)},
+    {"fixed_sizes": [2.0], "fixed_ratios": [1.0], "densities": [1],
+     "variances": [0.1, 0.1, 0.2, 0.2], "offset": 0.5, "clip": False},
+    outs=("Boxes", "Variances"), grad=None)
+FIXTURES["anchor_generator"] = Fx(
+    {"Input": f32(1, 2, 3, 3)},
+    {"anchor_sizes": [16.0], "aspect_ratios": [1.0],
+     "stride": [4.0, 4.0], "variances": [0.1, 0.1, 0.2, 0.2],
+     "offset": 0.5},
+    outs=("Anchors", "Variances"), grad=None)
+FIXTURES["polygon_box_transform"] = Fx(
+    {"Input": f32(1, 8, 2, 2)}, {}, outs=("Output",), grad=None)
+FIXTURES["yolo_box"] = Fx(
+    {"X": f32(1, 18, 2, 2), "ImgSize": np.array([[32, 32]], "int32")},
+    {"anchors": [10, 13, 16, 30, 33, 23], "class_num": 1,
+     "conf_thresh": 0.01, "downsample_ratio": 16},
+    outs=("Boxes", "Scores"), grad=None)
+FIXTURES["bipartite_match"] = Fx(
+    {"DistMat": f32(3, 4)}, {"match_type": "bipartite"},
+    outs=("ColToRowMatchIndices", "ColToRowMatchDist"), grad=None)
+FIXTURES["target_assign"] = Fx(
+    {"X": f32(2, 3, 4), "MatchIndices": i64(2, 5, hi=3).astype("int32")},
+    {"mismatch_value": 0}, outs=("Out", "OutWeight"), grad=None)
+FIXTURES["mine_hard_examples"] = Fx(
+    {"ClsLoss": f32(2, 4),
+     "MatchIndices": (i64(2, 4, hi=3) - 1).astype("int32")},
+    {"neg_pos_ratio": 1.0}, outs=("NegIndices",), grad=None)
+FIXTURES["roi_pool"] = Fx(
+    {"X": f32(1, 2, 8, 8),
+     "ROIs": np.array([[0, 0, 4, 4], [2, 2, 7, 7]], "float32")},
+    {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+    outs=("Out",), grad=None)
+FIXTURES["roi_align"] = Fx(
+    {"X": f32(1, 2, 8, 8),
+     "ROIs": np.array([[0, 0, 4, 4], [2, 2, 7, 7]], "float32")},
+    {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+    outs=("Out",), grad=None)
+FIXTURES["psroi_pool"] = Fx(
+    {"X": f32(1, 8, 6, 6),
+     "ROIs": np.array([[0, 0, 4, 4]], "float32")},
+    {"output_channels": 2, "pooled_height": 2, "pooled_width": 2,
+     "spatial_scale": 1.0}, outs=("Out",), grad=None)
+FIXTURES["roi_perspective_transform"] = Fx(
+    {"X": f32(1, 2, 8, 8),
+     "ROIs": np.array([[0, 1, 1, 5, 1, 5, 5, 1, 5]], "float32")},
+    {"transformed_height": 2, "transformed_width": 2,
+     "spatial_scale": 1.0}, outs=("Out",), grad=None)
+FIXTURES["sigmoid_focal_loss"] = Fx(
+    {"X": sym(3, 4), "Label": i64(3, 1, hi=5).astype("int32"),
+     "FgNum": np.array([2], "int32")},
+    {"gamma": 2.0, "alpha": 0.25}, grad=None)
+FIXTURES["multiclass_nms"] = Fx(
+    {"BBoxes": f32(1, 4, 4) * 8, "Scores": f32(1, 2, 4)},
+    {"background_label": 0, "score_threshold": 0.01, "nms_top_k": 4,
+     "nms_threshold": 0.3, "keep_top_k": 4}, grad=None)
+FIXTURES["deformable_conv"] = Fx(
+    {"Input": f32(1, 2, 6, 6), "Offset": sym(1, 18, 6, 6, scale=0.1),
+     "Mask": f32(1, 9, 6, 6), "Filter": sym(3, 2, 3, 3)},
+    {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+     "groups": 1, "deformable_groups": 1}, outs=("Output",), grad=None)
+FIXTURES["deformable_psroi_pooling"] = Fx(
+    {"Input": f32(1, 8, 6, 6), "ROIs": np.array([[0, 0, 4, 4]], "float32")},
+    {"group_size": [1, 1], "pooled_height": 2, "pooled_width": 2,
+     "spatial_scale": 1.0, "trans_std": 0.1}, outs=("Output",),
+    grad=None)
+
+# --------------------------------------------------------- metrics / misc
+FIXTURES["accuracy"] = Fx(
+    {"Indices": i64(4, 1, hi=3), "Label": i64(4, 1, hi=3)},
+    {}, outs=("Accuracy",), grad=None)
+FIXTURES["auc"] = Fx(
+    {"Predict": f32(4, 2), "Label": i64(4, 1, hi=2),
+     "StatPos": np.zeros(201, "int64"), "StatNeg": np.zeros(201, "int64")},
+    {"num_thresholds": 200}, outs=("AUC",), grad=None)
+FIXTURES["chunk_eval"] = Fx(
+    {"Inference": i64(2, 5, hi=3), "Label": i64(2, 5, hi=3),
+     "Length": np.array([5, 4], "int64")},
+    {"num_chunk_types": 1, "chunk_scheme": "IOB"},
+    outs=("Precision", "Recall"), grad=None)
+FIXTURES["linear_chain_crf"] = Fx(
+    {"Emission": f32(2, 4, 3), "Transition": sym(5, 3),
+     "Label": i64(2, 4, 1, hi=3),
+     "Length": np.array([4, 3], "int64")},
+    {}, outs=("LogLikelihood",), grad=None)
+FIXTURES["crf_decoding"] = Fx(
+    {"Emission": f32(2, 4, 3), "Transition": sym(5, 3),
+     "Length": np.array([4, 3], "int64")},
+    {}, outs=("ViterbiPath",), grad=None)
+FIXTURES["center_loss"] = Fx(
+    {"X": f32(4, 3), "Label": i64(4, 1, hi=5), "Centers": f32(5, 3),
+     "CenterUpdateRate": np.array([0.1], "float32")},
+    {"need_update": False}, outs=("Loss",), grad=None)
+_pb4 = np.array([[0, 0, 2, 2], [1, 1, 4, 3]], "float32")
+FIXTURES["box_decoder_and_assign"] = Fx(
+    {"PriorBox": _pb4, "PriorBoxVar": f32(2, 4),
+     "TargetBox": sym(2, 8, scale=0.2), "BoxScore": f32(2, 2)},
+    {}, outs=("DecodeBox", "OutputAssignBox"), grad=None)
+FIXTURES["select"] = Fx(
+    {"Cond": i64(3, 4, hi=2).astype(bool), "X": f32(3, 4), "Y": f32(3, 4)},
+    {}, grad=None)
+
+
+# piecewise/kinked ops: a finite-difference step can cross the kink, so
+# the FD check is skipped — their grads are covered by the dedicated
+# suites with carefully-placed inputs
+for _k in ["hard_shrink", "softshrink", "thresholded_relu", "maxout",
+           "reduce_max", "reduce_min", "max", "elementwise_max",
+           "elementwise_min", "pool2d", "pool3d", "relu", "relu6",
+           "leaky_relu", "prelu", "abs", "hard_sigmoid", "hard_swish",
+           "brelu", "elu", "clip", "huber_loss", "smooth_l1_loss",
+           "nearest_interp", "selu", "max_pool2d_with_index"]:
+    if _k in FIXTURES:
+        FIXTURES[_k].grad = None
+
+# ------------------------------------------------------------------ checks
+
+EXEMPT = {
+    # needs a mesh / multi-device program — tests/test_parallel.py,
+    # tests/test_dist_cluster.py, tests/test_moe.py
+    "allreduce", "c_allgather", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "c_allreduce_sum", "c_broadcast", "c_reducescatter",
+    "c_sync_calc_stream", "c_sync_comm_stream", "c_comm_init",
+    "c_comm_init_all", "c_gen_nccl_id",
+    # program/executor infrastructure — tests/test_core.py,
+    # tests/test_control_flow_rnn.py, tests/test_io_and_data.py
+    "cond", "conditional_block", "conditional_block_infer", "switch",
+    "while", "recurrent", "static_rnn", "feed", "fetch", "read", "print",
+    "py_func", "save", "save_combine", "load", "load_combine",
+    "delete_var", "fake_init", "get_places", "coalesce_tensor",
+    # pipeline sub-block ops — tests/test_pipeline_optimizer.py
+    "pipeline", "pipeline_hetero",
+    # beam search — tests/test_book_models.py machine translation decode
+    "beam_search", "beam_search_decode",
+    # TensorArray / LoD program infrastructure — tests/test_framework_ops.py,
+    # tests/test_control_flow_rnn.py, tests/test_sampled_ops.py
+    "array_read", "array_write", "array_length", "lod_array_length",
+    "write_to_array", "read_from_array", "tensor_array_to_tensor",
+    "array_to_lod_tensor", "lod_tensor_to_array", "lod_rank_table",
+    "max_sequence_len", "shrink_rnn_memory", "rnn_memory_helper",
+    "merge_lod_tensor", "merge_lod_tensor_infer", "split_lod_tensor",
+    "reorder_lod_tensor_by_rank",
+    # multi-stage detection pipelines with their own numeric suites —
+    # tests/test_detection_ops.py, tests/test_parity_ops.py
+    "yolov3_loss", "generate_proposals", "generate_proposal_labels",
+    "rpn_target_assign", "retinanet_target_assign",
+    "retinanet_detection_output", "detection_map",
+    "collect_fpn_proposals", "distribute_fpn_proposals",
+    "generate_mask_labels", "fused_embedding_fc_lstm",
+}
+
+
+def _eager(op_type, fx):
+    import jax.numpy as jnp
+
+    import paddle_tpu.ops as ops
+    jvals = {s: [jnp.asarray(v) for v in vs] for s, vs in fx.inputs.items()}
+    return ops.eager_call(op_type, jvals, dict(fx.attrs))
+
+
+def _swept():
+    return sorted(set(FIXTURES) & set(registry.registered_ops()))
+
+
+@pytest.mark.parametrize("op_type", _swept())
+def test_op_runs_and_outputs_finite(op_type):
+    fx = FIXTURES[op_type]
+    out = _eager(op_type, fx)
+    for slot in fx.outs:
+        assert slot in out, f"{op_type}: no output slot {slot}"
+        vals = out[slot]
+        assert len(vals) == fx.counts.get(slot, 1), \
+            f"{op_type}.{slot}: arity {len(vals)}"
+        for v in vals:
+            a = np.asarray(v)
+            if slot == fx.outs[0] and op_type != "where_index":
+                assert a.size > 0, f"{op_type}.{slot} empty"
+            if np.issubdtype(a.dtype, np.floating):
+                assert np.isfinite(a).all(), f"{op_type}.{slot} not finite"
+
+
+@pytest.mark.parametrize("op_type", [
+    n for n in _swept()
+    if FIXTURES[n].grad is not None and registry.get_op(n).differentiable])
+def test_op_directional_grad(op_type):
+    """jax.grad of the registered kernel vs central finite differences
+    along 2 random directions (op_test.py:46's check, O(1) evals)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.executor import ExecContext
+
+    fx = FIXTURES[op_type]
+    slot = fx.grad
+    x0 = np.asarray(fx.inputs[slot][0], np.float64)
+    opdef = registry.get_op(op_type)
+
+    def call(x):
+        ins = {s: [jnp.asarray(v) for v in vs] for s, vs in fx.inputs.items()}
+        ins[slot] = [x] + [jnp.asarray(v) for v in fx.inputs[slot][1:]]
+        ctx = ExecContext(jax.random.PRNGKey(0), is_test=True)
+        out = opdef.fn(ctx, ins, dict(fx.attrs))
+        return sum(jnp.sum(jnp.asarray(v, jnp.float32))
+                   for v in out[fx.gout]
+                   if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating))
+
+    g = jax.grad(lambda x: call(x))(jnp.asarray(x0, jnp.float32))
+    g = np.asarray(g, np.float64)
+    rng = np.random.RandomState(11)
+    d = fx.delta
+    for _ in range(2):
+        v = rng.randn(*x0.shape)
+        fp = float(call(jnp.asarray(x0 + d * v, jnp.float32)))
+        fm = float(call(jnp.asarray(x0 - d * v, jnp.float32)))
+        numeric = (fp - fm) / (2 * d)
+        analytic = float((g * v).sum())
+        denom = max(abs(numeric), abs(analytic), 1e-2)
+        assert abs(numeric - analytic) / denom < fx.atol_grad, (
+            f"{op_type}: directional grad mismatch "
+            f"analytic={analytic} numeric={numeric}")
+
+
+def test_non_differentiable_ops_are_flagged():
+    """A fixture requesting a grad check on an op the registry flags
+    non-differentiable is a fixture bug (the grad test silently filters
+    those out) — surface the mismatch here."""
+    mismatched = [n for n in _swept()
+                  if FIXTURES[n].grad is not None
+                  and not registry.get_op(n).differentiable]
+    assert not mismatched, mismatched
+    flagged = [n for n in registry.registered_ops()
+               if not registry.get_op(n).differentiable]
+    assert len(flagged) >= 120  # the registry keeps explicit flags
+
+
+def test_sweep_coverage_counter():
+    """Fails when per-op coverage regresses below the VERDICT r3 #3 bar
+    (≥350 op types exercised): ≥340 exercised by THIS sweep and ≥400
+    total once ops exempted to a named heavier-infrastructure test file
+    are included."""
+    all_ops = set(registry.registered_ops())
+    covered = set(FIXTURES) & all_ops
+    exempt = EXEMPT & all_ops
+    assert len(covered) >= 340, (
+        f"op sweep fixtures cover {len(covered)} < 340 op types")
+    assert len(covered) + len(exempt) >= 400, (
+        f"op sweep coverage {len(covered)} + exempt {len(exempt)} "
+        f"< 400 of {len(all_ops)}; unaccounted: "
+        f"{sorted(all_ops - covered - exempt)[:40]}...")
+    assert not (covered & exempt), sorted(covered & exempt)
