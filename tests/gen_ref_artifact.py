@@ -1,0 +1,82 @@
+"""Generate the checked-in reference-format MNIST artifact
+(tests/data/ref_mnist_model/) with paddle_tpu.compat's writer, plus the
+independently-computed (pure numpy) expected outputs. Run once; the test
+then guards the loader against the frozen bytes."""
+import os
+
+import numpy as np
+
+from paddle_tpu.compat import reference_format as rf
+
+
+def build(dirname):
+    rng = np.random.RandomState(42)
+    w0 = (rng.randn(784, 32) * 0.05).astype("float32")
+    b0 = (rng.randn(32) * 0.05).astype("float32")
+    w1 = (rng.randn(32, 10) * 0.05).astype("float32")
+    b1 = (rng.randn(10) * 0.05).astype("float32")
+
+    def var(name, shape, persistable=False):
+        return {"name": name, "type": rf.VT_LOD_TENSOR, "dtype": "float32",
+                "shape": list(shape), "persistable": persistable,
+                "lod_level": 0}
+
+    prog = {"blocks": [{
+        "idx": 0, "parent_idx": -1,
+        "vars": {
+            "img": var("img", [-1, 784]),
+            "fc0.w": var("fc0.w", [784, 32], True),
+            "fc0.b": var("fc0.b", [32], True),
+            "fc1.w": var("fc1.w", [32, 10], True),
+            "fc1.b": var("fc1.b", [10], True),
+            "h0": var("h0", [-1, 32]), "h0b": var("h0b", [-1, 32]),
+            "h0r": var("h0r", [-1, 32]),
+            "h1": var("h1", [-1, 10]), "h1b": var("h1b", [-1, 10]),
+            "prob": var("prob", [-1, 10]),
+        },
+        "ops": [
+            {"type": "feed", "inputs": {"X": ["feed"]},
+             "outputs": {"Out": ["img"]}, "attrs": {"col": 0}},
+            {"type": "mul", "inputs": {"X": ["img"], "Y": ["fc0.w"]},
+             "outputs": {"Out": ["h0"]},
+             "attrs": {"x_num_col_dims": 1, "y_num_col_dims": 1}},
+            {"type": "elementwise_add",
+             "inputs": {"X": ["h0"], "Y": ["fc0.b"]},
+             "outputs": {"Out": ["h0b"]}, "attrs": {"axis": 1}},
+            {"type": "relu", "inputs": {"X": ["h0b"]},
+             "outputs": {"Out": ["h0r"]}, "attrs": {}},
+            {"type": "mul", "inputs": {"X": ["h0r"], "Y": ["fc1.w"]},
+             "outputs": {"Out": ["h1"]},
+             "attrs": {"x_num_col_dims": 1, "y_num_col_dims": 1}},
+            {"type": "elementwise_add",
+             "inputs": {"X": ["h1"], "Y": ["fc1.b"]},
+             "outputs": {"Out": ["h1b"]}, "attrs": {"axis": 1}},
+            {"type": "softmax", "inputs": {"X": ["h1b"]},
+             "outputs": {"Out": ["prob"]}, "attrs": {}},
+            {"type": "fetch", "inputs": {"X": ["prob"]},
+             "outputs": {"Out": ["fetch"]}, "attrs": {"col": 0}},
+        ],
+    }]}
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(rf.serialize_program_desc(prog))
+    for name, arr in [("fc0.w", w0), ("fc0.b", b0),
+                      ("fc1.w", w1), ("fc1.b", b1)]:
+        with open(os.path.join(dirname, name), "wb") as f:
+            rf.write_lod_tensor_stream(f, arr)
+
+    # expected outputs: INDEPENDENT numpy forward (not the loader under
+    # test) on a fixed input batch
+    x = rng.rand(4, 784).astype("float32")
+    h = np.maximum(x @ w0 + b0, 0.0)
+    logits = h @ w1 + b1
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    prob = e / e.sum(axis=1, keepdims=True)
+    np.savez(os.path.join(dirname, "expected.npz"), x=x, prob=prob)
+    print("wrote", dirname)
+
+
+if __name__ == "__main__":
+    build(os.path.join(os.path.dirname(__file__), "data",
+                       "ref_mnist_model"))
