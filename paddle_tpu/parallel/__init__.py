@@ -26,4 +26,5 @@ from .moe import (  # noqa: F401
     init_moe_params, moe_ffn, moe_ffn_expert_parallel, top_k_gating)
 from .pipeline import GPipe, pipeline_step  # noqa: F401
 from .ring_attention import ring_attention, ring_self_attention  # noqa: F401
-from .tensor_parallel import MEGATRON_RULES, annotate_tp  # noqa: F401
+from .tensor_parallel import (MEGATRON_RULES, annotate_tp,  # noqa: F401
+                              annotate_tp_auto, derive_tp_specs)

@@ -398,3 +398,78 @@ def test_composed_dp_tp_pp_single_program():
     ref = run(False)
     got = run(True)
     np.testing.assert_allclose(ref, got, rtol=5e-3, atol=1e-4)
+
+
+def test_structural_tp_derivation_matches_hand_rules():
+    """derive_tp_specs (no name-regex table) reproduces the hand-written
+    MEGATRON/NMT/DEEPFM rule annotations exactly, on all three models
+    (VERDICT r3 #7)."""
+    from paddle_tpu.models import bert, deepfm
+    from paddle_tpu.models import transformer_nmt as nmt
+    from paddle_tpu.parallel import tensor_parallel as tp
+
+    def hand_specs(program, rules):
+        prog = program
+        tp.annotate_tp(prog, rules)
+        return {p.name: tuple(p.shard_spec) for p in prog.all_parameters()
+                if getattr(p, "shard_spec", None)}
+
+    def derived(program):
+        return {k: tuple(v) for k, v in tp.derive_tp_specs(program).items()}
+
+    # BERT-base shapes (hand rules live in MEGATRON_RULES; build without
+    # build-time shard_spec so only the rules speak)
+    cfg = bert.BertConfig(vocab_size=30522, hidden_size=768, num_layers=2,
+                          num_heads=12, ffn_size=3072, max_position=512,
+                          hidden_dropout=0.1, attn_dropout=0.1,
+                          use_flash_attention=False)
+    main, _, _, _ = bert.build_pretrain_program(cfg, 2, 16)
+    for p in main.all_parameters():   # clear any build-time annotations
+        p.shard_spec = None
+    d = derived(main)
+    h = hand_specs(main, tp.MEGATRON_RULES)
+    assert d == h, (sorted(set(h) - set(d)), sorted(set(d) - set(h)),
+                    {k: (h.get(k), d.get(k)) for k in set(h) | set(d)
+                     if h.get(k) != d.get(k)})
+
+    # transformer-big NMT
+    ncfg = nmt.TransformerConfig()
+    nmain, _, _, _ = nmt.build_train_program(ncfg, 16, 16)
+    for p in nmain.all_parameters():
+        p.shard_spec = None
+    d = derived(nmain)
+    h = hand_specs(nmain, tp.NMT_RULES)
+    assert d == h, {k: (h.get(k), d.get(k)) for k in set(h) | set(d)
+                    if h.get(k) != d.get(k)}
+
+    # DeepFM at Criteo vocab
+    dmain, _, _, _, _ = deepfm.build_train_program(vocab_size=1_000_000,
+                                                   is_sparse=False)
+    for p in dmain.all_parameters():
+        p.shard_spec = None
+    d = derived(dmain)
+    h = hand_specs(dmain, tp.DEEPFM_RULES)
+    assert d == h, {k: (h.get(k), d.get(k)) for k in set(h) | set(d)
+                    if h.get(k) != d.get(k)}
+
+
+def test_structural_tp_transpose_and_inference_head():
+    """Review r4: tied-embedding heads (matmul transpose_y=True) shard the
+    vocab dim, and a plain-softmax inference head still derives."""
+    from paddle_tpu.parallel import derive_tp_specs
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [8], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, [4096, 512], param_attr=fluid.ParamAttr(name="tied_emb"))
+        h = fluid.layers.fc(emb, 512, num_flatten_dims=2, act="relu",
+                            param_attr=fluid.ParamAttr(name="t.w"),
+                            bias_attr=False)
+        # tied head: logits = h @ emb.T  → vocab on dim 0 of the weight
+        table = main.global_block().var("tied_emb")
+        logits = fluid.layers.matmul(h, table, transpose_y=True)
+        prob = fluid.layers.softmax(logits)  # inference: no fused CE
+    specs = derive_tp_specs(main, min_embed_rows=1024, min_matmul_dim=256)
+    # both the lookup rule and the transposed-head rule agree on (tp, None)
+    assert specs.get("tied_emb") == ("tp", None), specs
