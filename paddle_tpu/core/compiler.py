@@ -69,6 +69,7 @@ class CompiledProgram:
         self._program = program
         self._mesh: Optional[Mesh] = None
         self._data_axis: Optional[str] = None
+        self._seq_axis: Optional[str] = None
         self._cache: Dict = {}
         self.build_strategy: Optional[BuildStrategy] = None
         self.exec_strategy: Optional[ExecutionStrategy] = None
@@ -90,14 +91,27 @@ class CompiledProgram:
         return self
 
     def with_mesh(self, mesh: Mesh, data_axis: Optional[str] = "dp",
-                  strategy=None):
+                  strategy=None, seq_axis: Optional[str] = None):
         """TPU-native extension: run over an arbitrary (dp, mp, pp, sp) mesh.
         Parameters carrying `shard_spec` are placed accordingly (Megatron-style
         TP); everything else is replicated. `strategy` (a fleet
         DistributedStrategy) wires sharding_degree (ZeRO optimizer-state
-        sharding over the data axis) and recompute (remat)."""
+        sharding over the data axis) and recompute (remat).
+
+        ``seq_axis``: shard dim 1 (the sequence dim) of every rank≥2 feed
+        over this mesh axis — GSPMD sequence parallelism: embeddings,
+        layer norms, dropout and the FFN stay sequence-sharded and XLA
+        inserts the gathers attention needs (the annotation-only form of
+        Megatron-SP; the ring-attention kernels are the manual form)."""
         self._mesh = mesh
         self._data_axis = data_axis if data_axis in mesh.axis_names else None
+        self._seq_axis = seq_axis if seq_axis in mesh.axis_names else None
+        if (self._seq_axis is not None
+                and self._seq_axis == self._data_axis):
+            raise ValueError(
+                f"with_mesh: seq_axis and data_axis are both "
+                f"{seq_axis!r} — a feed dim cannot shard over the same "
+                f"mesh axis twice; use distinct axes")
         self._zero_shard = False       # re-derived per call, never sticky
         self._strategy_remat = False   # ditto; build_strategy.remat is the
         if strategy is not None:       # user's own knob and is left alone
@@ -139,12 +153,16 @@ class CompiledProgram:
         spec = P(*spec) if not isinstance(spec, P) else spec
         return NamedSharding(self._mesh, spec)
 
-    def _feed_sharding(self):
-        if self._data_axis is None:
+    def _feed_sharding(self, ndim: Optional[int] = None):
+        if self._data_axis is None and getattr(self, "_seq_axis", None) is None:
             return NamedSharding(self._mesh, P())
+        seq = getattr(self, "_seq_axis", None)
+        if seq is not None and ndim is not None and ndim >= 2:
+            return NamedSharding(self._mesh, P(self._data_axis, seq))
         return NamedSharding(self._mesh, P(self._data_axis))
 
-    def _build(self, feed_names, fetch_names, state_names, out_state_names):
+    def _build(self, feed_names, fetch_names, state_names, out_state_names,
+               feed_ndims=None):
         block = self._program.global_block()
         mesh = self._mesh
         amp = getattr(self._program, "_amp", None)
@@ -161,7 +179,8 @@ class CompiledProgram:
             return fetches, new_state, ctx.final_key()
 
         state_sh = {n: self._state_sharding(n) for n in state_names}
-        feed_sh = {n: self._feed_sharding() for n in feed_names}
+        feed_sh = {n: self._feed_sharding((feed_ndims or {}).get(n))
+                   for n in feed_names}
         key_sh = NamedSharding(mesh, P())
         out_state_sh = {n: self._state_sharding(n) for n in out_state_names}
 
@@ -198,11 +217,19 @@ class CompiledProgram:
                 # each trainer process feeds its LOCAL batch shard (the
                 # reference's per-trainer reader contract, test_dist_base.py);
                 # assemble the global array across processes
+                if getattr(self, "_seq_axis", None) is not None:
+                    raise NotImplementedError(
+                        "multi-process feeds assume batch-only sharding "
+                        "(each trainer supplies its local batch rows at "
+                        "FULL sequence length) — with seq_axis set the "
+                        "expected per-process shape would also split the "
+                        "sequence dim. Feed a pre-built global jax.Array "
+                        "instead, or drop seq_axis for multi-process runs.")
                 local = np.asarray(val)
                 if dtype is not None:
                     local = local.astype(jnp.dtype(dtype))
                 feed_vals[name] = jax.make_array_from_process_local_data(
-                    self._feed_sharding(), local)
+                    self._feed_sharding(local.ndim), local)
             else:
                 from .executor import convert_feed_value
                 feed_vals[name] = convert_feed_value(block, name, val)
@@ -216,10 +243,15 @@ class CompiledProgram:
                    tuple(state_names),
                    bool((self.build_strategy and self.build_strategy.remat)
                         or getattr(self, "_strategy_remat", False)),
-                   getattr(self, "_zero_shard", False))
+                   getattr(self, "_zero_shard", False),
+                   id(self._mesh), self._data_axis,
+                   getattr(self, "_seq_axis", None))
         fn = self._cache.get(key_sig)
         if fn is None:
-            fn = self._build(sorted(feed_vals), fetch_names, state_names, out_state_names)
+            fn = self._build(sorted(feed_vals), fetch_names, state_names,
+                             out_state_names,
+                             {n: np.asarray(v).ndim if not isinstance(v, jax.Array) else v.ndim
+                              for n, v in feed_vals.items()})
             self._cache[key_sig] = fn
 
         state = {}

@@ -246,14 +246,23 @@ def derive_tp_specs(program: Program, axis: str = "tp",
         if paired_row and col_ok:
             w2, w2_op = paired_row
             if _in_dim(w2, w2_op) >= min_matmul_dim:
-                row_proposals.setdefault(w2, _row_spec(w2_op))
+                prop = _row_spec(w2_op)
+                if w2 in row_proposals and row_proposals[w2] != prop:
+                    warnings.warn(
+                        f"derive_tp_specs: {w2} terminates col→row chains "
+                        f"with conflicting orientations "
+                        f"{row_proposals[w2]} vs {prop} (mixed transpose_y "
+                        f"uses); leaving it replicated", stacklevel=2)
+                    row_proposals[w2] = None
+                elif w2 not in row_proposals:
+                    row_proposals[w2] = prop
 
     # row-parallel is the WEAKEST classification: a tied embedding+head
     # weight is both the terminus of a col→row chain AND a vocab head /
     # lookup table — the head/lookup spec (shard the vocab dim) serves
     # every use, so it wins and the row proposal is dropped silently.
     for name, spec in row_proposals.items():
-        if name not in specs:
+        if name not in specs and spec is not None:
             specs[name] = spec
 
     return {n: s for n, s in specs.items() if s is not None}

@@ -473,3 +473,37 @@ def test_structural_tp_transpose_and_inference_head():
     specs = derive_tp_specs(main, min_embed_rows=1024, min_matmul_dim=256)
     # both the lookup rule and the transposed-head rule agree on (tp, None)
     assert specs.get("tied_emb") == ("tp", None), specs
+
+
+def test_seq_axis_gspmd_sequence_parallel_loss_equality():
+    """with_mesh(seq_axis=...) shards the sequence dim of feeds over the
+    sp axis (GSPMD sequence parallelism) — same loss as unsharded."""
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import make_mesh
+
+    cfg = bert.BertConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                          num_heads=2, ffn_size=32, max_position=16,
+                          hidden_dropout=0.0, attn_dropout=0.0,
+                          use_flash_attention=False)
+    B, T = 4, 8
+    main, startup, feeds, loss = bert.build_pretrain_program(cfg, B, T)
+    rng = np.random.RandomState(0)
+    feed = {"src_ids": rng.randint(0, 64, (B, T)).astype("int64"),
+            "pos_ids": np.tile(np.arange(T), (B, 1)).astype("int64"),
+            "sent_ids": np.zeros((B, T), "int64"),
+            "input_mask": np.ones((B, T), "float32"),
+            "mlm_labels": rng.randint(0, 64, (B, T, 1)).astype("int64")}
+
+    def run(seq_axis):
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            prog = fluid.CompiledProgram(main).with_mesh(
+                make_mesh({"dp": 2, "sp": 4}), data_axis="dp",
+                seq_axis=seq_axis)
+            return [float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+                    for _ in range(2)]
+
+    ref = run(None)
+    got = run("sp")
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
