@@ -58,7 +58,15 @@ def deepfm(sparse_ids, dense_feats, vocab_size: int, num_fields: int,
 
 def build_train_program(vocab_size=100000, num_fields=26, num_dense=13,
                         embed_dim=16, lr=1e-3, shard_axis=None,
-                        is_sparse=False):
+                        is_sparse=False, embedding_optimizer=None):
+    """embedding_optimizer="sgd" puts the two Criteo-scale tables on plain
+    SGD while the dense net keeps Adam — the reference's CTR practice
+    (Downpour sparse tables run their own one-state rule while the dense
+    net runs a full optimizer). On TPU this matters doubly: XLA lowers a
+    sparse table update as an O(table) scatter pass (measured 10.9 ms per
+    [33M,16] f32 scatter on v5e regardless of sorted/unique hints), so
+    Adam's three table passes (param+moment1+moment2) cost 3x what SGD's
+    one pass does."""
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
@@ -70,5 +78,22 @@ def build_train_program(vocab_size=100000, num_fields=26, num_dense=13,
         loss = layers.mean(
             layers.sigmoid_cross_entropy_with_logits(logit, label))
         prob = layers.sigmoid(logit)
-        fluid.optimizer.Adam(lr).minimize(loss)
+        if embedding_optimizer is None:
+            fluid.optimizer.Adam(lr).minimize(loss)
+        else:
+            if embedding_optimizer != "sgd":
+                raise ValueError(
+                    f"embedding_optimizer={embedding_optimizer!r}: only "
+                    "'sgd' is supported (one-state table updates)")
+            adam = fluid.optimizer.Adam(lr)
+            sgd = fluid.optimizer.SGD(lr)
+            # ONE backward pass, gradients split across the two rules
+            params_grads = adam.backward(loss)
+            table_names = {"fm_w1", "fm_emb"}
+            table_pg = [pg for pg in params_grads
+                        if pg[0].name in table_names]
+            dense_pg = [pg for pg in params_grads
+                        if pg[0].name not in table_names]
+            adam.apply_gradients(dense_pg)
+            sgd.apply_gradients(table_pg)
     return main, startup, ["sparse_ids", "dense", "label"], loss, prob

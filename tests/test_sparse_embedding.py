@@ -201,3 +201,33 @@ def test_deepfm_trains_with_sparse_grads():
                   for _ in range(6)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_deepfm_sgd_embedding_optimizer_converges():
+    """embedding_optimizer="sgd" (tables on SGD, dense net on Adam — one
+    backward pass split across two apply_gradients) trains: loss falls
+    and BOTH rules' params move."""
+    from paddle_tpu.models import deepfm
+
+    main, startup, feeds, loss, prob = deepfm.build_train_program(
+        vocab_size=1000, is_sparse=True, embedding_optimizer="sgd",
+        lr=0.05)
+    types = [op.type for op in main.global_block().ops]
+    assert "adam" in types and "sgd" in types
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1000, (64, 26)).astype("int64")
+    dense = rng.rand(64, 13).astype("float32")
+    label = (rng.rand(64, 1) > 0.5).astype("float32")
+    feed = {"sparse_ids": ids, "dense": dense, "label": label}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        emb0 = np.asarray(fluid.global_scope().find_var("fm_emb")).copy()
+        w0 = np.asarray(fluid.global_scope().find_var("deep_0.w_0")).copy()
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(60)]
+        emb1 = np.asarray(fluid.global_scope().find_var("fm_emb"))
+        w1 = np.asarray(fluid.global_scope().find_var("deep_0.w_0"))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    assert np.abs(emb1 - emb0).max() > 0      # sgd moved the table
+    assert np.abs(w1 - w0).max() > 0          # adam moved the dense net
