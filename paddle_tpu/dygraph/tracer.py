@@ -51,6 +51,8 @@ class _TapeEntry:
 
 class Tracer:
     def __init__(self, train_mode: bool = True, seed: int = 0):
+        self._seed = seed
+        self._op_counter = 0
         self.tape: List[_TapeEntry] = []
         self._train_mode = train_mode
         self._no_grad_depth = 0
@@ -83,27 +85,51 @@ class Tracer:
                      and any(not v.stop_gradient for vs in inputs.values() for v in vs))
         if not need_grad:
             in_vals = {s: [v.value for v in vs] for s, vs in inputs.items()}
-            out = opdef.fn(self._ctx, in_vals, attrs)
+            from ..ops import eager as _eager
+            prep = _eager._prepare(op_type, in_vals, attrs,
+                                   not self._train_mode, seed=self._seed)
+            if prep is not None:
+                jfn, _, struct, flat = prep
+                c = np.uint32(self._op_counter)
+                self._op_counter += 1
+                out = _eager._unflatten(struct, jfn(c, *flat))
+            else:
+                out = opdef.fn(self._ctx, in_vals, attrs)
             return {s: [VarBase(v, stop_gradient=True) for v in vs]
                     for s, vs in out.items()}
 
         in_slots = sorted(inputs)
         in_counts = [len(inputs[s]) for s in in_slots]
         flat_in_vars = [v for s in in_slots for v in inputs[s]]
-        out_struct: List[Tuple[str, int]] = []  # (slot, count) recorded in fn
 
-        def fn(*flat):
-            pos = 0
-            ins = {}
-            for s, c in zip(in_slots, in_counts):
-                ins[s] = list(flat[pos:pos + c])
-                pos += c
-            out = opdef.fn(self._ctx, ins, attrs)
-            out_struct.clear()
-            out_struct.extend((s, len(out[s])) for s in sorted(out))
-            return tuple(v for s, _ in out_struct for v in out[s])
+        from ..ops import eager as _eager
+        in_vals = {s: [v.value for v in vs] for s, vs in inputs.items()}
+        jit_res = _eager.vjp_call(op_type, in_vals, attrs,
+                                  not self._train_mode, seed=self._seed,
+                                  counter=self._op_counter)
+        if jit_res is not None:
+            # PreparedOp jit-cache path: one compiled XLA call per op;
+            # eager flattens inputs/outputs in the same sorted-slot order
+            # as the fallback below, so cotangent alignment is unchanged
+            self._op_counter += 1
+            out_dict, _, vjp_fn = jit_res
+            out_struct = [(s, len(out_dict[s])) for s in sorted(out_dict)]
+            flat_out = tuple(v for s, _ in out_struct for v in out_dict[s])
+        else:
+            out_struct = []
 
-        flat_out, vjp_fn = jax.vjp(fn, *[v.value for v in flat_in_vars])
+            def fn(*flat):
+                pos = 0
+                ins = {}
+                for s, c in zip(in_slots, in_counts):
+                    ins[s] = list(flat[pos:pos + c])
+                    pos += c
+                out = opdef.fn(self._ctx, ins, attrs)
+                out_struct.clear()
+                out_struct.extend((s, len(out[s])) for s in sorted(out))
+                return tuple(v for s, _ in out_struct for v in out[s])
+
+            flat_out, vjp_fn = jax.vjp(fn, *[v.value for v in flat_in_vars])
 
         outs: Dict[str, List[VarBase]] = {}
         out_vars: List[VarBase] = []

@@ -1,21 +1,38 @@
 """Eager op dispatch — the dygraph analog of PreparedOp.
 
 Reference analog: ``paddle/fluid/imperative/prepared_operator.h`` — run a
-single op immediately using the same kernel library as the static graph.
-Here, `call()` executes a registered op impl eagerly on jax.Arrays; the
-dygraph Tracer wraps it with vjp-taping for autograd (imperative/tracer.cc:35).
+single op immediately using the same kernel library as the static graph,
+with a per-(op, dtype/shape) prepared-kernel cache so repeated dispatches
+skip setup. Here that cache is a ``jax.jit`` executable per
+(op_type, input signature, attrs, is_test): the first call traces and
+compiles, later calls are ONE XLA execution instead of N primitive
+dispatches (SURVEY §7 "op-by-op jit cache" mitigation; VERDICT r3 #9).
+`call()` executes a registered op impl eagerly on jax.Arrays; the dygraph
+Tracer wraps it with vjp-taping for autograd (imperative/tracer.cc:35).
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
 import jax
+import numpy as np
 
 from ..core.executor import ExecContext
 
 
 _eager_ctx: Optional[ExecContext] = None
 _eager_seed = [0]
+_rng_counter = [0]
+_jit_cache: Dict = {}
+
+# ops that must NOT run under the jit cache: host-side effects, program
+# sub-blocks, or value-dependent python control flow inside the impl
+_NO_JIT = frozenset({
+    "print", "py_func", "save", "save_combine", "load", "load_combine",
+    "while", "cond", "conditional_block", "conditional_block_infer",
+    "switch", "recurrent", "static_rnn", "pipeline", "pipeline_hetero",
+    "feed", "fetch", "read", "delete_var", "py_reader",
+})
 
 
 def _ctx() -> ExecContext:
@@ -28,13 +45,135 @@ def _ctx() -> ExecContext:
 def set_eager_seed(seed: int):
     global _eager_ctx
     _eager_seed[0] = seed
+    _rng_counter[0] = 0
     _eager_ctx = ExecContext(jax.random.PRNGKey(seed))
+
+
+def _attrs_key(attrs: Dict):
+    try:
+        return tuple(sorted(
+            (k, tuple(v) if isinstance(v, (list, tuple)) else v)
+            for k, v in attrs.items()
+            if isinstance(v, (int, float, bool, str))
+            or (isinstance(v, (list, tuple))
+                and all(isinstance(x, (int, float, bool, str)) for x in v))))
+    except Exception:
+        return None
+
+
+def _prepare(op_type: str, inputs: Dict[str, List],
+             attrs: Optional[Dict], is_test: bool,
+             seed: Optional[int] = None):
+    """Resolve the (fwd_jit, bwd_jit, out_struct) cache entry for this
+    dispatch (plus the flat input list), or None when the op/inputs must
+    take the direct path. fwd_jit takes (rng_counter, *flat_arrays) and
+    returns a flat tuple; bwd_jit takes (rng_counter, cotangents,
+    *flat_arrays) and recomputes the forward inside the jit so the
+    backward is also ONE cached executable. out_struct fills on the first
+    execution. Dropout keys advance through the host-side counter folded
+    into the seed INSIDE the jit — no per-call host-side split."""
+    import os
+
+    from ..core import registry
+
+    attrs = attrs or {}
+    if op_type in _NO_JIT or os.environ.get("PDTPU_EAGER_JIT") == "0":
+        return None
+    akey = _attrs_key(attrs)
+    if akey is None or len(akey) != len(attrs):
+        return None  # non-scalar attr (e.g. a sub-block) → direct path
+    slots = sorted(inputs)
+    flat = []
+    sig = []
+    for s in slots:
+        for v in inputs[s]:
+            if not isinstance(v, jax.Array):
+                return None  # SelectedRows / host values → direct path
+            flat.append(v)
+            sig.append((s, v.shape, str(v.dtype)))
+    counts = tuple((s, len(inputs[s])) for s in slots)
+    seed = _eager_seed[0] if seed is None else seed
+    key = (op_type, tuple(sig), akey, bool(is_test), seed)
+    entry = _jit_cache.get(key)
+    if entry is None:
+        opdef = registry.get_op(op_type)
+        out_struct: List = []
+
+        def fn(counter, *flat_vals):
+            pos = 0
+            ins = {}
+            for s, c in counts:
+                ins[s] = list(flat_vals[pos:pos + c])
+                pos += c
+            k = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+            ctx = ExecContext(k, is_test=is_test)
+            out = opdef.fn(ctx, ins, dict(attrs))
+            out_struct.clear()
+            out_struct.extend((s, len(out[s])) for s in sorted(out))
+            return tuple(v for s, _ in out_struct for v in out[s])
+
+        def bwd(counter, cots, *flat_vals):
+            # recompute-forward backward: tracing happens ONCE (jit), so a
+            # steady-state grad dispatch is one executable launch — the
+            # extra forward FLOPs are cheap next to per-primitive dispatch
+            _, vjp = jax.vjp(lambda *f: fn(counter, *f), *flat_vals)
+            return vjp(tuple(cots))
+
+        entry = (jax.jit(fn), jax.jit(bwd), out_struct)
+        _jit_cache[key] = entry
+    fwd_jit, bwd_jit, struct = entry
+    return fwd_jit, bwd_jit, struct, flat
+
+
+def _next_counter() -> np.uint32:
+    c = _rng_counter[0]
+    _rng_counter[0] += 1
+    return np.uint32(c)
+
+
+def _unflatten(struct, flat_out):
+    out = {}
+    i = 0
+    for s, n in struct:
+        out[s] = list(flat_out[i:i + n])
+        i += n
+    return out
+
+
+def vjp_call(op_type: str, inputs: Dict[str, List],
+             attrs: Optional[Dict], is_test: bool,
+             seed: Optional[int] = None,
+             counter: Optional[int] = None):
+    """Cached-jit dispatch with a vjp, for the dygraph tracer's grad path:
+    returns (out {slot: [arrays]}, flat_inputs, vjp_fn over flat inputs),
+    or None for the direct path. Forward AND backward are cached jit
+    executables (the backward recomputes the forward from the saved
+    primal inputs — the flash-attention trade, applied to dispatch cost:
+    no per-call tracing survives in steady state)."""
+    prep = _prepare(op_type, inputs, attrs, is_test, seed=seed)
+    if prep is None:
+        return None
+    fwd_jit, bwd_jit, struct, flat = prep
+    c = _next_counter() if counter is None else np.uint32(counter)
+    flat_out = fwd_jit(c, *flat)
+
+    def vjp_fn(cots):
+        return bwd_jit(c, tuple(cots), *flat)
+
+    return _unflatten(struct, flat_out), flat, vjp_fn
 
 
 def call(op_type: str, inputs: Dict[str, List], attrs: Optional[Dict] = None,
          is_test: bool = False) -> Dict[str, List]:
-    """Run one op eagerly. inputs: slot -> list of jax arrays."""
+    """Run one op eagerly. inputs: slot -> list of jax arrays. Takes the
+    per-op jit cache when the op/inputs allow it, else dispatches the impl
+    directly."""
     from ..core import registry
+
+    prep = _prepare(op_type, inputs, attrs, is_test)
+    if prep is not None:
+        fwd_jit, _, struct, flat = prep
+        return _unflatten(struct, fwd_jit(_next_counter(), *flat))
 
     opdef = registry.get_op(op_type)
     ctx = _ctx()

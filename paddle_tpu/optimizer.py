@@ -135,6 +135,7 @@ class Optimizer:
         # rebuild from scratch: cached lr/accumulator vars belong to the
         # previous scratch program; names are deterministic, so accumulated
         # values transfer via the old-env merge below
+        self._dy_jit = None   # executable belongs to the old program
         self._lr_var = None
         self._accumulators = {}
         self._dy_prog = Program()
@@ -245,7 +246,25 @@ class Optimizer:
             env[p.name] = p.value
             env[grad_var_name(p.name)] = (p.grad_value if p.grad_value is not None
                                           else jnp.zeros_like(p.value))
-        _run_block(self._dy_prog.global_block(), env, self._dy_ctx)
+        # jit the whole update block (one executable per param-set) — the
+        # dygraph PreparedOp-cache story applied to the optimizer: N
+        # per-param update dispatches collapse into one launch. Non-array
+        # env entries (SelectedRows sparse grads etc.) fall back to the
+        # eager block run.
+        arr_env = {n: v for n, v in env.items() if isinstance(v, jax.Array)}
+        if len(arr_env) == len(env):
+            if getattr(self, "_dy_jit", None) is None:
+                block = self._dy_prog.global_block()
+
+                def _upd(e):
+                    e = dict(e)
+                    _run_block(block, e, ExecContext(None, is_test=True))
+                    return e
+
+                self._dy_jit = jax.jit(_upd)
+            env = self._dy_env = self._dy_jit(arr_env)
+        else:
+            _run_block(self._dy_prog.global_block(), env, self._dy_ctx)
         for p in params:
             p.value = env[p.name]
         return [], [(p, p.grad_value) for p in params]

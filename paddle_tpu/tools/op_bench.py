@@ -108,8 +108,97 @@ def _parse_input_flag(s: str):
     return slot, {"shape": shape, "dtype": dtype}
 
 
+def bench_dygraph_mlp(steps: int = 50, batch: int = 64, width: int = 256,
+                      depth: int = 4):
+    """Dygraph transformer-style MLP train-step micro-bench (VERDICT r3
+    #9): linear → layer_norm → gelu blocks, the realistic dygraph op mix
+    (multi-primitive ops are where per-op jit caching pays — a bare
+    single-primitive relu MLP measures launch count, not fusion). Eager
+    per-op jit cache (ops/eager.py _prepare — the PreparedOp analog,
+    imperative/prepared_operator.h) vs raw per-primitive dispatch
+    (PDTPU_EAGER_JIT=0). The two arms run as INTERLEAVED 10-step
+    segments and report per-arm medians — the tunnel runtime's dispatch
+    latency drifts by multiples over minutes, so back-to-back A/B runs
+    are meaningless. Returns {cached_ms, uncached_ms, speedup}."""
+    import os
+    import statistics
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph
+    from paddle_tpu.ops import eager as _eager
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(batch, width).astype("float32")
+    Y = rng.rand(batch, 1).astype("float32")
+    seg = 10
+    n_seg = max(2, steps // seg)
+
+    old = os.environ.get("PDTPU_EAGER_JIT")
+    os.environ.pop("PDTPU_EAGER_JIT", None)
+    try:
+        with dygraph.guard(seed=7):
+            layers_ = [dygraph.nn.Linear(width, width)
+                       for i in range(depth)] + [dygraph.nn.Linear(width, 1)]
+            lns = [dygraph.nn.LayerNorm(width) for _ in range(depth)]
+            opt = fluid.optimizer.SGD(0.01)
+            xv = dygraph.to_variable(X)
+            yv = dygraph.to_variable(Y)
+            from paddle_tpu.dygraph.tracer import trace_op
+            params = [q for ly in layers_ + lns for q in ly.parameters()]
+
+            def step():
+                h = xv
+                for i, ly in enumerate(layers_[:-1]):
+                    h = ly(h)
+                    h = lns[i](h)
+                    h = trace_op("gelu", {"X": [h]}, {})["Out"][0]
+                h = layers_[-1](h)
+                diff = trace_op("elementwise_sub", {"X": [h], "Y": [yv]},
+                                {"axis": -1})["Out"][0]
+                sq = trace_op("square", {"X": [diff]}, {})["Out"][0]
+                loss = trace_op("mean", {"X": [sq]}, {})["Out"][0]
+                loss.backward()
+                opt.minimize(loss, parameter_list=params)
+                for ly in layers_ + lns:
+                    ly.clear_gradients()
+                return loss
+
+            def segment(cached: bool):
+                if cached:
+                    os.environ.pop("PDTPU_EAGER_JIT", None)
+                else:
+                    os.environ["PDTPU_EAGER_JIT"] = "0"
+                step()  # warmup/compile for this arm
+                t0 = time.time()
+                for _ in range(seg):
+                    loss = step()
+                np.asarray(loss.value)
+                return (time.time() - t0) / seg * 1e3
+
+            cached_t, uncached_t = [], []
+            for _ in range(n_seg):
+                cached_t.append(segment(True))
+                uncached_t.append(segment(False))
+    finally:
+        if old is not None:
+            os.environ["PDTPU_EAGER_JIT"] = old
+        else:
+            os.environ.pop("PDTPU_EAGER_JIT", None)
+    cached = statistics.median(cached_t)
+    uncached = statistics.median(uncached_t)
+    return {"bench": "dygraph_mlp_step", "steps": steps,
+            "cached_ms": round(cached, 3), "uncached_ms": round(uncached, 3),
+            "speedup": round(uncached / cached, 2)}
+
+
 def main(argv: Optional[List[str]] = None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dygraph", action="store_true",
+                    help="run the dygraph MLP step bench (eager jit cache "
+                         "on vs off)")
     ap.add_argument("--op")
     ap.add_argument("--input", action="append", default=[],
                     help="SLOT=shape[:dtype], e.g. X=256x256:float32")
@@ -136,6 +225,10 @@ def main(argv: Optional[List[str]] = None):
         specs.append({"op": args.op, "inputs": inputs,
                       "attrs": json.loads(args.attrs),
                       "outputs": outputs or None, "repeat": args.repeat})
+    if args.dygraph:
+        print(json.dumps(bench_dygraph_mlp()))
+        if not specs:
+            return
     if not specs:
         ap.error("need --op or --config")
 

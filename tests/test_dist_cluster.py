@@ -69,3 +69,44 @@ def test_two_process_cluster_loss_equality(tmp_path):
     np.testing.assert_allclose(logs[0]["losses"], local, rtol=2e-4, atol=1e-5)
     # and training actually progressed
     assert logs[0]["losses"][-1] < logs[0]["losses"][0]
+
+
+def test_two_process_dygraph_data_parallel(tmp_path):
+    """VERDICT r3 #10: the dygraph DataParallel recipe (scale_loss →
+    backward → apply_collective_grads) across the 2-process localhost
+    cluster reproduces the single-process dygraph run exactly when both
+    ranks feed the same batch."""
+    from paddle_tpu.distributed import launch
+
+    runner = os.path.join(os.path.dirname(__file__),
+                          "dist_dygraph_runner.py")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
+    out = subprocess.run([sys.executable, "-u", runner, "--local"],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    local = json.loads(out.stdout.strip().splitlines()[-1])["losses"]
+    assert local[-1] < local[0] * 0.7  # it actually trains
+
+    env_backup = dict(os.environ)
+    for k in list(os.environ):
+        if k.startswith(("PADDLE_", "XLA_", "JAX_")):
+            del os.environ[k]
+    try:
+        procs, fds = launch.start_procs(
+            2, runner, [], started_port=_free_port(),
+            log_dir=str(tmp_path))
+        rc = launch.wait_procs(procs, fds)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    for rank in range(2):
+        text = (tmp_path / f"workerlog.{rank}").read_text()
+        assert rc == 0, f"rank{rank} log:\n{text[-2000:]}"
+        line = [l for l in text.splitlines() if l.startswith("{")][-1]
+        got = json.loads(line)
+        np.testing.assert_allclose(got["losses"], local, rtol=1e-5,
+                                   atol=1e-7,
+                                   err_msg=f"rank {rank} diverged from "
+                                           f"single-process dygraph")

@@ -259,3 +259,49 @@ def test_dygraph_layer_zoo_fixes():
 
         with pytest.raises(NotImplementedError):
             dygraph.nn.NCE(num_total_classes=10, dim=4, sampler="log_uniform")
+
+
+def test_eager_jit_cache_matches_direct_dispatch():
+    """The per-op jit cache (PreparedOp analog) must be numerically
+    invisible: same losses and updated params with PDTPU_EAGER_JIT=0."""
+    import os
+
+    from paddle_tpu.ops import eager as _eager
+
+    os.environ.pop("PDTPU_EAGER_JIT", None)  # ambient disable → vacuous
+
+    def run():
+        _eager._jit_cache.clear()
+        with dygraph.guard(seed=9):
+            m = dygraph.Linear(8, 4, act="tanh")
+            head = dygraph.Linear(4, 1)
+            opt = fluid.optimizer.Adam(0.05)
+            rng = np.random.RandomState(0)
+            X = rng.rand(16, 8).astype("float32")
+            Y = rng.rand(16, 1).astype("float32")
+            from paddle_tpu.dygraph.tracer import trace_op
+            params = m.parameters() + head.parameters()
+            losses = []
+            for _ in range(5):
+                out = head(m(dygraph.to_variable(X)))
+                d = trace_op("elementwise_sub",
+                             {"X": [out], "Y": [dygraph.to_variable(Y)]},
+                             {"axis": -1})["Out"][0]
+                loss = trace_op("mean", {"X": [trace_op(
+                    "square", {"X": [d]}, {})["Out"][0]]}, {})["Out"][0]
+                losses.append(float(np.asarray(loss.value)))
+                loss.backward()
+                opt.minimize(loss, parameter_list=params)
+                m.clear_gradients(); head.clear_gradients()
+            w = np.asarray(m.weight.value)
+        return losses, w
+
+    cached_losses, cached_w = run()
+    os.environ["PDTPU_EAGER_JIT"] = "0"
+    try:
+        direct_losses, direct_w = run()
+    finally:
+        os.environ.pop("PDTPU_EAGER_JIT", None)
+    np.testing.assert_allclose(cached_losses, direct_losses, rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(cached_w, direct_w, rtol=1e-5, atol=1e-6)
