@@ -5,13 +5,15 @@ protobuf ``ProgramDesc`` program files plus raw per-variable tensor
 streams — into a ``paddle_tpu`` Program + host arrays, so trained
 artifacts migrate, not just scripts.
 """
-from .reference_format import (load_reference_inference_model,
+from .reference_format import (export_reference_inference_model,
+                               load_reference_inference_model,
                                load_reference_persistables,
                                parse_program_desc, read_lod_tensor_stream,
                                serialize_program_desc,
                                write_lod_tensor_stream)
 
 __all__ = [
+    "export_reference_inference_model",
     "load_reference_inference_model", "load_reference_persistables",
     "parse_program_desc", "read_lod_tensor_stream",
     "serialize_program_desc", "write_lod_tensor_stream",

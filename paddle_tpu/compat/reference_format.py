@@ -471,3 +471,126 @@ def load_reference_inference_model(dirname: str,
     for name, arr in params.items():
         scope.set_var(name, arr)
     return prog, feed_names, fetch_names
+
+
+# -- export (artifacts flow BACK to the reference) --------------------------
+
+VT_FEED_MINIBATCH = 9
+VT_FETCH_LIST = 10
+
+
+def export_reference_inference_model(dirname: str, feed_names, fetch_names,
+                                     program, scope=None,
+                                     params_filename: Optional[str] = None):
+    """Write a paddle_tpu inference Program + its persistables in the
+    REFERENCE's binary formats — the inverse of
+    :func:`load_reference_inference_model`, so models trained here can be
+    served by the reference's load_inference_model (io.py:1113) /
+    AnalysisPredictor. Emits the feed/fetch ops and holder vars the
+    reference loader expects (io.py save_inference_model conventions) and
+    one LoDTensor stream per persistable (or a save_combine-style single
+    file when ``params_filename`` is given, in block var order)."""
+    import paddle_tpu as fluid
+
+    scope = scope or fluid.global_scope()
+    if len(program.blocks) > 1:
+        raise NotImplementedError(
+            f"export_reference_inference_model: program has "
+            f"{len(program.blocks)} blocks — control-flow sub-blocks have "
+            f"no export path yet; export an inference-pruned single-block "
+            f"program (the loader refuses these too)")
+    block = program.global_block()
+    feed_names = list(feed_names)
+    fetch_names = list(fetch_names)
+
+    varz = {
+        "feed": {"name": "feed", "type": VT_FEED_MINIBATCH, "dtype": None,
+                 "shape": None, "persistable": True, "lod_level": 0},
+        "fetch": {"name": "fetch", "type": VT_FETCH_LIST, "dtype": None,
+                  "shape": None, "persistable": True, "lod_level": 0},
+    }
+    for v in program.list_vars():
+        shape = None
+        try:
+            shape = [int(d) if d is not None else -1 for d in (v.shape or [])]
+        except Exception:
+            pass
+        from ..core.dtypes import dtype_str
+        try:
+            dt = dtype_str(getattr(v, "dtype", "float32") or "float32")
+        except Exception:
+            dt = "float32"
+        if dt not in _DTYPE_IDS:
+            raise ValueError(
+                f"export_reference_inference_model: var {v.name!r} dtype "
+                f"{dt} has no reference VarType encoding (the Fluid 1.5 "
+                f"schema predates bf16) — cast persistables to float32 "
+                f"before export")
+        varz[v.name] = {
+            "name": v.name, "type": VT_LOD_TENSOR,
+            "dtype": dt, "shape": shape,
+            "persistable": bool(v.persistable), "lod_level": 0,
+        }
+
+    def _clean_attrs(op):
+        out = {}
+        for k, val in op.attrs.items():
+            if k.startswith("op_"):
+                continue  # op_role/op_role_var markers — loader ignores
+            if k in ("sub_block", "sub_blocks"):
+                raise NotImplementedError(
+                    f"export_reference_inference_model: op {op.type} "
+                    f"carries a sub-block — control flow cannot be "
+                    f"exported")
+            if isinstance(val, (bool, int, float, str)):
+                out[k] = val
+            elif isinstance(val, (list, tuple)) and all(
+                    isinstance(x, (bool, int, float, str)) for x in val):
+                out[k] = list(val)
+            elif hasattr(val, "item"):            # numpy scalar
+                out[k] = val.item()
+            else:
+                raise ValueError(
+                    f"export_reference_inference_model: op {op.type} attr "
+                    f"{k!r} ({type(val).__name__}) has no reference wire "
+                    f"encoding — prune it or export a simpler program")
+        return out
+
+    ops = []
+    for i, n in enumerate(feed_names):
+        ops.append({"type": "feed", "inputs": {"X": ["feed"]},
+                    "outputs": {"Out": [n]}, "attrs": {"col": i}})
+    for op in block.ops:
+        ops.append({"type": op.type, "inputs": dict(op.inputs),
+                    "outputs": dict(op.outputs), "attrs": _clean_attrs(op)})
+    for i, n in enumerate(fetch_names):
+        ops.append({"type": "fetch", "inputs": {"X": [n]},
+                    "outputs": {"Out": ["fetch"]}, "attrs": {"col": i}})
+
+    desc = {"blocks": [{"idx": 0, "parent_idx": -1, "vars": varz,
+                        "ops": ops}]}
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(serialize_program_desc(desc))
+
+    persist = []
+    for v in program.list_vars():
+        if not v.persistable:
+            continue
+        if scope.find_var(v.name) is None:
+            raise ValueError(
+                f"export_reference_inference_model: persistable var "
+                f"{v.name!r} has no value in the scope — exporting would "
+                f"desynchronize the combined-params stream order the "
+                f"reference loader expects (run startup / load weights "
+                f"first, or pass the right scope)")
+        persist.append(v.name)
+    if params_filename is not None:
+        with open(os.path.join(dirname, params_filename), "wb") as f:
+            for n in persist:
+                write_lod_tensor_stream(f, np.asarray(scope.find_var(n)))
+    else:
+        for n in persist:
+            with open(os.path.join(dirname, n), "wb") as f:
+                write_lod_tensor_stream(f, np.asarray(scope.find_var(n)))
+    return fetch_names

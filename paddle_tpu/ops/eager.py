@@ -32,6 +32,8 @@ _NO_JIT = frozenset({
     "while", "cond", "conditional_block", "conditional_block_infer",
     "switch", "recurrent", "static_rnn", "pipeline", "pipeline_hetero",
     "feed", "fetch", "read", "delete_var", "py_reader",
+    # output shape depends on input VALUES — unjittable by construction
+    "range", "linspace", "where_index", "unique", "unique_with_counts",
 })
 
 

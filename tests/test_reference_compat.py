@@ -29,6 +29,32 @@ DATA = os.path.join(os.path.dirname(__file__), "data", "ref_mnist_model")
 REF_PROTO = "/root/reference/paddle/fluid/framework/framework.proto"
 
 
+
+def _reference_pb2(tmp_path):
+    """Compile the reference framework.proto with protoc and import the
+    generated module, or pytest.skip when the toolchain is unavailable."""
+    if shutil.which("protoc") is None or not os.path.exists(REF_PROTO):
+        pytest.skip("protoc or reference proto unavailable")
+    try:
+        import google.protobuf  # noqa: F401
+    except ImportError:
+        pytest.skip("protobuf runtime unavailable")
+    work = tmp_path / "pbgen"
+    work.mkdir(exist_ok=True)
+    shutil.copy(REF_PROTO, work / "framework.proto")
+    res = subprocess.run(
+        ["protoc", "-I", str(work), "--python_out", str(work),
+         "framework.proto"], capture_output=True, text=True)
+    if res.returncode != 0:
+        pytest.skip(f"protoc failed: {res.stderr[:200]}")
+    sys.path.insert(0, str(work))
+    try:
+        import framework_pb2
+    finally:
+        sys.path.pop(0)
+    return framework_pb2
+
+
 def _sample_prog():
     return {"blocks": [{
         "idx": 0, "parent_idx": -1,
@@ -76,25 +102,7 @@ def test_wire_format_matches_reference_proto(tmp_path):
     classes compiled from the REFERENCE's framework.proto — if our
     hand-rolled writer/parser disagreed with the real schema, this would
     catch it."""
-    if shutil.which("protoc") is None or not os.path.exists(REF_PROTO):
-        pytest.skip("protoc or reference proto unavailable")
-    try:
-        import google.protobuf  # noqa: F401
-    except ImportError:
-        pytest.skip("protobuf runtime unavailable")
-    work = tmp_path / "pb"
-    work.mkdir()
-    shutil.copy(REF_PROTO, work / "framework.proto")
-    res = subprocess.run(
-        ["protoc", "-I", str(work), "--python_out", str(work),
-         "framework.proto"], capture_output=True, text=True)
-    if res.returncode != 0:
-        pytest.skip(f"protoc failed: {res.stderr[:200]}")
-    sys.path.insert(0, str(work))
-    try:
-        import framework_pb2  # generated from the reference schema
-    finally:
-        sys.path.pop(0)
+    framework_pb2 = _reference_pb2(tmp_path)
 
     data = rf.serialize_program_desc(_sample_prog())
     desc = framework_pb2.ProgramDesc()
@@ -218,3 +226,78 @@ def test_loader_guards(tmp_path):
     with open(p, "rb") as f:
         back_arr, _ = rf.read_lod_tensor_stream(f)
     np.testing.assert_array_equal(back_arr, arr)
+
+
+def test_export_then_load_reference_roundtrip(tmp_path):
+    """Write-side interop: a model trained HERE exports in the reference's
+    binary formats, reloads through the reference-format loader, predicts
+    identically — and the written __model__ parses with protoc classes
+    generated from the reference's own schema (when protoc exists)."""
+    import paddle_tpu.compat as compat
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="e.w1"),
+                            bias_attr=fluid.ParamAttr(name="e.b1"))
+        out = fluid.layers.fc(h, 3, param_attr=fluid.ParamAttr(name="e.w2"),
+                              bias_attr=False)
+        prob = fluid.layers.softmax(out)
+    startup.random_seed = 5
+    rng = np.random.RandomState(0)
+    X = rng.rand(4, 8).astype("float32")
+    exdir = tmp_path / "export"
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        (ref_out,) = exe.run(main, feed={"x": X}, fetch_list=[prob])
+        compat.export_reference_inference_model(
+            str(exdir), ["x"], [prob.name], main)
+    assert (exdir / "__model__").exists()
+    assert (exdir / "e.w1").exists()
+
+    with fluid.scope_guard(fluid.Scope()):
+        prog2, feeds, fetches = compat.load_reference_inference_model(
+            str(exdir))
+        assert feeds == ["x"] and fetches == [prob.name]
+        exe = fluid.Executor(fluid.TPUPlace())
+        (got,) = exe.run(prog2, feed={"x": X}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref_out),
+                               rtol=1e-6, atol=1e-7)
+
+    # authenticity: the exported bytes parse through the reference schema
+    # (skips, loudly, when the toolchain is absent)
+    framework_pb2 = _reference_pb2(tmp_path)
+    desc = framework_pb2.ProgramDesc()
+    desc.ParseFromString((exdir / "__model__").read_bytes())
+    types = [o.type for o in desc.blocks[0].ops]
+    assert types[0] == "feed" and types[-1] == "fetch"
+    assert "mul" in types and "softmax" in types
+    names = {v.name for v in desc.blocks[0].vars}
+    assert {"feed", "fetch", "e.w1", "e.w2"} <= names
+
+
+def test_export_guards(tmp_path):
+    """Review r4: the exporter refuses control-flow programs, scope-less
+    persistables, and bf16 vars loudly instead of writing broken bytes."""
+    import paddle_tpu.compat as compat
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        out = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(name="g.w"),
+                              bias_attr=False)
+    # persistable with no scope value
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(ValueError, match="no value in the scope"):
+            compat.export_reference_inference_model(
+                str(tmp_path / "g1"), ["x"], [out.name], main)
+    # bf16 var
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        main.global_block().var("g.w").dtype = "bfloat16"
+        with pytest.raises(ValueError, match="bf16|float32"):
+            compat.export_reference_inference_model(
+                str(tmp_path / "g2"), ["x"], [out.name], main)
