@@ -1011,3 +1011,109 @@ def test_sweep_coverage_counter():
         f"< 400 of {len(all_ops)}; unaccounted: "
         f"{sorted(all_ops - covered - exempt)[:40]}...")
     assert not (covered & exempt), sorted(covered & exempt)
+
+
+# ---------------------------------------------------------- golden values
+# numpy reference formulas for families whose math is short enough to
+# state exactly (the dedicated test_*_op suites carry the complex ones) —
+# this is the check_output half of op_test.py:544 for the long tail.
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+GOLDEN = {
+    "relu": lambda x: np.maximum(x, 0),
+    "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+    "tanh": np.tanh,
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+    "silu": lambda x: x / (1 + np.exp(-x)),
+    "swish": lambda x: x / (1 + np.exp(-x)),
+    "logsigmoid": lambda x: -np.log1p(np.exp(-x)),
+    "tanh_shrink": lambda x: x - np.tanh(x),
+    "relu6": lambda x: np.clip(x, 0, 6),
+    "leaky_relu": lambda x: np.where(x >= 0, x, 0.02 * x),
+    "elu": lambda x: np.where(x >= 0, x, np.exp(x) - 1),
+    "softmax": _np_softmax,
+    "log_softmax": lambda x: np.log(_np_softmax(x)),
+    "abs": np.abs, "exp": np.exp, "log": np.log, "log1p": np.log1p,
+    "sqrt": np.sqrt, "rsqrt": lambda x: 1 / np.sqrt(x),
+    "reciprocal": lambda x: 1 / x, "square": np.square,
+    "sin": np.sin, "cos": np.cos, "tan": np.tan,
+    "sinh": np.sinh, "cosh": np.cosh,
+    "ceil": np.ceil, "floor": np.floor, "round": np.round,
+    "sign": np.sign, "erf": None,  # scipy-free: checked via grad only
+    "cumsum": lambda x: np.cumsum(x, axis=-1),
+    "elementwise_add": lambda x, y: x + y,
+    "elementwise_sub": lambda x, y: x - y,
+    "elementwise_mul": lambda x, y: x * y,
+    "elementwise_div": lambda x, y: x / y,
+    "elementwise_max": np.maximum,
+    "elementwise_min": np.minimum,
+    "elementwise_pow": np.power,
+    "elementwise_mod": lambda x, y: np.mod(x, y),
+    "elementwise_floordiv": lambda x, y: x // y,
+    "equal": lambda x, y: x == y, "not_equal": lambda x, y: x != y,
+    "less_than": lambda x, y: x < y, "less_equal": lambda x, y: x <= y,
+    "greater_than": lambda x, y: x > y,
+    "greater_equal": lambda x, y: x >= y,
+    "logical_and": np.logical_and, "logical_or": np.logical_or,
+    "logical_xor": np.logical_xor, "logical_not": np.logical_not,
+    "isfinite": lambda x: np.isfinite(x).all(),
+    "reduce_sum": lambda x: x.sum(axis=1),
+    "reduce_mean": lambda x: x.mean(axis=1),
+    "reduce_max": lambda x: x.max(axis=1),
+    "reduce_min": lambda x: x.min(axis=1),
+    "reduce_prod": lambda x: x.prod(axis=1),
+    "logsumexp": lambda x: np.log(np.exp(x).sum(axis=1)),
+    "frobenius_norm": lambda x: np.sqrt((x ** 2).sum(axis=1)),
+    "mean": lambda x: x.mean(),
+    "matmul": lambda x, y: x @ y, "mul": lambda x, y: x @ y,
+    "dot": lambda x, y: (x * y).sum(-1, keepdims=True),
+    "sum": lambda *xs: np.sum(xs, axis=0),
+    "minus": lambda x, y: x - y,
+    "scale": lambda x: x * 2.0 + 1.0,
+    "clip": lambda x: np.clip(x, -0.3, 0.3),
+    "pow": lambda x: np.power(x, 2.5),
+    "squared_l2_norm": lambda x: np.array((x ** 2).sum(), "float32"),
+    "l1_norm": lambda x: np.array(np.abs(x).sum(), "float32"),
+    "transpose": lambda x: np.transpose(x, (0, 2, 1)),
+    "concat": lambda a, b: np.concatenate([a, b], 0),
+    "stack": lambda a, b: np.stack([a, b], 0),
+    "reshape": lambda x: x.reshape(3, 4),
+    "flatten": lambda x: x.reshape(2, 12),
+    "squeeze": lambda x: x.squeeze(1),
+    "unsqueeze": lambda x: x[:, None],
+    "expand": lambda x: np.tile(x, (2, 1)),
+    "tile": lambda x: np.tile(x, (2, 2)),
+    "gather": lambda i, x: x[i],  # args arrive in sorted-slot order
+    "assign": lambda x: x,
+    "fill_zeros_like": np.zeros_like,
+    "fill_zeros_like2": np.zeros_like,
+    "ones_like": np.ones_like,
+    "fill_any_like": lambda x: np.full_like(x, 2.0),
+    "sign": np.sign,
+}
+GOLDEN = {k: v for k, v in GOLDEN.items() if v is not None}
+
+
+@pytest.mark.parametrize("op_type", sorted(set(GOLDEN) & set(FIXTURES)
+                                           & set(registry.registered_ops())))
+def test_op_matches_numpy_golden(op_type):
+    fx = FIXTURES[op_type]
+    got = _eager(op_type, fx)[fx.outs[0]][0]
+    args = [np.asarray(v, np.float64
+                       if np.issubdtype(np.asarray(v).dtype, np.floating)
+                       else np.asarray(v).dtype)
+            for vs in (fx.inputs[s] for s in sorted(fx.inputs))
+            for v in vs]
+    exp = GOLDEN[op_type](*args)
+    got = np.asarray(got)
+    if got.dtype == bool or exp.dtype == bool:
+        np.testing.assert_array_equal(got.astype(bool),
+                                      np.asarray(exp, bool).reshape(got.shape))
+    else:
+        np.testing.assert_allclose(
+            got.astype(np.float64), np.asarray(exp, np.float64).reshape(got.shape),
+            rtol=2e-5, atol=2e-6, err_msg=f"{op_type} vs numpy")
