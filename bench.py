@@ -499,10 +499,10 @@ def main():
                     q, k, v, _mesh1, causal=True, impl=impl))
                 o = f(_q, _k, _v); np.asarray(o.ravel()[0])
                 t0 = time.time()
-                for _ in range(20):
+                for _ in range(10):
                     o = f(_q, _k, _v)
                 np.asarray(o.ravel()[0])
-                return (time.time() - t0) / 20
+                return (time.time() - t0) / 10
             ring_speedup = round(_bench_ring("jnp") /
                                  _bench_ring("pallas"), 2)
     except Exception as e:  # pragma: no cover
@@ -515,7 +515,7 @@ def main():
     try:
         if on_tpu:
             from paddle_tpu.tools.op_bench import bench_dygraph_mlp
-            dy = bench_dygraph_mlp(steps=30)
+            dy = bench_dygraph_mlp(steps=20)
     except Exception as e:  # pragma: no cover
         extras2["dygraph_bench_error"] = str(e)[:120]
     extras2["dygraph_jit_cache_speedup"] = (dy or {}).get("speedup")
