@@ -107,8 +107,22 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                          main_program: Optional[Program] = None,
                          model_filename: Optional[str] = None,
                          params_filename: Optional[str] = None,
-                         export_for_deployment: bool = True):
-    """io.py:933 parity: prune to feed→fetch, save program + params."""
+                         export_for_deployment: bool = True,
+                         format: str = "native"):
+    """io.py:933 parity: prune to feed→fetch, save program + params.
+
+    ``format="reference"`` writes the artifact in the REFERENCE's binary
+    formats instead (protobuf ProgramDesc ``__model__`` + LoDTensor var
+    streams, compat.export_reference_inference_model) so the reference's
+    own load_inference_model can serve a model trained here."""
+    if format not in ("native", "reference"):
+        raise ValueError(f"save_inference_model: unknown format {format!r} "
+                         "(use 'native' or 'reference')")
+    if format == "reference" and model_filename is not None:
+        raise ValueError(
+            "save_inference_model(format='reference') always writes the "
+            "reference loader's default '__model__' file; model_filename "
+            "is not supported there")
     main_program = main_program or default_main_program()
     fetch_names = [t.name for t in target_vars]
     blk = main_program.global_block()
@@ -118,6 +132,11 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
             f"target_vars {missing} are not in main_program — were they "
             f"created under a different program (check program_guard scope)?")
     pruned = main_program._prune_for_inference(feeded_var_names, fetch_names)
+    if format == "reference":
+        from .compat import export_reference_inference_model
+        return export_reference_inference_model(
+            dirname, feeded_var_names, fetch_names, pruned,
+            params_filename=params_filename)
     os.makedirs(dirname, exist_ok=True)
     model = {
         "program": pruned.to_dict(),

@@ -301,3 +301,34 @@ def test_export_guards(tmp_path):
         with pytest.raises(ValueError, match="bf16|float32"):
             compat.export_reference_inference_model(
                 str(tmp_path / "g2"), ["x"], [out.name], main)
+
+
+def test_save_inference_model_reference_format(tmp_path):
+    """fluid.io.save_inference_model(format="reference") writes the
+    reference's binary artifact directly from the public API."""
+    import paddle_tpu.compat as compat
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        out = fluid.layers.fc(x, 2, act="tanh",
+                              param_attr=fluid.ParamAttr(name="s.w"),
+                              bias_attr=fluid.ParamAttr(name="s.b"))
+    startup.random_seed = 4
+    rng = np.random.RandomState(1)
+    X = rng.rand(3, 6).astype("float32")
+    d = tmp_path / "refout"
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        (ref,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+        fluid.io.save_inference_model(str(d), ["x"], [out], exe,
+                                      main_program=main,
+                                      format="reference")
+    assert (d / "__model__").exists() and (d / "s.w").exists()
+    with fluid.scope_guard(fluid.Scope()):
+        prog2, feeds, fetches = compat.load_reference_inference_model(str(d))
+        exe = fluid.Executor(fluid.TPUPlace())
+        (got,) = exe.run(prog2, feed={"x": X}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-7)
