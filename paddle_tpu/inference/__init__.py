@@ -17,6 +17,8 @@ Weight sharing across clones = sharing the same device arrays (zero-copy).
 """
 from __future__ import annotations
 
+import os
+
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -35,6 +37,19 @@ class PrecisionType:
     # API-compat alias: the reference's Half means fp16 on GPU; on TPU the
     # low-precision serving dtype is bf16.
     Half = "bfloat16"
+
+
+def _is_reference_model_file(path: str) -> bool:
+    """Binary-protobuf reference __model__ vs this framework's JSON model:
+    the native format starts with '{' (a JSON object); the protobuf wire
+    format's first byte is a field tag (ProgramDesc.blocks = field 1,
+    length-delimited → 0x0a)."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(1)
+    except OSError:
+        return False
+    return bool(head) and head != b"{"
 
 
 class Config:
@@ -160,13 +175,28 @@ class Predictor:
         if cfg.model_dir() is None:
             raise ValueError("Config.set_model(dir) required")
         scope = Scope()
+        model_path = os.path.join(cfg.model_dir(),
+                                  cfg._model_filename or "__model__")
         with scope_guard(scope):
-            exe = Executor(TPUPlace())
-            program, feed_names, fetch_vars = io.load_inference_model(
-                cfg.model_dir(), exe,
-                model_filename=cfg._model_filename,
-                params_filename=cfg._params_filename)
-        fetch_names = [v.name for v in fetch_vars]
+            if _is_reference_model_file(model_path):
+                # a model dir the REFERENCE framework saved (binary
+                # protobuf ProgramDesc + LoDTensor var streams) serves
+                # directly — AnalysisPredictor parity for migrated
+                # artifacts (compat/reference_format.py)
+                from ..compat import load_reference_inference_model
+                program, feed_names, fetch_names = \
+                    load_reference_inference_model(
+                        cfg.model_dir(),
+                        model_filename=cfg._model_filename,
+                        params_filename=cfg._params_filename,
+                        scope=scope)
+            else:
+                exe = Executor(TPUPlace())
+                program, feed_names, fetch_vars = io.load_inference_model(
+                    cfg.model_dir(), exe,
+                    model_filename=cfg._model_filename,
+                    params_filename=cfg._params_filename)
+                fetch_names = [v.name for v in fetch_vars]
         if cfg.ir_optim():
             builder = cfg.pass_builder()
             with scope_guard(scope):  # weight-folding passes edit the scope

@@ -332,3 +332,19 @@ def test_save_inference_model_reference_format(tmp_path):
         (got,) = exe.run(prog2, feed={"x": X}, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_predictor_serves_reference_format_dir(tmp_path):
+    """inference.Predictor auto-detects a reference-format model dir and
+    serves it — AnalysisPredictor parity for migrated artifacts."""
+    from paddle_tpu import inference
+
+    cfg = inference.Config(DATA)
+    pred = inference.create_predictor(cfg)
+    assert pred.get_input_names() == ["img"]
+    exp = np.load(os.path.join(DATA, "expected.npz"))
+    h = pred.get_input_handle("img")
+    h.copy_from_cpu(exp["x"])
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, exp["prob"], rtol=1e-5, atol=1e-5)
