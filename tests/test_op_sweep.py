@@ -876,6 +876,38 @@ for _k in ["hard_shrink", "softshrink", "thresholded_relu", "maxout",
     if _k in FIXTURES:
         FIXTURES[_k].grad = None
 
+
+# smooth long-tail ops: enable the directional grad check with the right
+# input slot (the kinked/sampled/selection ops stay excluded above)
+_GRAD_ENABLE = {
+    "lstm": "Input", "gru": "Input", "gru_unit": "Input",
+    "lstm_unit": "X", "lstmp": "Input", "fusion_lstm": "X",
+    "fusion_gru": "X", "cudnn_lstm": "Input", "attention_lstm": "X",
+    "sequence_pool": "X", "sequence_softmax": "X",
+    "sequence_reverse": "X", "sequence_pad": "X", "sequence_unpad": "X",
+    "sequence_reshape": "X", "sequence_expand_as": "X",
+    "sequence_conv": "X", "im2sequence": "X", "sequence_scatter": "X",
+    "cross_entropy": "X", "bpr_loss": "X", "sigmoid_focal_loss": "X",
+    "center_loss": "X", "hierarchical_sigmoid": "X",
+    "linear_chain_crf": "Emission", "warpctc": "Logits",
+    "flash_attention": "Q", "roi_align": "X", "psroi_pool": "X",
+    # spectral_norm: power-iteration u/v are stop_gradient buffers
+    # (reference semantics), so analytic != FD by design — excluded
+    "pool3d": "X", "cvm": "X",
+    "lod_reset": "X", "multiplex": "X", "unpool": "X",
+    "tree_conv": "NodesVector", "match_matrix_tensor": "X",
+    "var_conv_2d": "X", "fusion_squared_mat_sub": "X",
+    "fusion_transpose_flatten_concat": "X", "fusion_seqpool_concat": "X",
+    "fused_embedding_seq_pool": "W", "nce": "Input",
+    "sample_logits": "Logits", "select": "X",
+}
+for _n, _slot in _GRAD_ENABLE.items():
+    if _n in FIXTURES:
+        FIXTURES[_n].grad = _slot
+        FIXTURES[_n].delta = 1e-3
+        if FIXTURES[_n].gout is None:
+            FIXTURES[_n].gout = FIXTURES[_n].outs[0]
+
 # ------------------------------------------------------------------ checks
 
 EXEMPT = {
