@@ -869,7 +869,7 @@ FIXTURES["select"] = Fx(
 # suites with carefully-placed inputs
 for _k in ["hard_shrink", "softshrink", "thresholded_relu", "maxout",
            "reduce_max", "reduce_min", "max", "elementwise_max",
-           "elementwise_min", "pool2d", "pool3d", "relu", "relu6",
+           "elementwise_min", "pool2d", "relu", "relu6",
            "leaky_relu", "prelu", "abs", "hard_sigmoid", "hard_swish",
            "brelu", "elu", "clip", "huber_loss", "smooth_l1_loss",
            "nearest_interp", "selu", "max_pool2d_with_index"]:
@@ -877,8 +877,12 @@ for _k in ["hard_shrink", "softshrink", "thresholded_relu", "maxout",
         FIXTURES[_k].grad = None
 
 
-# smooth long-tail ops: enable the directional grad check with the right
-# input slot (the kinked/sampled/selection ops stay excluded above)
+# long-tail ops that are smooth W.R.T. THE PERTURBED SLOT under the
+# harness's fixed PRNG key: sampled ops (nce, sample_logits) draw the
+# same samples on every FD evaluation, and selection ops (multiplex,
+# select, unpool) select by inputs the check never perturbs — so central
+# differences are valid for all of them. Truly kinked-in-the-slot ops
+# stay excluded above.
 _GRAD_ENABLE = {
     "lstm": "Input", "gru": "Input", "gru_unit": "Input",
     "lstm_unit": "X", "lstmp": "Input", "fusion_lstm": "X",
@@ -905,8 +909,6 @@ for _n, _slot in _GRAD_ENABLE.items():
     if _n in FIXTURES:
         FIXTURES[_n].grad = _slot
         FIXTURES[_n].delta = 1e-3
-        if FIXTURES[_n].gout is None:
-            FIXTURES[_n].gout = FIXTURES[_n].outs[0]
 
 # ------------------------------------------------------------------ checks
 
