@@ -644,15 +644,16 @@ class _AutoLayoutStep:
         return bases
 
     def _relayout_accumulators(self, state, feed, key):
-        """Second compile pass: pin every optimizer accumulator to its base
-        parameter's AUTO-chosen layout. The AUTO solver optimizes each
-        array's layout for its own uses — conv weights get conv-friendly
-        tilings (e.g. {1,3,2,0:T(1,128)} on 1x1 kernels) while their
-        velocities get the default {1,0,3,2:T(8,128)}, so every momentum
-        update fuses a physical tile-format transpose. Measured on the
-        ResNet-50 recipe: the 37 mismatched 1x1-conv/fc updates ran at
-        ~50 GB/s, 10.0 of the 46.5 ms device step; pinning v to p's layout
-        removes the transpose."""
+        """Second compile pass: pin every optimizer accumulator to its
+        base parameter's AUTO-chosen layout, guarding against the AUTO
+        solver choosing DIFFERENT tilings for a param and its velocity
+        (which would fuse a physical tile-format transpose into every
+        update). On the ResNet-50 recipe the solver already agrees
+        (trace-audited: zero mismatches in the train-step module — the
+        apparent 'slow update kernels' were wgrad reductions reading
+        activations, already near stream rate), so this pass usually
+        compiles nothing; it exists so a future solver change can't
+        silently regress update bandwidth."""
         from jax.experimental.layout import Format
 
         in_state = dict(self._compiled.input_formats[0][0])
