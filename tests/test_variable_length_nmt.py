@@ -228,3 +228,49 @@ def test_packed_rows_match_separate_sentences():
             tok_losses.append((li, n_tok))
     expected = sum(l * n for l, n in tok_losses) / sum(n for _, n in tok_losses)
     np.testing.assert_allclose(packed_loss, expected, rtol=2e-5, atol=1e-6)
+
+
+def test_pack_by_tokens_edge_cases():
+    """Packer contract details: oversized pairs are dropped (bucketing's
+    rule), rows split exactly at budget boundaries, and degenerate
+    single-token targets (no trainable position) are skipped."""
+    pairs = [
+        (np.arange(1, 5), np.arange(1, 5)),       # fits
+        (np.arange(1, 40), np.arange(1, 6)),      # src over budget → drop
+        (np.arange(1, 3), np.array([7])),         # lt = 0 → drop
+        (np.arange(1, 9), np.arange(1, 9)),       # fills the rest
+        (np.arange(1, 6), np.arange(1, 6)),       # forces a new row
+    ]
+    rows = list(rd.pack_by_tokens(lambda: iter(pairs), 12, 12)())
+    assert len(rows) == 2
+    # row 0: pair 0 (src 4, tgt 3) + pair 3 (src 8, tgt 7) = src 12/12
+    assert rows[0]["src_seg"].max() == 2
+    assert (rows[0]["src_seg"] > 0).sum() == 12
+    assert (rows[0]["tgt_seg"] > 0).sum() == 3 + 7
+    # row 1: pair 4 alone
+    assert rows[1]["src_seg"].max() == 1
+    assert (rows[1]["src_seg"] > 0).sum() == 5
+    # per-segment positions restart at 0
+    assert rows[0]["src_pos"][4] == 0  # first token of segment 2
+    # labels are the shifted targets
+    np.testing.assert_array_equal(rows[1]["lbl_ids"][:4],
+                                  np.arange(2, 6))
+
+
+def test_packed_attention_masks_block_structure():
+    """Masks are exactly block-diagonal by segment: no cross-sentence
+    attention, pads see nothing and are seen by nothing."""
+    src_seg = np.array([[1, 1, 2, 2, 0, 0]])
+    tgt_seg = np.array([[1, 2, 2, 0]])
+    em, dm, cm = rd.packed_attention_masks(src_seg, tgt_seg)
+    keep_e = em[0, 0] == 0
+    # src token 0 (seg1) attends seg1 only
+    np.testing.assert_array_equal(keep_e[0], [1, 1, 0, 0, 0, 0])
+    # pad column/row fully masked
+    assert not keep_e[:, 4].any() and not keep_e[4].any()
+    keep_c = cm[0, 0] == 0
+    # tgt pos 1 (seg2) cross-attends src seg2 only
+    np.testing.assert_array_equal(keep_c[1], [0, 0, 1, 1, 0, 0])
+    keep_d = dm[0, 0] == 0
+    # causal within segment: tgt 2 (seg2) sees tgt 1,2 but not seg1's 0
+    np.testing.assert_array_equal(keep_d[2], [0, 1, 1, 0])
