@@ -1168,13 +1168,29 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """nn.py py_func (py_func_op.cc): host-python escape hatch."""
+    """nn.py py_func (py_func_op.cc): host-python escape hatch. The out
+    var(s) must carry a FULLY-specified shape+dtype — the host callback
+    crosses the jit boundary (jax.pure_callback), so XLA needs the result
+    signature up front (the reference infers it at run time; static
+    shapes are the TPU contract)."""
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = out if isinstance(out, (list, tuple)) else [out]
+    from ..core.dtypes import dtype_str
+    shapes, dtypes = [], []
+    for v in outs:
+        shp = list(v.shape or [])
+        if not shp or any(d is None or int(d) < 0 for d in shp):
+            raise ValueError(
+                f"py_func: out var {v.name!r} needs a fully-specified "
+                f"shape (got {v.shape}) — the host callback's result "
+                f"signature must be static for XLA")
+        shapes.append([int(d) for d in shp])
+        dtypes.append(dtype_str(v.dtype))
     helper = LayerHelper("py_func")
     helper.append_op(type="py_func", inputs={"X": [v.name for v in xs]},
                      outputs={"Out": [v.name for v in outs]},
-                     attrs={"func": func, "backward_func": backward_func})
+                     attrs={"func": func, "backward_func": backward_func,
+                            "out_shapes": shapes, "out_dtypes": dtypes})
     return out
 
 

@@ -1193,3 +1193,168 @@ def test_op_matches_numpy_golden(op_type):
         np.testing.assert_allclose(
             got.astype(np.float64), np.asarray(exp, np.float64).reshape(got.shape),
             rtol=2e-5, atol=2e-6, err_msg=f"{op_type} vs numpy")
+
+
+def test_exempt_ops_are_actually_covered_elsewhere():
+    """Every EXEMPT op must be mentioned in some OTHER test file — an
+    exemption whose promised heavier-infrastructure coverage was deleted
+    would otherwise rot silently."""
+    import os
+
+    here = os.path.dirname(__file__)
+    corpus = []
+    for fn in os.listdir(here):
+        if fn.startswith("test_") and fn.endswith(".py") \
+                and fn != "test_op_sweep.py":
+            with open(os.path.join(here, fn)) as f:
+                corpus.append(f.read())
+    for fn in ("dist_mlp_runner.py", "dist_ckpt_runner.py",
+               "dist_dygraph_runner.py", "elastic_runner.py",
+               "dist_shuffle_runner.py"):
+        p = os.path.join(here, fn)
+        if os.path.exists(p):
+            with open(p) as f:
+                corpus.append(f.read())
+    # the dryrun exercises the mesh/pipeline ops
+    with open(os.path.join(os.path.dirname(here), "__graft_entry__.py")) as f:
+        corpus.append(f.read())
+    text = "\n".join(corpus)
+    # a few exempt ops are exercised through the API that emits them
+    # rather than by their op-type string in any test file
+    VIA_API = {
+        "c_sync_calc_stream": "BuildStrategy sync knobs (test_strategy_knobs)",
+        "c_sync_comm_stream": "same",
+        "c_comm_init": "parallel.env bootstrap (test_dist_cluster)",
+        "c_comm_init_all": "same",
+        "c_gen_nccl_id": "same",
+        "fake_init": "transpiler shim (test_api_parity name check)",
+        "get_places": "layers.get_places (test_api_parity)",
+        "delete_var": "executor GC path",
+        "read": "PyReader (test_io_and_data)",
+        "coalesce_tensor": "fused-allreduce shim",
+        "merge_lod_tensor_infer": "inference IfElse lowering",
+        "conditional_block_infer": "same",
+        "rnn_memory_helper": "StaticRNN internals (test_control_flow_rnn)",
+        "conditional_block": "Switch test (test_control_flow_rnn)",
+        "switch": "Switch class test (test_control_flow_rnn)",
+        "static_rnn": "StaticRNN class test (test_control_flow_rnn)",
+        "recurrent": "registered alias of static_rnn (parity_ops.py:55)",
+        "array_length": "covered by the test below",
+        "array_read": "covered by the test below",
+        "py_func": "covered by the test below",
+        "allreduce": "legacy alias — c-ops shard_map test in THIS file "
+                     "(the corpus scan excludes this file)",
+        "c_allgather": "c-ops shard_map test below",
+        "c_allreduce_max": "same", "c_allreduce_min": "same",
+        "c_allreduce_sum": "same", "c_allreduce_prod": "same",
+        "c_broadcast": "same", "c_reducescatter": "same",
+        "lod_array_length": "array_length alias",
+        "write_to_array": "array_write alias (test_control_flow_rnn)",
+        "read_from_array": "array_read alias (test_control_flow_rnn)",
+    }
+    import re as _re
+    missing = [n for n in sorted(EXEMPT)
+               if n not in VIA_API
+               and not _re.search(r"\b%s\b" % _re.escape(n), text)]
+    assert not missing, (
+        f"EXEMPT ops with no visible coverage anywhere: {missing}")
+
+
+def test_program_c_collective_ops_under_shard_map():
+    """The program-level c_* collective ops (ops/collective_ops.py —
+    ring_id → mesh axis) compute the right reductions inside shard_map,
+    and degrade to identity outside one (single-process reference
+    behavior)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.core.executor import ExecContext
+    from paddle_tpu.parallel.collective import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    ctx = ExecContext(None, mesh=mesh)
+    x = np.arange(1, 9, dtype="float32")
+
+    def run(op_name, out_spec):
+        def body(xs):
+            return registry.get_op(op_name).fn(
+                ctx, {"X": [xs]}, {"ring_id": 0})["Out"][0]
+        fn = shard_map(body, mesh, in_specs=(P("dp"),), out_specs=out_spec)
+        return np.asarray(fn(jnp.asarray(x)))
+
+    shards = x.reshape(4, 2)
+    np.testing.assert_allclose(run("c_allreduce_sum", P())[:2],
+                               shards.sum(0))
+    np.testing.assert_allclose(run("c_allreduce_max", P())[:2],
+                               shards.max(0))
+    np.testing.assert_allclose(run("c_allreduce_min", P())[:2],
+                               shards.min(0))
+    np.testing.assert_allclose(run("c_allreduce_prod", P())[:2],
+                               shards.prod(0), rtol=1e-6)
+    np.testing.assert_allclose(run("c_allgather", P()), x)
+    # the legacy `allreduce` alias (operators/collective allreduce op)
+    def body_legacy(xs):
+        return registry.get_op("allreduce").fn(
+            ctx, {"X": [xs]}, {"ring_id": 0})["Out"][0]
+    fn_leg = shard_map(body_legacy, mesh, in_specs=(P("dp"),),
+                       out_specs=P())
+    np.testing.assert_allclose(np.asarray(fn_leg(jnp.asarray(x)))[:2],
+                               shards.sum(0))
+    # reduce_scatter: local length must divide by world size → use [8]/dev
+    x32 = np.arange(32, dtype="float32")
+
+    def body_rs(xs):
+        return registry.get_op("c_reducescatter").fn(
+            ctx, {"X": [xs]}, {"ring_id": 0})["Out"][0]
+    fn_rs = shard_map(body_rs, mesh, in_specs=(P("dp"),),
+                      out_specs=P("dp"))
+    got_rs = np.asarray(fn_rs(jnp.asarray(x32)))
+    # each device scatters its reduced [2] chunk of the [8] local sum
+    np.testing.assert_allclose(got_rs, x32.reshape(4, 8).sum(0))
+    # outside shard_map: identity (GSPMD owns collectives there)
+    same = registry.get_op("c_allreduce_sum").fn(
+        ctx, {"X": [jnp.asarray(x)]}, {"ring_id": 0})["Out"][0]
+    np.testing.assert_allclose(np.asarray(same), x)
+    # c_broadcast: root's shard replicated
+    b = run("c_broadcast", P())
+    np.testing.assert_allclose(b[:2], shards[0])
+
+
+def test_tensor_array_read_length_and_py_func_ops():
+    """array_read/array_length and py_func through real programs — the
+    exemption list's executor-coverage claim, made concrete (array_write
+    and Switch/conditional_block already run in test_control_flow_rnn)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3])
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        arr = layers.create_array("float32", element_shape=[1, 3],
+                                  max_len=4)
+        arr = layers.array_write(x, i0, arr)
+        arr = layers.array_write(layers.scale(x, scale=2.0), i1, arr)
+        y = layers.array_read(arr, i1)
+        n = layers.array_length(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        xv = np.array([[1.0, 2.0, 3.0]], "float32")
+        yv, nv = exe.run(main, feed={"x": xv}, fetch_list=[y, n])
+    np.testing.assert_allclose(yv, 2 * xv)
+    assert int(np.asarray(nv).item()) == 2
+
+    # py_func: host-side python escape hatch
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+        out_var = main.global_block().create_var(name="pf_out",
+                                                 shape=[2, 4],
+                                                 dtype="float32")
+        layers.py_func(lambda a: np.asarray(a) + 5.0, x, out_var)
+    with fluid.scope_guard(fluid.Scope()):
+        xv = np.ones((2, 4), "float32")
+        got = exe.run(main, feed={"x": xv}, fetch_list=[out_var])[0]
+    np.testing.assert_allclose(np.asarray(got), xv + 5.0)
