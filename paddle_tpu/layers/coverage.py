@@ -1172,7 +1172,12 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     var(s) must carry a FULLY-specified shape+dtype — the host callback
     crosses the jit boundary (jax.pure_callback), so XLA needs the result
     signature up front (the reference infers it at run time; static
-    shapes are the TPU contract)."""
+    shapes are the TPU contract).
+
+    Runtime support: host callbacks need a PJRT runtime with host
+    send/recv (CPU and standard TPU runtimes have it; tunneled/proxied
+    runtimes may raise UNIMPLEMENTED at execution — the reference's
+    py_func was CPU-kernel-only too, py_func_op.cc)."""
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = out if isinstance(out, (list, tuple)) else [out]
     from ..core.dtypes import dtype_str
