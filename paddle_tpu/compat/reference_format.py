@@ -118,6 +118,11 @@ _DTYPES = {0: "bool", 1: "int16", 2: "int32", 3: "int64", 4: "float16",
            21: "int8"}
 _DTYPE_IDS = {v: k for k, v in _DTYPES.items()}
 VT_LOD_TENSOR = 7
+VT_FEED_MINIBATCH = 9
+VT_FETCH_LIST = 10
+VT_SELECTED_ROWS = 8   # framework.proto VarType enum — no decode support;
+VT_READER = 15         # the loader skips/raises on these, see
+VT_RAW = 17            # load_reference_persistables
 
 # AttrType enum (framework.proto:26-39)
 _AT_INT, _AT_FLOAT, _AT_STRING, _AT_INTS, _AT_FLOATS, _AT_STRINGS, \
@@ -388,17 +393,40 @@ def load_reference_persistables(dirname: str, program_desc: dict,
                                 ) -> Dict[str, np.ndarray]:
     """Read the variables a reference ``save_persistables`` /
     ``save_inference_model`` wrote: one stream per file named by the var
-    (io.py:487), or a single combined file holding the streams in block
-    var order (save_combine_op.cc; io.py save_vars builds the combine op
-    from the program's persistables in block order)."""
+    (io.py:487), or a single combined file holding the streams in
+    SORTED-name order (io.py:242 — save_vars feeds save_combine from
+    ``sorted(save_var_map.keys())``, and load_vars mirrors it at
+    io.py:664; NOT block var order).
+
+    Persistable selection mirrors the reference predicate
+    (io.py:70 is_persistable excludes FEED_MINIBATCH / FETCH_LIST /
+    READER; io.py:225 additionally skips RAW at save time) — an
+    exclusion list, not a LOD_TENSOR whitelist.  A persistable var of a
+    type we cannot decode (e.g. SELECTED_ROWS) is skipped on the
+    per-var-file path (positionally harmless — its file is simply never
+    opened) but raises on the combined path, where silently skipping
+    would desynchronize the positional stream."""
     block = program_desc["blocks"][0]
-    names = [v["name"] for v in block["vars"].values()
-             if v["persistable"] and v.get("type") == VT_LOD_TENSOR
-             and v["name"] not in ("feed", "fetch")]
+    names = []
+    for v in block["vars"].values():
+        if not v["persistable"] or v["name"] in ("feed", "fetch"):
+            continue
+        vt = v.get("type")
+        if vt in (VT_FEED_MINIBATCH, VT_FETCH_LIST, VT_READER, VT_RAW):
+            continue  # reference never saves these (io.py:70,:225)
+        if vt != VT_LOD_TENSOR:
+            if params_filename is None:
+                continue  # per-var file never read — no desync possible
+            raise NotImplementedError(
+                f"load_reference_persistables: persistable var "
+                f"{v['name']!r} has VarType {vt} — only LOD_TENSOR "
+                f"streams can be decoded, and skipping it would "
+                f"desynchronize the combined-params stream")
+        names.append(v["name"])
     out: Dict[str, np.ndarray] = {}
     if params_filename is not None:
         with open(os.path.join(dirname, params_filename), "rb") as f:
-            for name in names:
+            for name in sorted(names):
                 out[name], _ = read_lod_tensor_stream(f)
     else:
         for name in names:
@@ -475,8 +503,6 @@ def load_reference_inference_model(dirname: str,
 
 # -- export (artifacts flow BACK to the reference) --------------------------
 
-VT_FEED_MINIBATCH = 9
-VT_FETCH_LIST = 10
 
 
 def export_reference_inference_model(dirname: str, feed_names, fetch_names,
@@ -489,7 +515,9 @@ def export_reference_inference_model(dirname: str, feed_names, fetch_names,
     AnalysisPredictor. Emits the feed/fetch ops and holder vars the
     reference loader expects (io.py save_inference_model conventions) and
     one LoDTensor stream per persistable (or a save_combine-style single
-    file when ``params_filename`` is given, in block var order)."""
+    file when ``params_filename`` is given, in sorted-name order —
+    io.py:242 builds the save_combine input list from
+    ``sorted(save_var_map.keys())``)."""
     import paddle_tpu as fluid
 
     scope = scope or fluid.global_scope()
@@ -510,6 +538,14 @@ def export_reference_inference_model(dirname: str, feed_names, fetch_names,
                   "shape": None, "persistable": True, "lod_level": 0},
     }
     for v in program.list_vars():
+        if v.name in ("feed", "fetch"):
+            # would clobber the feed/fetch holder entries in varz, and the
+            # loader's persistable selection skips these names — the
+            # combined stream would silently desynchronize
+            raise ValueError(
+                f"export_reference_inference_model: var name {v.name!r} "
+                f"collides with the reference's feed/fetch holder vars — "
+                f"rename it before export")
         shape = None
         try:
             shape = [int(d) if d is not None else -1 for d in (v.shape or [])]
@@ -587,7 +623,7 @@ def export_reference_inference_model(dirname: str, feed_names, fetch_names,
         persist.append(v.name)
     if params_filename is not None:
         with open(os.path.join(dirname, params_filename), "wb") as f:
-            for n in persist:
+            for n in sorted(persist):
                 write_lod_tensor_stream(f, np.asarray(scope.find_var(n)))
     else:
         for n in persist:

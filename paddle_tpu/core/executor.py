@@ -749,16 +749,18 @@ class Executor:
     # -- lowering ----------------------------------------------------------
     def _state_names(self, program: Program, scope: Scope) -> List[str]:
         # cached single entry, rebuilt when the program version or any
-        # scope in the lookup chain changes size: rebuilding the list
-        # walks every program var and cost ~0.8 ms/step on ResNet-50.
-        # The cache holds STRONG refs to program+scope (so identity
-        # comparison can't alias a recycled id) and the per-chain-scope
-        # var counts (has_var walks parents, so a var added to a PARENT
-        # scope must also invalidate).
+        # scope in the lookup chain mutates its KEY SET: rebuilding the
+        # list walks every program var and cost ~0.8 ms/step on
+        # ResNet-50.  The cache holds STRONG refs to program+scope (so
+        # identity comparison can't alias a recycled id) and the
+        # per-chain-scope key-set generations (has_var walks parents, so
+        # a var added to a PARENT scope must also invalidate; a
+        # generation counter, unlike len(_vars), catches erase-one +
+        # add-another).
         chain_sizes = []
         s = scope
         while s is not None:
-            chain_sizes.append(len(s._vars))
+            chain_sizes.append(s._keyset_gen)
             s = s.parent
         cached = self._state_names_cache
         if (cached is not None and cached[0] is program

@@ -19,6 +19,12 @@ class Scope:
         self._vars: Dict[str, object] = {}
         self.parent = parent
         self.kids = []
+        # bumped on every KEY-SET mutation (new name, erased name) —
+        # value replacement keeps the generation, so executor caches
+        # keyed on it survive ordinary state updates but can't go stale
+        # when one var is erased and a different one added (which leaves
+        # len(_vars) unchanged)
+        self._keyset_gen = 0
 
     def new_scope(self) -> "Scope":
         s = Scope(self)
@@ -29,6 +35,8 @@ class Scope:
         self.kids = []
 
     def set_var(self, name: str, value):
+        if name not in self._vars:
+            self._keyset_gen += 1
         self._vars[name] = value
 
     def find_var(self, name: str):
@@ -43,7 +51,9 @@ class Scope:
         return self.find_var(name) is not None
 
     def erase(self, name: str):
-        self._vars.pop(name, None)
+        if name in self._vars:
+            self._keyset_gen += 1
+            del self._vars[name]
 
     def var_names(self):
         return list(self._vars.keys())

@@ -75,9 +75,10 @@ class DataParallel(Layer):
 
         Implementation: a cached multi-host pmap over ALL devices (global
         axis). Each process replicates its local grads across its local
-        devices; psum then yields local_devices × Σ_process g, so dividing by
-        (total_devices) gives the cross-process mean regardless of the
-        local-device count."""
+        devices; psum then yields local_devices × Σ_process g, so dividing
+        by local_n leaves the cross-process SUM — scale_loss already
+        applied the 1/nranks, matching the reference recipe (scaled loss +
+        allreduce-SUM ⇒ global mean update)."""
         n = self._strategy.nranks
         if n <= 1:
             return

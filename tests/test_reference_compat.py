@@ -191,9 +191,11 @@ def test_per_var_and_combined_params_agree(tmp_path):
         desc = rf.parse_program_desc(f.read())
     per_var = rf.load_reference_persistables(str(d1), desc)
 
-    # build the combined file in block var order (io.py save_vars order)
-    names = [v["name"] for v in desc["blocks"][0]["vars"].values()
-             if v["persistable"]]
+    # build the combined file in sorted-name order — io.py:242 save_vars
+    # feeds save_combine from sorted(save_var_map.keys())
+    names = sorted(v["name"] for v in desc["blocks"][0]["vars"].values()
+                   if v["persistable"] and v["name"] not in ("feed",
+                                                             "fetch"))
     with open(tmp_path / "params", "wb") as f:
         for n in names:
             rf.write_lod_tensor_stream(f, per_var[n])
