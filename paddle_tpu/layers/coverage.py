@@ -1174,6 +1174,13 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     signature up front (the reference infers it at run time; static
     shapes are the TPU contract).
 
+    ``backward_func`` makes the op differentiable (py_func_op.cc:198 grad
+    maker): it is called as ``backward_func(*kept_fwd_inputs,
+    *kept_fwd_outputs, *out_grads)`` and must return one grad per forward
+    input (``None`` → zeros); vars listed in
+    ``skip_vars_in_backward_input`` are withheld from its arguments
+    (output grads can never be skipped).
+
     Runtime support: host callbacks need a PJRT runtime with host
     send/recv (CPU and standard TPU runtimes have it; tunneled/proxied
     runtimes may raise UNIMPLEMENTED at execution — the reference's
@@ -1191,11 +1198,33 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
                 f"signature must be static for XLA")
         shapes.append([int(d) for d in shp])
         dtypes.append(dtype_str(v.dtype))
+    # resolve skip_vars_in_backward_input (vars or names) to positional
+    # keep-lists over (fwd inputs, fwd outputs) — reference semantics
+    # (py_func_op.cc:220): skipped fwd ins/outs are not handed to
+    # backward_func; output grads can never be skipped.
+    skip = set()
+    if skip_vars_in_backward_input is not None:
+        sv = (skip_vars_in_backward_input
+              if isinstance(skip_vars_in_backward_input, (list, tuple))
+              else [skip_vars_in_backward_input])
+        skip = {v.name if hasattr(v, "name") else str(v) for v in sv}
+        known = {v.name for v in xs} | {v.name for v in outs}
+        unknown = skip - known
+        if unknown:
+            raise ValueError(
+                f"py_func: skip_vars_in_backward_input names "
+                f"{sorted(unknown)} are neither forward inputs nor "
+                f"outputs of this py_func")
+    attrs = {"func": func, "backward_func": backward_func,
+             "out_shapes": shapes, "out_dtypes": dtypes}
+    if backward_func is not None:
+        attrs["bwd_keep_in"] = [i for i, v in enumerate(xs)
+                                if v.name not in skip]
+        attrs["bwd_keep_out"] = [i for i, v in enumerate(outs)
+                                 if v.name not in skip]
     helper = LayerHelper("py_func")
     helper.append_op(type="py_func", inputs={"X": [v.name for v in xs]},
-                     outputs={"Out": [v.name for v in outs]},
-                     attrs={"func": func, "backward_func": backward_func,
-                            "out_shapes": shapes, "out_dtypes": dtypes})
+                     outputs={"Out": [v.name for v in outs]}, attrs=attrs)
     return out
 
 

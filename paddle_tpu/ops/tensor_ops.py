@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.dtypes import convert_dtype
@@ -372,16 +373,66 @@ def _increment(ctx, inputs, attrs):
     return one(x + attrs.get("step", 1.0))
 
 
-@register_op("py_func", differentiable=False)
+@register_op("py_func",
+             differentiable=lambda attrs: attrs.get("backward_func") is not None)
 def _py_func(ctx, inputs, attrs):
-    """py_func_op.cc analog — escape hatch to host Python via pure_callback."""
+    """py_func_op.cc analog — escape hatch to host Python via pure_callback.
+
+    With a ``backward_func`` the op is differentiable, matching the
+    reference grad contract (py_func_op.cc:198 PyFuncOpGradDescMaker): the
+    backward callable receives (non-skipped forward inputs, non-skipped
+    forward outputs, output grads) positionally and returns one grad per
+    forward input — ``None`` meaning "input grad not needed" lowers to
+    zeros.  Both sides are host callbacks; the pairing is a jax.custom_vjp
+    so the tape-walk vjp in the executor differentiates straight through.
+    """
     fn = attrs["func"]
     out_shapes = attrs["out_shapes"]
     out_dtypes = [convert_dtype(d) for d in attrs["out_dtypes"]]
     xs = inputs.get("X", [])
     result_shape = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in zip(out_shapes, out_dtypes)]
-    outs = jax.pure_callback(fn, result_shape, *xs)
-    return {"Out": list(outs)}
+    bwd = attrs.get("backward_func")
+    if bwd is None:
+        outs = jax.pure_callback(fn, result_shape, *xs)
+        return {"Out": list(outs)}
+
+    # indices of fwd inputs/outputs the backward callable wants
+    # (skip_vars_in_backward_input resolved to positions by the layer)
+    keep_in = attrs.get("bwd_keep_in")
+    keep_out = attrs.get("bwd_keep_out")
+    keep_in = list(range(len(xs))) if keep_in is None else list(keep_in)
+    keep_out = (list(range(len(result_shape))) if keep_out is None
+                else list(keep_out))
+    in_sds = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
+
+    def _host_bwd(*args):
+        grads = bwd(*args)
+        if not isinstance(grads, (list, tuple)):
+            grads = (grads,)
+        if len(grads) != len(in_sds):
+            raise ValueError(
+                f"py_func backward_func returned {len(grads)} grads for "
+                f"{len(in_sds)} forward inputs")
+        return tuple(
+            np.zeros(sd.shape, sd.dtype) if g is None
+            else np.asarray(g, sd.dtype).reshape(sd.shape)
+            for g, sd in zip(grads, in_sds))
+
+    @jax.custom_vjp
+    def call(*args):
+        return tuple(jax.pure_callback(fn, result_shape, *args))
+
+    def call_fwd(*args):
+        outs = tuple(jax.pure_callback(fn, result_shape, *args))
+        res = (tuple(args[i] for i in keep_in)
+               + tuple(outs[i] for i in keep_out))
+        return outs, res
+
+    def call_bwd(res, gouts):
+        return tuple(jax.pure_callback(_host_bwd, in_sds, *res, *gouts))
+
+    call.defvjp(call_fwd, call_bwd)
+    return {"Out": list(call(*xs))}
 
 
 @register_op("print", differentiable=False)

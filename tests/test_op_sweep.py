@@ -1358,3 +1358,83 @@ def test_tensor_array_read_length_and_py_func_ops():
         xv = np.ones((2, 4), "float32")
         got = exe.run(main, feed={"x": xv}, fetch_list=[out_var])[0]
     np.testing.assert_allclose(np.asarray(got), xv + 5.0)
+
+
+def test_py_func_backward_func():
+    """py_func honors backward_func (py_func_op.cc:198 grad maker): the
+    backward callable receives (non-skipped fwd inputs, non-skipped fwd
+    outputs, out grads) positionally and returns one grad per fwd input,
+    with None lowering to zeros. Three probes: analytic tanh grad, the
+    skip list narrowing what backward sees, and None -> zeros."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.backward import gradients
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[0.3, -1.2, 0.7, 2.0]], "float32")
+
+    # 1) full contract: bwd sees (x, y, dy); grad of sum(tanh x) = 1 - y^2
+    seen = {}
+
+    def fwd(a):
+        return np.tanh(np.asarray(a))
+
+    def bwd(a, y, dy):
+        seen["shapes"] = (np.asarray(a).shape, np.asarray(y).shape,
+                          np.asarray(dy).shape)
+        return (1.0 - np.asarray(y) ** 2) * np.asarray(dy)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = main.global_block().create_var(name="pfb_y", shape=[1, 4],
+                                           dtype="float32")
+        layers.py_func(fwd, x, y, backward_func=bwd)
+        z = layers.reduce_sum(y)
+        (gx,) = gradients(z, x)
+    with fluid.scope_guard(fluid.Scope()):
+        gv = exe.run(main, feed={"x": xv}, fetch_list=[gx])[0]
+    np.testing.assert_allclose(np.asarray(gv), 1.0 - np.tanh(xv) ** 2,
+                               rtol=1e-6)
+    assert seen["shapes"] == ((1, 4), (1, 4), (1, 4))
+
+    # 2) skip the fwd OUTPUT from backward's inputs: bwd gets (x, dy) only
+    def bwd_noy(a, dy):
+        a = np.asarray(a)
+        return (1.0 - np.tanh(a) ** 2) * np.asarray(dy)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = main.global_block().create_var(name="pfb_y2", shape=[1, 4],
+                                           dtype="float32")
+        layers.py_func(fwd, x, y, backward_func=bwd_noy,
+                       skip_vars_in_backward_input=y)
+        z = layers.reduce_sum(y)
+        (gx,) = gradients(z, x)
+    with fluid.scope_guard(fluid.Scope()):
+        gv = exe.run(main, feed={"x": xv}, fetch_list=[gx])[0]
+    np.testing.assert_allclose(np.asarray(gv), 1.0 - np.tanh(xv) ** 2,
+                               rtol=1e-6)
+
+    # 3) None from backward_func -> zero grad for that input
+    def fwd2(a, b):
+        return np.asarray(a) + 2.0 * np.asarray(b)
+
+    def bwd2(a, b, y, dy):
+        return None, 2.0 * np.asarray(dy)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xa = layers.data("xa", [4])
+        xb = layers.data("xb", [4])
+        y = main.global_block().create_var(name="pfb_y3", shape=[1, 4],
+                                           dtype="float32")
+        layers.py_func(fwd2, [xa, xb], y, backward_func=bwd2)
+        z = layers.reduce_sum(y)
+        ga, gb = gradients(z, [xa, xb])
+    with fluid.scope_guard(fluid.Scope()):
+        gav, gbv = exe.run(main, feed={"xa": xv, "xb": xv},
+                           fetch_list=[ga, gb])
+    np.testing.assert_allclose(np.asarray(gav), np.zeros_like(xv))
+    np.testing.assert_allclose(np.asarray(gbv), np.full_like(xv, 2.0))
