@@ -19,6 +19,7 @@ _STR2DTYPE = {
     "int32": jnp.int32,
     "int64": jnp.int64,
     "uint8": jnp.uint8,
+    "uint16": jnp.uint16,  # packed row-major tables (ops/deferred_rows.py)
     "bool": jnp.bool_,
 }
 

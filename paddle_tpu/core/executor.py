@@ -679,6 +679,18 @@ class _AutoLayoutStep:
 
     def __call__(self, state, feed, key):
         if self._auto is not None and self._compiled is None:
+            # huge state leaves (Criteo-scale embedding tables): a layout
+            # disagreement between the AUTO solver and the producing
+            # program would force a relayout COPY of the leaf — for a
+            # >2GB table that transient doubles its footprint and OOMs
+            # the chip. Default layouts are deterministic per shape/dtype
+            # across programs, so the plain jit threads such state with
+            # no copy; the AUTO pass matters for many-leaf convnet state,
+            # not single-giant-table programs.
+            if any(getattr(v, "nbytes", 0) > (2 << 30)
+                   for v in state.values()):
+                self._auto = None
+        if self._auto is not None and self._compiled is None:
             try:
                 self._compiled = self._auto.lower(state, feed, key).compile()
                 self._in_format = self._compiled.input_formats[0][0]
@@ -809,7 +821,13 @@ class Executor:
         from .compiler import CompiledProgram
 
         if isinstance(program, CompiledProgram):
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            out = program._run(self, feed, fetch_list, scope, return_numpy)
+            # maintenance epilogues must fire under the mesh too — the
+            # deferred-row fold is cadence-critical (the append log
+            # overflows silently if it never runs)
+            self._advance_epilogues(program._program, scope or _scope(), 1,
+                                    compiled=program)
+            return out
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -844,6 +862,11 @@ class Executor:
             scope.set_var(n, v)
         scope.set_var(_RNG_STATE, new_key)
 
+        # maintenance epilogues (e.g. the deferred-row fold program,
+        # optimizer.py _build_deferred_fold — pserver communicator-cadence
+        # analog): run attached programs every `every` runs of this program
+        self._advance_epilogues(program, scope, 1)
+
         from ..flags import flag
         if flag("check_nan_inf"):
             # FLAGS_check_nan_inf parity (operator.cc:949): validate every
@@ -858,6 +881,171 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def run_batched(
+        self,
+        program: Program,
+        feed_list,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+    ):
+        """Run N training steps in ONE device dispatch: lax.scan over the
+        jitted step with the N feed dicts stacked along a leading axis.
+
+        The TPU analog of the reference's in-C++ trainer hot loop
+        (hogwild_worker.cc:163 via Executor::RunFromDataset): there the
+        per-step loop never re-enters Python; here the per-dispatch
+        runtime cost (host Python + transport, ~ms-scale on tunneled
+        runtimes) is paid once per N steps instead of per step. Feeds
+        must share shapes/dtypes across the N steps (one compiled scan).
+
+        Requires every persistable the program writes to already exist in
+        the scope (run the startup program and one plain `run` first).
+        Maintenance epilogues (deferred-row folds) keep their cadence:
+        N must divide the epilogue interval (or be a multiple of it is
+        rejected — the log would overflow mid-scan).
+
+        Returns one stacked np/jax array of shape [N, ...] per fetch.
+        """
+        import jax as _jax
+        from jax import lax as _lax
+
+        feed_list = list(feed_list)
+        if not feed_list:
+            raise ValueError("run_batched: empty feed_list")
+        n = len(feed_list)
+        epilogues = getattr(program, "_epilogue_programs", None) or []
+        for every, *_rest in epilogues:
+            if n > every:
+                raise ValueError(
+                    f"run_batched: {n} steps per dispatch exceeds the "
+                    f"maintenance-epilogue interval {every} — the "
+                    f"deferred-update log would overflow mid-scan")
+        if epilogues:
+            # a fold is a pure representation change (safe any time):
+            # run it early if this batch would not fit in the log
+            sc = scope or _scope()
+            for i, entry in enumerate(epilogues):
+                every, eprog, meta = (entry if len(entry) == 3
+                                      else (*entry, None))
+                pend, key, _ = self._epilogue_pending(program, sc, i, meta)
+                if pend[key] + n > every:
+                    self.run(eprog, scope=sc, return_numpy=False)
+                    pend[key] = 0
+        fetch_list = list(fetch_list or [])
+        scope = scope or _scope()
+        fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
+        block = program.global_block()
+        feeds_conv = [{k: convert_feed_value(block, k, v) for k, v in fd.items()}
+                      for fd in feed_list]
+        keys = sorted(feeds_conv[0])
+        stacked = {k: jnp.stack([jnp.asarray(fd[k]) for fd in feeds_conv])
+                   for k in keys}
+
+        state_names = sorted({v.name for v in program.list_vars()
+                              if v.persistable})
+        missing = [nm for nm in state_names if scope.find_var(nm) is None]
+        if missing:
+            raise ValueError(
+                f"run_batched needs every persistable in scope (run the "
+                f"startup program and one plain run first); missing: "
+                f"{missing[:5]}")
+        key_sig = (id(program), program._version, n,
+                   tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                                for k, v in stacked.items())),
+                   tuple(fetch_names))
+        fn = self._cache.get(key_sig)
+        if fn is None:
+            inner = self._build(program, keys, fetch_names,
+                                state_names, state_names)
+            raw_step = inner._step
+
+            def scan_fn(state, feeds, key):
+                def body(carry, feed):
+                    st, k = carry
+                    fetches, new_state, k2 = raw_step(st, feed, k)
+                    return (new_state, k2), fetches
+                (st, k2), ys = _lax.scan(body, (state, key), feeds)
+                return ys, st, k2
+
+            fn = _jax.jit(scan_fn, donate_argnums=(0,))
+            self._cache[key_sig] = fn
+
+        state = {nm: scope.find_var(nm) for nm in state_names}
+        state = {nm: (v if isinstance(v, jax.Array) else jnp.asarray(v))
+                 for nm, v in state.items()}
+        key = scope.find_var(_RNG_STATE)
+        if key is None:
+            key = _make_key(program.random_seed or 0)
+        ys, new_state, new_key = fn(state, stacked, key)
+        for nm, v in new_state.items():
+            scope.set_var(nm, v)
+        scope.set_var(_RNG_STATE, new_key)
+
+        self._advance_epilogues(program, scope, n)
+        if return_numpy:
+            return [np.asarray(y) for y in ys]
+        return list(ys)
+
+    def _epilogue_pending(self, program, scope, i, meta):
+        """Steps-since-fold for epilogue i of `program` against `scope`.
+
+        Kept ON THE SCOPE (the deferred log/count state lives there — one
+        program driven against two scopes must not share a counter), and
+        seeded from the scope's in-program count vars on first encounter,
+        so a checkpoint-restored scope resumes with the correct cadence
+        without a per-step device sync."""
+        pend = getattr(scope, "_epilogue_pending", None)
+        if pend is None:
+            pend = scope._epilogue_pending = {}
+        key = (id(program), i)
+        fresh = key not in pend
+        if fresh:
+            seed = 0
+            r = int((meta or {}).get("rows_per_step", 0))
+            for nm in (meta or {}).get("count_vars", []):
+                v = scope.find_var(nm)
+                if v is not None and r > 0:
+                    seed = max(seed,
+                               int(np.asarray(v).reshape(-1)[0]) // r)
+            pend[key] = seed
+        return pend, key, fresh
+
+    def _run_epilogue(self, eprog, scope, compiled=None):
+        if compiled is not None and compiled._mesh is not None:
+            from .compiler import CompiledProgram
+            cache = getattr(compiled, "_compiled_epilogues", None)
+            if cache is None:
+                cache = compiled._compiled_epilogues = {}
+            cp = cache.get(id(eprog))
+            if cp is None:
+                cp = CompiledProgram(eprog).with_mesh(
+                    compiled._mesh, data_axis=compiled._data_axis)
+                cache[id(eprog)] = cp
+            cp._run(self, {}, [], scope, False)
+            return
+        self.run(eprog, scope=scope, return_numpy=False)
+
+    def _advance_epilogues(self, program, scope, steps: int, compiled=None):
+        """Track steps since each epilogue last ran; fire at its interval.
+        The accounting mirrors the in-program deferred-log `count` state:
+        both reset together when the fold runs."""
+        epilogues = getattr(program, "_epilogue_programs", None)
+        if not epilogues:
+            return
+        for i, entry in enumerate(epilogues):
+            every, eprog, meta = (entry if len(entry) == 3
+                                  else (*entry, None))
+            pend, key, fresh = self._epilogue_pending(program, scope, i,
+                                                      meta)
+            if not fresh:
+                # a fresh seed read the in-program count AFTER this run's
+                # append — it already includes these steps
+                pend[key] += steps
+            if pend[key] >= every:
+                self._run_epilogue(eprog, scope, compiled)
+                pend[key] = 0
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,

@@ -39,6 +39,25 @@ class UniformInitializer(Initializer):
                    "min": self.low, "max": self.high, "seed": self.seed})
 
 
+class RowPackInitializer(Initializer):
+    """Init for packed row-major tables (ops/deferred_rows.py): visible
+    columns ~ U(low, high), optimizer state columns = state_value, all
+    bit-split into [height, 128] uint16. TPU extension, no reference
+    analog (the layout replaces the pserver sparse table)."""
+
+    def __init__(self, vis: int, dt: int, low: float = -0.1,
+                 high: float = 0.1, state_value: float = 0.0):
+        self.vis, self.dt = int(vis), int(dt)
+        self.low, self.high, self.state_value = low, high, state_value
+
+    def __call__(self, var, block):
+        block.append_op(
+            "rowpack_init", outputs={"Out": [var.name]},
+            attrs={"height": int(var.shape[0]), "vis": self.vis,
+                   "dt": self.dt, "low": self.low, "high": self.high,
+                   "state_value": self.state_value})
+
+
 class NormalInitializer(Initializer):
     def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
         self.loc, self.scale, self.seed = loc, scale, seed

@@ -67,22 +67,44 @@ def fc(input: Variable, size: int, num_flatten_dims: int = 1, param_attr=None,
 
 def embedding(input: Variable, size: Sequence[int], is_sparse: bool = False,
               is_distributed: bool = False, padding_idx: Optional[int] = None,
-              param_attr=None, dtype="float32", name=None) -> Variable:
+              param_attr=None, dtype="float32", name=None,
+              row_pack: bool = False) -> Variable:
     """layers/nn.py embedding → lookup_table op. is_sparse is accepted for API
-    parity; on TPU the gradient is an XLA scatter-add either way."""
+    parity; on TPU the gradient is an XLA scatter-add either way.
+
+    row_pack=True (TPU extension, no reference analog): store the table as
+    a [vocab, 128] uint16 packed row-major array — each row bit-splits up
+    to 64 f32 values (embedding + optional optimizer state columns) into
+    lane-aligned u16 pairs, making per-step touched-row scatter updates
+    ~3x cheaper than the column-major f32 layout the unpacked table is
+    forced into (see ops/deferred_rows.py "packed row-major tables").
+    Requires is_sparse=True and a *_row_packed optimizer
+    (SGD/Adagrad/Adam with packed_rows=...); size[-1] counts the f32
+    values per row INCLUDING state columns."""
     helper = LayerHelper("embedding", name=name)
-    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype,
-                                default_initializer=XavierInitializer())
+    attrs = {"padding_idx": -1 if padding_idx is None else padding_idx,
+             "is_sparse": is_sparse, "is_distributed": is_distributed}
+    if row_pack:
+        from ..ops.deferred_rows import PACK_LANES
+        from ..initializer import RowPackInitializer
+        if not is_sparse:
+            raise ValueError("row_pack=True requires is_sparse=True")
+        w = helper.create_parameter(
+            param_attr, shape=[size[0], PACK_LANES], dtype="uint16",
+            default_initializer=RowPackInitializer(size[-1], size[-1]))
+        attrs["row_pack_dt"] = int(size[-1])
+    else:
+        w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype,
+                                    default_initializer=XavierInitializer())
     out_shape = None
     if input.shape is not None:
         ids_shape = input.shape[:-1] if input.shape[-1] == 1 else input.shape
         out_shape = tuple(ids_shape) + (size[-1],)
-    out = helper.create_variable_for_type_inference(dtype, out_shape)
+    out = helper.create_variable_for_type_inference(
+        "float32" if row_pack else dtype, out_shape)
     helper.append_op(
         type="lookup_table", inputs={"W": [w.name], "Ids": [input.name]},
-        outputs={"Out": [out.name]},
-        attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
-               "is_sparse": is_sparse, "is_distributed": is_distributed})
+        outputs={"Out": [out.name]}, attrs=attrs)
     return out
 
 

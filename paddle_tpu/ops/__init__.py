@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     coverage_ops,
     crf_ops,
+    deferred_rows,
     detection_ops,
     framework_ops,
     fused_ops,

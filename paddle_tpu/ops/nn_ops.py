@@ -435,28 +435,65 @@ def _lookup_sparse_grad(attrs):
         squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
         idx = ids[..., 0] if squeeze_last else ids
         flat_ids = idx.reshape(-1).astype(jnp.int32)
-        rows = g.reshape(-1, g.shape[-1]).astype(w.dtype)
+        rows = g.reshape(-1, g.shape[-1])
+        if not attrs2.get("row_pack_dt"):  # packed tables keep f32 grads
+            rows = rows.astype(w.dtype)
         padding_idx = attrs2.get("padding_idx", -1)
         if padding_idx is not None and padding_idx >= 0:
             rows = jnp.where((flat_ids == padding_idx)[:, None], 0.0, rows)
-        return {"W": [SelectedRows(flat_ids, rows, w.shape[0])],
-                "Ids": [None]}
+        out = {"W": [SelectedRows(flat_ids, rows, w.shape[0])],
+               "Ids": [None]}
+        # pending deferred-update state is opt state, not a diff input
+        for slot in ("PendingPos", "PendingCum"):
+            if slot in inputs:
+                out[slot] = [None]
+        return out
 
     return grad
 
 
-@register_op("lookup_table", nondiff_inputs=["Ids"],
+@register_op("lookup_table", nondiff_inputs=["Ids", "PendingPos", "PendingCum"],
              grad_fn=_lookup_sparse_grad)
 def _lookup_table(ctx, inputs, attrs):
     """lookup_table_op.cc: W[ids]; padding_idx rows produce zeros. Grad is an
     XLA scatter-add (dense) by default; with is_sparse=True the grad is a
-    SelectedRows rows bundle consumed row-wise by sgd/adam."""
+    SelectedRows rows bundle consumed row-wise by sgd/adam/adagrad.
+
+    With PendingPos/PendingCum inputs (wired by a deferred-row optimizer,
+    ops/deferred_rows.py), the read adds the postab-indexed pending
+    cumulative delta to the base gather, so lookups always see the exact
+    serial-update value regardless of fold cadence — the TPU-native analog
+    of the reference's distributed_lookup_table prefetch rewrite
+    (parameter_prefetch.cc). The extra CumOut output feeds the deferred
+    optimizer op, which reuses these gathers instead of issuing its own."""
     (w,) = inputs["W"]
     (ids,) = inputs["Ids"]
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
     idx = ids[..., 0] if squeeze_last else ids
-    out = jnp.take(w, idx, axis=0)
+    rp_dt = attrs.get("row_pack_dt")
+    if rp_dt:
+        # packed row-major table (ops/deferred_rows.py): [V, 128] uint16
+        # holding dt bit-split f32 values per row — full-row gather, then
+        # bit-exact unpack
+        from .deferred_rows import unpack_rows
+        q = idx.reshape(-1).astype(jnp.int32)
+        out = unpack_rows(jnp.take(w, q, axis=0), int(rp_dt))
+        out = out.reshape(idx.shape + (int(rp_dt),))
+    else:
+        out = jnp.take(w, idx, axis=0)
     padding_idx = attrs.get("padding_idx", -1)
+    if "PendingPos" in inputs:
+        from .deferred_rows import lookup_join
+        (postab,) = inputs["PendingPos"]
+        (log_cum,) = inputs["PendingCum"]
+        q = idx.reshape(-1).astype(jnp.int32)
+        cur, cum = lookup_join(postab, log_cum, out.reshape(q.shape[0], -1), q)
+        shp = idx.shape + (w.shape[-1],)
+        out = lax.stop_gradient(cur.reshape(shp) - out) + out
+        if padding_idx is not None and padding_idx >= 0:
+            out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
+        return {"Out": [out],
+                "CumOut": [lax.stop_gradient(cum.reshape(shp))]}
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
     return one(out)

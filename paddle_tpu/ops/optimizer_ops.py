@@ -268,6 +268,15 @@ def _adagrad(ctx, inputs, attrs):
     (m,) = inputs["Moment"]
     eps = attrs.get("epsilon", 1e-6)
     lr = _lr(inputs)
+    if isinstance(g, SelectedRows):
+        # adagrad_op.cc SparseAdagradFunctor: duplicates merged first
+        # (adagrad is nonlinear in g), then touched rows advance
+        ids, rows = g.merged()
+        rows = rows.astype(p.dtype)
+        m_r = m[ids] + rows * rows
+        p_r = p[ids] - lr * rows / (jnp.sqrt(m_r) + eps)
+        return {"ParamOut": [p.at[ids].set(p_r)],
+                "MomentOut": [m.at[ids].set(m_r)]}
     m_out = m + g * g
     return {"ParamOut": [p - lr * g / (jnp.sqrt(m_out) + eps)], "MomentOut": [m_out]}
 

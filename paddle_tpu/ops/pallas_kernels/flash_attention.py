@@ -1014,6 +1014,24 @@ def _interpret_arg(dropout_rate: float):
     return pltpu.InterpretParams() if dropout_rate > 0.0 else True
 
 
+def _flash_bwd_block_dispatch(q, k, v, g, lse, out, sm_scale, causal):
+    """Block-level backward for the RING path (parallel/ring_attention.py):
+    given one resident K/V block and the GLOBAL lse/out/delta residuals,
+    return (dq, dk, dv) for that block via the Pallas dq/dkv kernels
+    (jax fallback off-TPU). No bias/dropout on the ring path."""
+    t, d = q.shape[1], q.shape[2]
+    bq, bk = _pick_blocks(t)
+    if _pallas_ok(t, d):
+        dq, dk, dv, _ = _flash_bwd_pallas(
+            q, k, v, None, g, lse, out, sm_scale, causal, bq, bk,
+            interpret=_interpret_arg(0.0))
+        return dq, dk, dv
+    dq, dk, dv, _ = _flash_bwd_jax(
+        (q, k, v, None, None, out, lse), g, sm_scale=sm_scale,
+        causal=causal, block_k=bk or t, dropout_rate=0.0, has_bias=False)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash_core(q, k, v, bias, dropout_key, sm_scale, causal, dropout_rate):
     out, _ = _flash_fwd_dispatch(q, k, v, bias, dropout_key, sm_scale,

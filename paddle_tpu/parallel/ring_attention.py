@@ -155,37 +155,103 @@ def _ring_attn_flash_local(q, k, v, axis: str, causal: bool):
 
     init = (jnp.zeros((b * h, tl, d), jnp.float32),
             jnp.full((b * h, tl), _NEG, jnp.float32), k, v)
-    (o, _, _, _), _ = lax.scan(step, init, jnp.arange(n))
-    return o.reshape(b, h, tl, d).astype(q.dtype)
+    (o, lse, _, _), _ = lax.scan(step, init, jnp.arange(n))
+    return (o.reshape(b, h, tl, d).astype(q.dtype),
+            lse.reshape(b, h, tl))
+
+
+def _ring_flash_bwd_local(q, k, v, o, lse, g, axis: str, causal: bool):
+    """Per-device ring BACKWARD (VERDICT r4 #3): reuses the Pallas
+    dq/dkv kernels per ring block with f32 dq and rotating f32 dk/dv
+    accumulators. The decomposition is exact: with the GLOBAL lse and
+    delta=Σ dO·o as residuals, every (q-shard, kv-block) pair's
+    contribution is independent — dq sums locally over blocks, dk/dv for
+    each K/V block accumulate as the block (and its accumulator) rotate
+    around the ring, arriving home after n hops. Future blocks under
+    `causal` skip compute entirely (lax.cond), mirroring the forward."""
+    from ..ops.pallas_kernels.flash_attention import _flash_bwd_block_dispatch
+
+    n = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    b, h, tl, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    def fold(x):
+        return x.reshape(b * h, tl, x.shape[-1])
+
+    qf, of, gf = fold(q), fold(o), fold(g.astype(q.dtype))
+    lse_f = lse.reshape(b * h, tl)
+
+    def block(k_cur, v_cur, diag: bool):
+        dqb, dkb, dvb = _flash_bwd_block_dispatch(
+            qf, fold(k_cur), fold(v_cur), gf, lse_f, of, scale, diag)
+        return (dqb.astype(jnp.float32), dkb.astype(jnp.float32),
+                dvb.astype(jnp.float32))
+
+    def step(carry, t):
+        dq_acc, k_cur, v_cur, dk_acc, dv_acc = carry
+        src = (idx - t) % n
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis, perm)  # overlaps kernel compute
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        if causal:
+            zero = (jnp.zeros_like(dq_acc), jnp.zeros((b * h, tl, d),
+                                                      jnp.float32),
+                    jnp.zeros((b * h, tl, d), jnp.float32))
+            dqb, dkb, dvb = lax.cond(
+                src == idx,
+                lambda _: block(k_cur, v_cur, True),
+                lambda _: lax.cond(
+                    src < idx,
+                    lambda __: block(k_cur, v_cur, False),
+                    lambda __: zero, None),
+                None)
+        else:
+            dqb, dkb, dvb = block(k_cur, v_cur, False)
+        dk_new = dk_acc + dkb.reshape(b, h, tl, d)
+        dv_new = dv_acc + dvb.reshape(b, h, tl, d)
+        # accumulators travel WITH their K/V block: after n hops each
+        # block's grads arrive back at its home device
+        return (dq_acc + dqb, k_nxt, v_nxt,
+                lax.ppermute(dk_new, axis, perm),
+                lax.ppermute(dv_new, axis, perm)), None
+
+    init = (jnp.zeros((b * h, tl, d), jnp.float32), k, v,
+            jnp.zeros((b, h, tl, d), jnp.float32),
+            jnp.zeros((b, h, tl, d), jnp.float32))
+    (dq, _, _, dk, dv), _ = lax.scan(step, init, jnp.arange(n))
+    return (dq.reshape(b, h, tl, d).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 def _ring_flash_fwd_value(q, k, v, mesh, axis, causal):
     spec = P(None, None, axis, None)
     fn = shard_map(partial(_ring_attn_flash_local, axis=axis, causal=causal),
-                   mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                   mesh, in_specs=(spec, spec, spec),
+                   out_specs=(spec, P(None, None, axis)))
     return fn(q, k, v)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _ring_flash(q, k, v, mesh, axis, causal):
-    return _ring_flash_fwd_value(q, k, v, mesh, axis, causal)
+    o, _ = _ring_flash_fwd_value(q, k, v, mesh, axis, causal)
+    return o
 
 
 def _ring_flash_fwd(q, k, v, mesh, axis, causal):
-    return _ring_flash_fwd_value(q, k, v, mesh, axis, causal), (q, k, v)
+    o, lse = _ring_flash_fwd_value(q, k, v, mesh, axis, causal)
+    return o, (q, k, v, o, lse)
 
 
 def _ring_flash_bwd(mesh, axis, causal, res, g):
-    # backward recomputes through the jnp oracle's vjp: both paths compute
-    # the identical function, the oracle's scan step is remat'd so the
-    # backward rebuilds one [Tl,Tl] score block at a time (memory linear
-    # in T), and the forward stays on the fast kernel
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: ring_self_attention(q_, k_, v_, mesh, axis=axis,
-                                               causal=causal, impl="jnp"),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    spec = P(None, None, axis, None)
+    lspec = P(None, None, axis)
+    fn = shard_map(
+        partial(_ring_flash_bwd_local, axis=axis, causal=causal),
+        mesh, in_specs=(spec, spec, spec, spec, lspec, spec),
+        out_specs=(spec, spec, spec))
+    return fn(q, k, v, o, lse, g)
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)

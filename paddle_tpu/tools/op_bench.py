@@ -187,10 +187,17 @@ def bench_dygraph_mlp(steps: int = 50, batch: int = 64, width: int = 256,
             os.environ["PDTPU_EAGER_JIT"] = old
         else:
             os.environ.pop("PDTPU_EAGER_JIT", None)
+    def _iqr(xs):
+        qs = statistics.quantiles(xs, n=4) if len(xs) >= 2 else [0, 0, 0]
+        return round(qs[2] - qs[0], 3)
+
     cached = statistics.median(cached_t)
     uncached = statistics.median(uncached_t)
     return {"bench": "dygraph_mlp_step", "steps": steps,
             "cached_ms": round(cached, 3), "uncached_ms": round(uncached, 3),
+            "cached_iqr_ms": _iqr(cached_t),
+            "uncached_iqr_ms": _iqr(uncached_t),
+            "n_segments": n_seg,
             "speedup": round(uncached / cached, 2)}
 
 
