@@ -248,9 +248,15 @@ def crop(x, shape=None, offsets=None, name=None):
 
 
 def pad(x, paddings, pad_value=0.0, name=None):
+    shp = None
+    if x.shape is not None and len(paddings) == 2 * len(x.shape):
+        shp = tuple(
+            None if d is None or int(d) < 0
+            else int(d) + paddings[2 * i] + paddings[2 * i + 1]
+            for i, d in enumerate(x.shape))
     return _emit("pad", {"X": x},
                  {"paddings": list(paddings), "pad_value": pad_value},
-                 name=name)
+                 name=name, out_shape=shp)
 
 
 def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
@@ -283,8 +289,15 @@ def shuffle_channel(x, group, name=None):
 
 
 def space_to_depth(x, blocksize, name=None):
+    shp = None
+    if x.shape is not None and len(x.shape) == 4:
+        n, c, h, w = x.shape
+        bs = int(blocksize)
+        shp = (n, None if c is None else c * bs * bs,
+               None if h is None else h // bs,
+               None if w is None else w // bs)
     return _emit("space_to_depth", {"X": x}, {"blocksize": blocksize},
-                 name=name)
+                 name=name, out_shape=shp)
 
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
