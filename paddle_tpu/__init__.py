@@ -32,6 +32,7 @@ from . import inference  # noqa: F401
 from . import metrics  # noqa: F401
 from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401
+from . import serving  # noqa: F401
 from . import reader as py_reader_module  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401

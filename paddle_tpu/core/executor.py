@@ -29,7 +29,29 @@ from . import registry
 from .program import Block, Program, Variable, default_main_program, grad_var_name
 from .scope import Scope, _scope, global_scope
 
+import weakref
+
 _RNG_STATE = "@RNG_STATE@"
+
+
+def feed_signature(feed_vals) -> tuple:
+    """Canonical hashable (name, shape, dtype) signature of a feed dict.
+
+    This is THE compiled-cache key ingredient: Executor.run/run_batched,
+    the inference Predictor, and the serving batcher all key their
+    executable caches with it, so "same signature" means the same thing
+    everywhere (one compile per signature, shared semantics)."""
+    return tuple(sorted((str(n), tuple(v.shape), str(v.dtype))
+                        for n, v in dict(feed_vals).items()))
+
+
+def _purge_pending(pend: dict, pid: int) -> None:
+    """Drop a dead program's epilogue counters: id() values recycle after
+    GC, so a stale (id, i) key would hand a brand-new program an inherited
+    steps-since-fold count (worst case the fold fires off-cadence and the
+    append log overwrites its tail)."""
+    for k in [k for k in pend if k[0] == pid]:
+        pend.pop(k, None)
 
 
 class Place:
@@ -840,7 +862,7 @@ class Executor:
 
         state_names = self._state_names(program, scope)
         out_state_names = sorted({v.name for v in program.list_vars() if v.persistable})
-        feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype)) for n, v in feed_vals.items()))
+        feed_sig = feed_signature(feed_vals)
         key_sig = (id(program), program._version, feed_sig, tuple(fetch_names),
                    tuple(state_names))
         fn = self._cache.get(key_sig)
@@ -937,6 +959,16 @@ class Executor:
         scope = scope or _scope()
         fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
         block = program.global_block()
+        keys0 = set(feed_list[0])
+        for i, fd in enumerate(feed_list[1:], start=1):
+            if set(fd) != keys0:
+                extra = sorted(set(fd) - keys0)
+                lacking = sorted(keys0 - set(fd))
+                raise ValueError(
+                    f"run_batched: feed dict at step {i} does not match "
+                    f"step 0's key set"
+                    + (f"; extra keys {extra}" if extra else "")
+                    + (f"; missing keys {lacking}" if lacking else ""))
         feeds_conv = [{k: convert_feed_value(block, k, v) for k, v in fd.items()}
                       for fd in feed_list]
         keys = sorted(feeds_conv[0])
@@ -952,9 +984,7 @@ class Executor:
                 f"startup program and one plain run first); missing: "
                 f"{missing[:5]}")
         key_sig = (id(program), program._version, n,
-                   tuple(sorted((k, tuple(v.shape), str(v.dtype))
-                                for k, v in stacked.items())),
-                   tuple(fetch_names))
+                   feed_signature(stacked), tuple(fetch_names))
         fn = self._cache.get(key_sig)
         if fn is None:
             inner = self._build(program, keys, fetch_names,
@@ -1010,6 +1040,10 @@ class Executor:
                     seed = max(seed,
                                int(np.asarray(v).reshape(-1)[0]) // r)
             pend[key] = seed
+            # id(program) recycles after GC — purge this program's counters
+            # when it dies so a new program at the same address cannot
+            # alias a stale steps-since-fold count
+            weakref.finalize(program, _purge_pending, pend, id(program))
         return pend, key, fresh
 
     def _run_epilogue(self, eprog, scope, compiled=None):
@@ -1023,6 +1057,9 @@ class Executor:
                 cp = CompiledProgram(eprog).with_mesh(
                     compiled._mesh, data_axis=compiled._data_axis)
                 cache[id(eprog)] = cp
+                # same id-reuse hazard as the fold counters: drop the
+                # compiled epilogue when its program dies
+                weakref.finalize(eprog, cache.pop, id(eprog), None)
             cp._run(self, {}, [], scope, False)
             return
         self.run(eprog, scope=scope, return_numpy=False)

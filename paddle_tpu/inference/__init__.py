@@ -261,14 +261,53 @@ class Predictor:
                 val = val.astype(jnp.bfloat16)
             feed_vals[n] = val
 
-        sig = tuple((n, tuple(v.shape), str(v.dtype))
-                    for n, v in sorted(feed_vals.items()))
+        from ..core.executor import feed_signature
+
+        sig = feed_signature(feed_vals)
         fn = self._cache.get(sig)
         if fn is None:
             fn = self._compile()
             self._cache[sig] = fn
         outs = fn(self._state, feed_vals)
         outs = [np.asarray(o) for o in outs]
+        self._fetch_buf = dict(zip(self._fetch_names, outs))
+        return outs
+
+    def run_padded(self, feed: Dict[str, np.ndarray], batch_size: int) -> List[np.ndarray]:
+        """Run with every feed padded along axis 0 to `batch_size` rows and
+        batch-major outputs sliced back to the true row count.
+
+        The serving entry point (paddle_tpu.serving.DynamicBatcher):
+        padding ragged traffic to a small set of bucket sizes keeps the
+        number of distinct XLA executables bounded — one per
+        (feed signature × bucket) — no matter what batch sizes arrive.
+        Pads by replicating the last row ("edge") so integer id feeds stay
+        in-vocab; the pad rows' outputs are computed and discarded.
+        Every feed must share the same leading (batch) dimension.
+        """
+        if not feed:
+            raise ValueError("run_padded: empty feed")
+        ns = {k: (np.asarray(v).shape[0] if np.asarray(v).ndim else -1)
+              for k, v in feed.items()}
+        n = next(iter(ns.values()))
+        if n <= 0 or any(m != n for m in ns.values()):
+            raise ValueError(
+                f"run_padded: feeds must share one positive leading batch "
+                f"dim; got {ns}")
+        if n > batch_size:
+            raise ValueError(
+                f"run_padded: {n} rows exceed the bucket size {batch_size}")
+        padded = {}
+        for k, v in feed.items():
+            v = np.asarray(v)
+            if n < batch_size:
+                width = [(0, batch_size - n)] + [(0, 0)] * (v.ndim - 1)
+                v = np.pad(v, width, mode="edge")
+            padded[k] = v
+        outs = self.run(padded)
+        # non-batch-major outputs (no leading batch dim) pass through whole
+        outs = [o[:n] if (o.ndim and o.shape[0] == batch_size) else o
+                for o in outs]
         self._fetch_buf = dict(zip(self._fetch_names, outs))
         return outs
 

@@ -182,6 +182,12 @@ class Optimizer:
                 f"is_sparse lookup sites; the deferred path requires "
                 f"exactly one (its gathered rows feed the optimizer op)")
         (site,) = sites
+        if site.attrs.get("row_pack_dt"):
+            raise ValueError(
+                f"deferred_rows: table {p.name!r} was built with "
+                f"row_pack=True; row_pack tables require the packed_rows "
+                f"optimizer config (direct touched-row scatter updates), "
+                f"not deferred_rows")
         helper = LayerHelper(f"{self._name}_deferred")
         postab = helper.create_global_variable(
             [int(p.shape[0])], "int32", name=f"{p.name}@pending_pos",

@@ -1,0 +1,50 @@
+"""paddle_tpu.serving — dynamic-batching TPU inference serving.
+
+Reference analog: the reference framework's serving story was
+AnalysisPredictor clones with shared weights behind an RPC pool, each
+clone running requests one-by-one through NaiveExecutor
+(analysis_predictor.cc:479 clone path). The TPU-native redesign exploits
+the opposite strength: one cached XLA executable per padded batch shape
+means concurrent requests are cheapest when MERGED, so the serving tier
+is a batching scheduler in front of one AOT Predictor:
+
+- `DynamicBatcher` (batcher.py) — groups queued requests by feed
+  signature, pads each group to a small fixed set of batch buckets
+  (default 1/2/4/8/16/32), dispatches one Predictor call per bucket, and
+  slices results back per request.
+- `InferenceServer` (server.py) — threaded front end: bounded queue with
+  reject-on-full backpressure, `max_batch_delay_ms` straggler window,
+  per-request deadlines, graceful drain on stop().
+- `warmup` (warmup.py) — compiles every (signature x bucket) executable
+  ahead of serving so no user request ever pays an XLA compile.
+- `Metrics` (metrics.py) — lock-protected counters/histograms (requests,
+  batch-size distribution, queue depth, latency percentiles, timeouts,
+  rejections) with a `snapshot()` dict and text `report()`.
+
+Minimal end-to-end::
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, serving
+
+    pred = inference.create_predictor(inference.Config(model_dir))
+    server = serving.InferenceServer(pred, buckets=(1, 2, 4, 8, 16, 32),
+                                     max_batch_delay_ms=2.0,
+                                     max_queue_size=256)
+    server.warmup()                       # compile all buckets up front
+    with server:                          # start(); stop() drains on exit
+        out, = server.infer({"x": batch_of_rows})
+    print(server.metrics.report())
+"""
+from .batcher import (DEFAULT_BUCKETS, DynamicBatcher, ServingError,  # noqa: F401
+                      bucket_for, item_signature)
+from .metrics import Counter, Gauge, Histogram, Metrics  # noqa: F401
+from .server import (InferenceServer, QueueFullError, Request,  # noqa: F401
+                     ServerClosedError)
+from .warmup import warmup  # noqa: F401
+
+__all__ = [
+    "DEFAULT_BUCKETS", "DynamicBatcher", "ServingError", "bucket_for",
+    "item_signature", "Counter", "Gauge", "Histogram", "Metrics",
+    "InferenceServer", "QueueFullError", "Request", "ServerClosedError",
+    "warmup",
+]

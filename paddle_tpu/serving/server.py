@@ -1,0 +1,266 @@
+"""Threaded inference server: bounded queue + dynamic batching + deadlines.
+
+Reference analog: a reference-framework serving deployment ran an RPC
+front end over a pool of AnalysisPredictor clones (shared weights, one
+NaiveExecutor loop each). Here the front end is in-process: callers
+`submit()` feeds from any thread, a serve worker drains the queue,
+merges same-signature requests up to the largest batch bucket (waiting
+at most `max_batch_delay_ms` for stragglers), and one padded XLA
+dispatch serves the whole group (`batcher.DynamicBatcher`).
+
+Overload behavior is explicit, not emergent: the queue is bounded and
+`submit()` raises `QueueFullError` immediately when it is full
+(reject-with-error backpressure — a serving tier should shed load at
+admission, not time out deep in the queue); each request can carry a
+deadline after which it is answered with `TimeoutError` instead of
+occupying a batch slot; `stop()` refuses new work and drains what was
+already admitted.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .batcher import (DEFAULT_BUCKETS, DynamicBatcher, ServingError,
+                      item_signature)
+from .metrics import Metrics
+
+__all__ = ["InferenceServer", "QueueFullError", "Request", "ServerClosedError",
+           "ServingError"]
+
+
+class QueueFullError(ServingError):
+    """Backpressure: the bounded request queue is at max_queue_size."""
+
+
+class ServerClosedError(ServingError):
+    """submit() after stop()."""
+
+
+class Request:
+    """One admitted inference request: `feed` arrays all carry a leading
+    batch dim of `n` rows; `future` resolves to the per-request output
+    slices (list of np arrays, one per fetch)."""
+
+    __slots__ = ("feed", "n", "sig", "future", "deadline", "enqueued_at")
+
+    def __init__(self, feed: Dict[str, np.ndarray], n: int, sig: tuple,
+                 deadline: Optional[float], enqueued_at: float):
+        self.feed = feed
+        self.n = n
+        self.sig = sig
+        self.future: Future = Future()
+        self.deadline = deadline
+        self.enqueued_at = enqueued_at
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+
+class InferenceServer:
+    """Dynamic-batching serve loop over an AOT Predictor.
+
+    Usage::
+
+        server = serving.InferenceServer(predictor, buckets=(1, 2, 4, 8),
+                                         max_batch_delay_ms=2.0)
+        server.warmup(example_feed={"x": np.zeros((1, 8), np.float32)})
+        server.start()
+        out, = server.infer({"x": x_row})          # blocking convenience
+        fut = server.submit({"x": x_row})          # or async
+        server.stop()                              # drains, then joins
+
+    `num_workers` > 1 runs several serve workers over predictor clones
+    (shared weights — the reference's clone optimization); useful when
+    per-dispatch host work (padding, slicing) limits throughput, since
+    XLA dispatches already overlap.
+    """
+
+    def __init__(self, predictor, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_batch_delay_ms: float = 2.0, max_queue_size: int = 256,
+                 default_timeout_ms: Optional[float] = None,
+                 num_workers: int = 1, metrics: Optional[Metrics] = None):
+        if max_queue_size < 1:
+            raise ValueError("max_queue_size must be >= 1")
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._predictor = predictor
+        self._batchers = [DynamicBatcher(predictor, buckets, self.metrics)]
+        for _ in range(num_workers - 1):
+            self._batchers.append(
+                DynamicBatcher(predictor.clone(), buckets, self.metrics))
+        self.buckets = self._batchers[0].buckets
+        self.max_batch_delay = max(0.0, float(max_batch_delay_ms)) / 1e3
+        self.max_queue_size = int(max_queue_size)
+        self.default_timeout = (None if default_timeout_ms is None
+                                else float(default_timeout_ms) / 1e3)
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._workers: List[threading.Thread] = []
+        self._started = False
+        self._closed = False
+        self._draining = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("server already stopped")
+            if self._started:
+                return self
+            self._started = True
+        for i in range(len(self._batchers)):
+            t = threading.Thread(target=self._serve_loop,
+                                 args=(self._batchers[i],),
+                                 name=f"paddle_tpu-serve-{i}", daemon=True)
+            self._workers.append(t)
+            t.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Refuse new submissions; with drain=True (default) every already
+        admitted request is still served before the workers exit, with
+        drain=False pending requests are failed with ServerClosedError."""
+        with self._cond:
+            self._closed = True
+            self._draining = drain
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    if not r.future.done():
+                        r.future.set_exception(
+                            ServerClosedError("server stopped without drain"))
+            self.metrics.gauge("serving/queue_depth").set(len(self._queue))
+            self._cond.notify_all()
+        for t in self._workers:
+            t.join()
+        self._workers = []
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def warmup(self, example_feed: Optional[Dict[str, np.ndarray]] = None):
+        """Compile every (signature x bucket) executable before serving
+        (see serving.warmup.warmup)."""
+        from .warmup import warmup as _warmup
+        reports = [_warmup(b.predictor, self.buckets, example_feed)
+                   for b in self._batchers]
+        return reports[0] if len(reports) == 1 else reports
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray],
+               timeout_ms: Optional[float] = None) -> Future:
+        """Admit one request; returns a Future of its output slices.
+        Raises QueueFullError (backpressure) or ServerClosedError
+        immediately instead of blocking the caller."""
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        if not feed:
+            raise ValueError("submit: empty feed")
+        ns = {k: (v.shape[0] if v.ndim else -1) for k, v in feed.items()}
+        n = next(iter(ns.values()))
+        if n <= 0 or any(m != n for m in ns.values()):
+            raise ValueError(
+                f"submit: feeds must share one positive leading batch dim "
+                f"(add [None] for single rows); got {ns}")
+        now = time.monotonic()
+        timeout = (self.default_timeout if timeout_ms is None
+                   else float(timeout_ms) / 1e3)
+        req = Request(feed, n, item_signature(feed),
+                      None if timeout is None else now + timeout, now)
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("server is stopped")
+            if len(self._queue) >= self.max_queue_size:
+                self.metrics.counter("serving/rejected").inc()
+                raise QueueFullError(
+                    f"request queue full ({self.max_queue_size}); retry "
+                    f"later or raise max_queue_size")
+            self._queue.append(req)
+            self.metrics.counter("serving/requests").inc()
+            self.metrics.gauge("serving/queue_depth").set(len(self._queue))
+            self._cond.notify()
+        return req.future
+
+    def infer(self, feed: Dict[str, np.ndarray],
+              timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+        """Blocking convenience wrapper around submit()."""
+        return self.submit(feed, timeout_ms=timeout_ms).result()
+
+    # -- serve loop --------------------------------------------------------
+    def _pop_group(self) -> Optional[List[Request]]:
+        """Take the queue head plus every queued same-signature request up
+        to the largest bucket; wait up to max_batch_delay for stragglers
+        once a group is open. Returns None only at shutdown with an empty
+        queue. Holds the lock except while sleeping on the condition."""
+        max_rows = self._batchers[0].max_bucket
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait(0.05)
+            group = [self._queue.popleft()]
+            sig = group[0].sig
+            rows = group[0].n
+
+            def scoop():
+                nonlocal rows
+                i = 0
+                while i < len(self._queue) and rows < max_rows:
+                    if self._queue[i].sig == sig:
+                        r = self._queue[i]
+                        del self._queue[i]
+                        group.append(r)
+                        rows += r.n
+                    else:
+                        i += 1
+
+            scoop()
+            deadline = time.monotonic() + self.max_batch_delay
+            # batch-delay gamble: trade a bounded sliver of latency for a
+            # fuller bucket — but never wait once the bucket is full or the
+            # server is draining
+            while (rows < max_rows and not self._closed):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+                scoop()
+            self.metrics.gauge("serving/queue_depth").set(len(self._queue))
+        return group
+
+    def _serve_loop(self, batcher: DynamicBatcher) -> None:
+        while True:
+            group = self._pop_group()
+            if group is None:
+                return
+            now = time.monotonic()
+            live: List[Request] = []
+            for r in group:
+                if r.expired(now):
+                    self.metrics.counter("serving/timeouts").inc()
+                    if not r.future.done():
+                        r.future.set_exception(TimeoutError(
+                            f"request missed its deadline after "
+                            f"{(now - r.enqueued_at) * 1e3:.1f}ms in queue"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            t0 = time.monotonic()
+            batcher.dispatch(live)
+            done = time.monotonic()
+            lat = self.metrics.histogram("serving/latency_ms")
+            wait = self.metrics.histogram("serving/queue_wait_ms")
+            for r in live:
+                lat.observe((done - r.enqueued_at) * 1e3)
+                wait.observe((t0 - r.enqueued_at) * 1e3)

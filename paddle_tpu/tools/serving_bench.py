@@ -1,0 +1,224 @@
+"""Serving load generator: dynamic batching vs sequential Predictor calls.
+
+Reference analog: the reference ecosystem benchmarked serving with an
+external RPC load tool; here the generator is in-process (no network
+noise) so BENCH rounds can track the batching win itself: N concurrent
+single-row requests served through `serving.InferenceServer` (one padded
+XLA dispatch per bucket) against the same N requests run one-by-one
+through the bare AOT Predictor.
+
+Arrivals are Poisson (exponential inter-arrival gaps at --qps) over
+--duration seconds, or a closed-loop burst of --requests when --qps is 0:
+the open-loop mode measures latency under a target load, the closed-loop
+mode measures peak throughput.
+
+CLI::
+
+    python -m paddle_tpu.tools.serving_bench --requests 256 --concurrency 32
+    python -m paddle_tpu.tools.serving_bench --qps 500 --duration 5 \
+        --buckets 1,2,4,8,16,32 --batch-delay-ms 2
+
+Output: one throughput + latency-percentile row per mode, plus the
+serving metrics report. Exit code 1 if batched throughput does not beat
+sequential (the property BENCH rounds assert).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+import threading
+import time
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["build_predictor", "bench_sequential", "bench_served",
+           "percentile_row", "main"]
+
+
+def build_predictor(model_dir: Optional[str] = None, in_dim: int = 512,
+                    hidden: int = 2048, classes: int = 16, layers: int = 2):
+    """Save an MLP inference model and return its Predictor. The default
+    size (2x2048 hidden) is deliberately weight-heavy: per batch-1 call
+    the CPU/TPU must re-read every weight, so batching has real economics
+    to demonstrate (one weight read serves the whole bucket)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import inference
+
+    model_dir = model_dir or tempfile.mkdtemp(prefix="serving_bench_")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [in_dim])
+        h = x
+        for _ in range(max(1, layers)):
+            h = fluid.layers.fc(h, hidden, act="relu")
+        out = fluid.layers.fc(h, classes, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+    return inference.create_predictor(inference.Config(model_dir))
+
+
+def _gen_rows(n: int, in_dim: int, seed: int = 0) -> List[np.ndarray]:
+    rng = np.random.RandomState(seed)
+    return [rng.rand(1, in_dim).astype(np.float32) for _ in range(n)]
+
+
+def _poisson_gaps(n: int, qps: float, seed: int = 0) -> np.ndarray:
+    return np.random.RandomState(seed + 1).exponential(1.0 / qps, size=n)
+
+
+def bench_sequential(predictor, rows: List[np.ndarray]) -> dict:
+    """One blocking batch-1 Predictor call per request (the no-serving
+    baseline: what a naive RPC handler per request would do)."""
+    predictor.run_padded({"x": rows[0]}, 1)  # compile outside the clock
+    lats = []
+    t0 = time.monotonic()
+    for r in rows:
+        s = time.monotonic()
+        predictor.run_padded({"x": r}, 1)
+        lats.append((time.monotonic() - s) * 1e3)
+    wall = time.monotonic() - t0
+    return _summarize("sequential", len(rows), wall, lats)
+
+
+def bench_served(predictor, rows: List[np.ndarray], concurrency: int = 32,
+                 buckets=(1, 2, 4, 8, 16, 32), batch_delay_ms: float = 2.0,
+                 qps: float = 0.0, seed: int = 0) -> dict:
+    """Drive the InferenceServer: closed-loop (`qps`=0, `concurrency`
+    submitter threads racing through the request list) or open-loop
+    Poisson arrivals at `qps`. Latency is measured from scheduled arrival
+    to completion, so open-loop numbers include queueing delay."""
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(
+        predictor, buckets=buckets, max_batch_delay_ms=batch_delay_ms,
+        max_queue_size=max(len(rows), 1024))
+    server.warmup(example_feed={"x": rows[0]})
+    lats = [0.0] * len(rows)
+    errors = [0]
+
+    with server:
+        t0 = time.monotonic()
+        if qps > 0:
+            gaps = _poisson_gaps(len(rows), qps, seed)
+            arrivals = t0 + np.cumsum(gaps)
+            futs = []
+            for i, r in enumerate(rows):
+                now = time.monotonic()
+                if arrivals[i] > now:
+                    time.sleep(arrivals[i] - now)
+                futs.append((i, server.submit({"x": r})))
+            for i, f in futs:
+                try:
+                    f.result()
+                    lats[i] = (time.monotonic() - arrivals[i]) * 1e3
+                except Exception:
+                    errors[0] += 1
+        else:
+            it = iter(list(enumerate(rows)))
+            lock = threading.Lock()
+
+            def drive():
+                while True:
+                    with lock:
+                        nxt = next(it, None)
+                    if nxt is None:
+                        return
+                    i, r = nxt
+                    s = time.monotonic()
+                    try:
+                        server.infer({"x": r})
+                        lats[i] = (time.monotonic() - s) * 1e3
+                    except Exception:
+                        errors[0] += 1
+
+            threads = [threading.Thread(target=drive)
+                       for _ in range(max(1, concurrency))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        wall = time.monotonic() - t0
+    out = _summarize(f"served(c={concurrency})" if qps <= 0
+                     else f"served(qps={qps:g})",
+                     len(rows) - errors[0], wall,
+                     [x for x in lats if x > 0])
+    out["errors"] = errors[0]
+    out["metrics"] = server.metrics.snapshot()
+    return out
+
+
+def _summarize(mode: str, n: int, wall: float, lats: List[float]) -> dict:
+    arr = np.asarray(sorted(lats)) if lats else np.asarray([0.0])
+
+    def pct(p):
+        return float(arr[min(len(arr) - 1, int(round(p / 100.0 * (len(arr) - 1))))])
+
+    return {"mode": mode, "requests": n, "wall_s": wall,
+            "throughput_rps": n / wall if wall > 0 else float("inf"),
+            "mean_ms": float(arr.mean()), "p50_ms": pct(50),
+            "p95_ms": pct(95), "p99_ms": pct(99)}
+
+
+def percentile_row(r: dict) -> str:
+    return (f"{r['mode']:<18}{r['requests']:>6}{r['wall_s']:>9.3f}"
+            f"{r['throughput_rps']:>12.1f}{r['mean_ms']:>10.2f}"
+            f"{r['p50_ms']:>10.2f}{r['p95_ms']:>10.2f}{r['p99_ms']:>10.2f}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=256,
+                    help="closed-loop request count (ignored with --qps)")
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help="open-loop Poisson arrival rate; 0 = closed loop")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="open-loop duration in seconds (with --qps)")
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--buckets", default="1,2,4,8,16,32")
+    ap.add_argument("--batch-delay-ms", type=float, default=2.0)
+    ap.add_argument("--in-dim", type=int, default=512)
+    ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--skip-sequential", action="store_true")
+    args = ap.parse_args(argv)
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    n = (args.requests if args.qps <= 0
+         else max(1, int(args.qps * args.duration)))
+    rows = _gen_rows(n, args.in_dim, args.seed)
+    pred = build_predictor(in_dim=args.in_dim, hidden=args.hidden,
+                           layers=args.layers)
+
+    header = (f"{'mode':<18}{'reqs':>6}{'wall_s':>9}{'rps':>12}"
+              f"{'mean_ms':>10}{'p50_ms':>10}{'p95_ms':>10}{'p99_ms':>10}")
+    print(header)
+    seq = None
+    if not args.skip_sequential:
+        seq = bench_sequential(pred, rows)
+        print(percentile_row(seq))
+    served = bench_served(pred, rows, concurrency=args.concurrency,
+                          buckets=buckets, batch_delay_ms=args.batch_delay_ms,
+                          qps=args.qps, seed=args.seed)
+    print(percentile_row(served))
+    print()
+    bs = served["metrics"].get("serving/batch_rows") or {}
+    print(f"batches={served['metrics'].get('serving/batches', 0)} "
+          f"mean_batch_rows={bs.get('mean') if bs else None} "
+          f"padded_rows={served['metrics'].get('serving/padded_rows', 0)} "
+          f"errors={served['errors']}")
+    if seq is not None:
+        speedup = served["throughput_rps"] / max(seq["throughput_rps"], 1e-9)
+        print(f"batched/sequential throughput: {speedup:.2f}x")
+        if served["throughput_rps"] <= seq["throughput_rps"]:
+            print("FAIL: dynamic batching did not beat sequential")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

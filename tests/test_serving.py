@@ -1,0 +1,267 @@
+"""paddle_tpu.serving: dynamic batching over the AOT Predictor.
+
+Covers the serving acceptance surface: bucket-padded results identical to
+the unbatched Predictor across ragged batch sizes, backpressure
+rejection, per-request deadlines, warmup compiling every bucket ahead of
+traffic, metrics snapshot sanity, and graceful shutdown drain — all on
+the CPU backend (no TPU needed: the batching layer is backend-agnostic).
+"""
+import time
+
+import numpy as np
+import pytest
+
+IN_DIM = 6
+CLASSES = 4
+BUCKETS = (2, 4, 8)
+
+
+@pytest.fixture(scope="module")
+def predictor(tmp_path_factory):
+    import paddle_tpu as fluid
+    from paddle_tpu import inference
+    from paddle_tpu.core import program as prog_mod
+
+    old = prog_mod._main_program, prog_mod._startup_program
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [IN_DIM])
+            h = fluid.layers.fc(x, 8, act="relu")
+            out = fluid.layers.fc(h, CLASSES, act="softmax")
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        model_dir = str(tmp_path_factory.mktemp("serving") / "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+        return inference.create_predictor(inference.Config(model_dir))
+    finally:
+        prog_mod._main_program, prog_mod._startup_program = old
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).rand(n, IN_DIM).astype(np.float32)
+
+
+# -- run_padded / batcher correctness ------------------------------------
+
+def test_run_padded_matches_unbatched_across_ragged_sizes(predictor):
+    """Padding to a bucket then slicing back must be bit-for-bit the rows
+    the unbatched Predictor computes — for every ragged size per bucket."""
+    for n in (1, 2, 3, 4, 5, 7, 8):
+        x = _rows(n, seed=n)
+        ref = predictor.run({"x": x})[0]
+        from paddle_tpu.serving import bucket_for
+        b = bucket_for(n, BUCKETS)
+        got = predictor.run_padded({"x": x}, b)[0]
+        assert got.shape == (n, CLASSES)
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_run_padded_validates_feed(predictor):
+    with pytest.raises(ValueError, match="leading batch"):
+        predictor.run_padded({"x": np.zeros((0, IN_DIM), np.float32)}, 4)
+    with pytest.raises(ValueError, match="exceed"):
+        predictor.run_padded({"x": _rows(9)}, 8)
+
+
+def test_server_equivalence_ragged_requests(predictor):
+    """Concurrent ragged requests (1/3/5 rows) batched through the server
+    return exactly what per-request unbatched runs return."""
+    from paddle_tpu import serving
+
+    sizes = [1, 3, 5, 2, 7, 1, 4]
+    feeds = [_rows(n, seed=10 + i) for i, n in enumerate(sizes)]
+    refs = [predictor.run({"x": f})[0] for f in feeds]
+    server = serving.InferenceServer(predictor, buckets=BUCKETS,
+                                     max_batch_delay_ms=5.0)
+    with server:
+        futs = [server.submit({"x": f}) for f in feeds]
+        outs = [f.result(timeout=30)[0] for f in futs]
+    for n, ref, got in zip(sizes, refs, outs):
+        assert got.shape == (n, CLASSES)
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_oversized_request_chains_buckets(predictor):
+    """A request beyond the largest bucket runs as chained chunks and
+    reassembles in order."""
+    from paddle_tpu import serving
+
+    x = _rows(21, seed=99)  # 21 > max bucket 8 -> 8 + 8 + 8(pad 3)
+    ref = predictor.run({"x": x})[0]
+    server = serving.InferenceServer(predictor, buckets=BUCKETS)
+    with server:
+        got = server.infer({"x": x})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_bucket_for():
+    from paddle_tpu.serving import bucket_for
+
+    assert bucket_for(1, BUCKETS) == 2
+    assert bucket_for(2, BUCKETS) == 2
+    assert bucket_for(5, BUCKETS) == 8
+    assert bucket_for(9, BUCKETS) is None
+
+
+# -- backpressure / timeout / shutdown -----------------------------------
+
+def test_backpressure_rejects_when_queue_full(predictor):
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=BUCKETS,
+                                     max_queue_size=2)
+    # not started: the queue can only fill
+    server.submit({"x": _rows(1)})
+    server.submit({"x": _rows(1)})
+    with pytest.raises(serving.QueueFullError):
+        server.submit({"x": _rows(1)})
+    assert server.metrics.counter("serving/rejected").value == 1
+    server.stop(drain=False)
+
+
+def test_timeout_path(predictor):
+    """A request whose deadline passes while queued is answered with
+    TimeoutError, not silently served late."""
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=BUCKETS)
+    expired = server.submit({"x": _rows(1)}, timeout_ms=1.0)
+    fresh = server.submit({"x": _rows(2)})  # no deadline
+    time.sleep(0.05)  # let the 1ms deadline lapse before serving starts
+    with server:
+        with pytest.raises(TimeoutError):
+            expired.result(timeout=30)
+        assert fresh.result(timeout=30)[0].shape == (2, CLASSES)
+    assert server.metrics.counter("serving/timeouts").value == 1
+
+
+def test_graceful_shutdown_drains_queue(predictor):
+    """stop() refuses new work but serves everything already admitted."""
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=BUCKETS)
+    feeds = [_rows(2, seed=40 + i) for i in range(10)]
+    futs = [server.submit({"x": f}) for f in feeds]
+    server.start()
+    server.stop()  # drain=True default
+    for f, feed in zip(futs, feeds):
+        assert f.done()
+        np.testing.assert_allclose(f.result()[0],
+                                   predictor.run({"x": feed})[0],
+                                   rtol=1e-6, atol=1e-6)
+    with pytest.raises(serving.ServerClosedError):
+        server.submit({"x": feeds[0]})
+
+
+def test_stop_without_drain_fails_pending(predictor):
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=BUCKETS)
+    fut = server.submit({"x": _rows(1)})
+    server.stop(drain=False)
+    with pytest.raises(serving.ServerClosedError):
+        fut.result(timeout=5)
+
+
+# -- warmup ---------------------------------------------------------------
+
+def test_warmup_compiles_all_buckets(predictor):
+    """Every (signature x bucket) executable exists before traffic; serving
+    after warmup adds no cache entries (no request pays a compile)."""
+    from paddle_tpu import serving
+
+    pred = predictor.clone()  # fresh empty executable cache, shared weights
+    assert len(pred._cache) == 0
+    report = serving.warmup(pred, BUCKETS,
+                            example_feed={"x": _rows(1)})
+    assert report["compiled"] == len(BUCKETS)
+    assert len(pred._cache) == len(BUCKETS)
+    # idempotent: a second warmup hits only the cache
+    report2 = serving.warmup(pred, BUCKETS, example_feed={"x": _rows(1)})
+    assert report2["compiled"] == 0
+    assert report2["cached"] == len(BUCKETS)
+    server = serving.InferenceServer(pred, buckets=BUCKETS)
+    with server:
+        for n in (1, 3, 5):
+            server.infer({"x": _rows(n, seed=n)})
+    assert len(pred._cache) == len(BUCKETS)
+
+
+# -- metrics --------------------------------------------------------------
+
+def test_metrics_snapshot_sanity(predictor):
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=BUCKETS,
+                                     max_batch_delay_ms=1.0)
+    with server:
+        for i in range(6):
+            server.infer({"x": _rows(2, seed=i)})
+    snap = server.metrics.snapshot()
+    assert snap["serving/requests"] == 6
+    assert snap["serving/latency_ms"]["count"] == 6
+    assert snap["serving/latency_ms"]["p50"] is not None
+    assert snap["serving/latency_ms"]["p50"] <= snap["serving/latency_ms"]["p99"]
+    assert 1 <= snap["serving/batches"] <= 6
+    assert snap["serving/batch_rows"]["count"] == snap["serving/batches"]
+    # every dispatched bucket is from the configured set
+    assert snap["serving/bucket"]["max"] in BUCKETS
+    assert snap["serving/queue_depth"] == 0
+    report = server.metrics.report()
+    assert "serving/requests" in report and "serving/latency_ms" in report
+
+
+def test_histogram_percentiles():
+    from paddle_tpu.serving import Histogram
+
+    h = Histogram("t")
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(50) == pytest.approx(50, abs=1)
+    assert h.percentile(99) == pytest.approx(99, abs=1)
+    s = h.snapshot()
+    assert s["count"] == 100 and s["min"] == 1 and s["max"] == 100
+
+
+# -- serving_bench plumbing ----------------------------------------------
+
+def test_serving_bench_smoke(predictor):
+    """The load generator runs end-to-end on CPU with tiny settings and
+    reports a complete summary for both modes."""
+    from paddle_tpu.tools import serving_bench as sb
+
+    rows = [np.random.RandomState(i).rand(1, IN_DIM).astype(np.float32)
+            for i in range(16)]
+    seq = sb.bench_sequential(predictor, rows)
+    served = sb.bench_served(predictor, rows, concurrency=8,
+                             buckets=BUCKETS, batch_delay_ms=1.0)
+    for r in (seq, served):
+        assert r["requests"] == 16
+        assert r["throughput_rps"] > 0
+        assert r["p50_ms"] <= r["p99_ms"]
+    assert served["errors"] == 0
+    assert served["metrics"]["serving/requests"] == 16
+
+
+# -- satellite regression: run_batched feed-key validation ----------------
+
+def test_run_batched_rejects_mismatched_feed_keys():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3])
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    good = {"x": np.zeros((2, 3), np.float32)}
+    exe.run(main, feed=good, fetch_list=[y])
+    bad = {"x": np.zeros((2, 3), np.float32),
+           "typo": np.zeros((2, 3), np.float32)}
+    with pytest.raises(ValueError, match=r"step 1.*extra keys.*typo"):
+        exe.run_batched(main, [good, bad], fetch_list=[y])
+    with pytest.raises(ValueError, match=r"step 1.*missing keys.*x"):
+        exe.run_batched(main, [good, {}], fetch_list=[y])

@@ -323,3 +323,23 @@ def test_deferred_fold_fires_under_compiled_program():
     plain = run(False)
     comp = run(True)
     np.testing.assert_allclose(plain, comp, rtol=1e-5, atol=1e-7)
+
+
+def test_row_pack_table_rejects_deferred_rows():
+    """Misconfiguration fails loudly at minimize() time: a row_pack table
+    driven with deferred_rows (instead of packed_rows) used to wire the
+    deferred machinery onto the packed lookup site and die later with a
+    far-away shape error (ADVICE r5, optimizer.py:104)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [F], dtype="int64")
+        emb = layers.embedding(
+            ids, [V, D], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb", initializer=RowPackInitializer(
+                D, D, -1.0, 1.0)))
+        loss = layers.reduce_sum(layers.square(emb))
+        opt = fluid.optimizer.SGD(0.1,
+                                  deferred_rows={"rows_per_step": B * F})
+        with pytest.raises(ValueError,
+                           match=r"row_pack=True.*packed_rows"):
+            opt.minimize(loss)
