@@ -30,6 +30,7 @@ from . import io  # noqa: F401
 from . import ir  # noqa: F401
 from . import inference  # noqa: F401
 from . import metrics  # noqa: F401
+from . import observability  # noqa: F401
 from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401
 from . import serving  # noqa: F401

@@ -23,8 +23,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import jax.numpy as jnp
 
-from .executor import _RNG_STATE, ExecContext, _run_block
+from .executor import (_RNG_STATE, _CACHE_HITS, _CACHE_MISSES, _EXECUTE_MS,
+                       _OBS, _WATCHDOG, _sig_digest, ExecContext, _run_block)
 from .program import Program, Variable
+from ..observability.tracer import trace_span
+
+import time
+import weakref
 
 
 class BuildStrategy:
@@ -247,12 +252,22 @@ class CompiledProgram:
                    id(self._mesh), self._data_axis,
                    getattr(self, "_seq_axis", None))
         fn = self._cache.get(key_sig)
-        if fn is None:
+        compiling = fn is None
+        if compiling:
+            _CACHE_MISSES.inc()
+            wd_key = (id(self._program), program._version, "mesh",
+                      tuple(fetch_names))
+            if _WATCHDOG.record_compile(
+                    wd_key, feed_sig,
+                    label=f"CompiledProgram 0x{id(self._program):x}"):
+                weakref.finalize(self._program, _WATCHDOG.forget, wd_key)
             fn = self._build(sorted(feed_vals), fetch_names, state_names,
                              out_state_names,
                              {n: np.asarray(v).ndim if not isinstance(v, jax.Array) else v.ndim
                               for n, v in feed_vals.items()})
             self._cache[key_sig] = fn
+        else:
+            _CACHE_HITS.inc()
 
         state = {}
         for n in state_names:
@@ -289,7 +304,17 @@ class CompiledProgram:
                 key = jax.make_array_from_process_local_data(
                     sh, np.asarray(key))
 
-        fetches, new_state, new_key = fn(state, feed_vals, key)
+        t0 = time.perf_counter()
+        with trace_span("compiled_program/compile+run" if compiling
+                        else "compiled_program/run",
+                        sig=_sig_digest(feed_sig)):
+            fetches, new_state, new_key = fn(state, feed_vals, key)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if compiling:
+            _OBS.histogram("executor/compile_ms",
+                           sig=_sig_digest(feed_sig)).observe(dt_ms)
+        else:
+            _EXECUTE_MS.observe(dt_ms)
         for n, v in new_state.items():
             scope.set_var(n, v)
         scope.set_var(_RNG_STATE, new_key)

@@ -29,9 +29,35 @@ from . import registry
 from .program import Block, Program, Variable, default_main_program, grad_var_name
 from .scope import Scope, _scope, global_scope
 
+from ..observability.registry import get_registry
+from ..observability.tracer import trace_span
+from ..observability.watchdog import get_watchdog
+
+import time
 import weakref
 
 _RNG_STATE = "@RNG_STATE@"
+
+# Executor telemetry lives in the process-wide registry so one export
+# shows executor + serving + user metrics together. Handles are module-
+# level: the hot path must not take the registry creation lock per step.
+_OBS = get_registry()
+_CACHE_HITS = _OBS.counter("executor/cache_hits")
+_CACHE_MISSES = _OBS.counter("executor/cache_misses")
+_EXECUTE_MS = _OBS.histogram("executor/execute_ms")
+_UPDATE_FLUSHES = _OBS.counter("executor/update_flushes")
+_FUSED_GROUPS = _OBS.counter("executor/fused_update_groups")
+_FUSED_OPS = _OBS.counter("executor/fused_update_ops")
+_WATCHDOG = get_watchdog()
+
+
+def _sig_digest(feed_sig) -> str:
+    """Short stable label for a feed signature (crc32 of its repr, NOT
+    hash() — str hashing is salted per process, and BENCH rounds compare
+    these labels across runs), so compile-time histograms can be told
+    apart per signature without dumping the whole tuple into a label."""
+    import zlib
+    return format(zlib.crc32(repr(feed_sig).encode()) & 0xFFFFFFFF, "08x")
 
 
 def feed_signature(feed_vals) -> tuple:
@@ -555,6 +581,11 @@ def _run_block(block: Block, env: Dict[str, object], ctx: ExecContext):
     def flush():
         if not pending:
             return
+        # counted at TRACE time (once per compiled signature, not per
+        # step): how many flush points the lowering hit and how many
+        # update ops actually fused — the observable for tuning
+        # PDTPU_FUSE_UPDATES
+        _UPDATE_FLUSHES.inc()
         groups: Dict[object, List] = {}
         singles: List = []
         for p in pending:
@@ -567,6 +598,8 @@ def _run_block(block: Block, env: Dict[str, object], ctx: ExecContext):
             if len(ops_) == 1:
                 singles.append(ops_[0])
             else:
+                _FUSED_GROUPS.inc()
+                _FUSED_OPS.inc(len(ops_))
                 _run_update_group(ops_, env, ctx)
         for p in singles:
             _run_op(p, env, ctx)
@@ -866,10 +899,22 @@ class Executor:
         key_sig = (id(program), program._version, feed_sig, tuple(fetch_names),
                    tuple(state_names))
         fn = self._cache.get(key_sig)
-        if fn is None:
+        compiling = fn is None
+        if compiling:
+            _CACHE_MISSES.inc()
+            # every cache miss is one XLA trace+compile: count it per
+            # program and let the watchdog diagnose shape-churn storms
+            if _WATCHDOG.record_compile(
+                    (id(program), program._version, tuple(fetch_names)),
+                    feed_sig, label=f"Executor program 0x{id(program):x}"):
+                weakref.finalize(
+                    program, _WATCHDOG.forget,
+                    (id(program), program._version, tuple(fetch_names)))
             fn = self._build(program, sorted(feed_vals), fetch_names,
                              state_names, out_state_names)
             self._cache[key_sig] = fn
+        else:
+            _CACHE_HITS.inc()
 
         state = {n: scope.find_var(n) for n in state_names}
         key = scope.find_var(_RNG_STATE)
@@ -878,7 +923,21 @@ class Executor:
         state = {n: (v if isinstance(v, jax.Array) else jnp.asarray(v))
                  for n, v in state.items()}
 
-        fetches, new_state, new_key = fn(state, feed_vals, key)
+        t0 = time.perf_counter()
+        with trace_span("executor/compile+run" if compiling
+                        else "executor/run", sig=_sig_digest(feed_sig)):
+            fetches, new_state, new_key = fn(state, feed_vals, key)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if compiling:
+            # the first call pays trace+compile (+ the first dispatch);
+            # labeled per signature so a shape-churning feed shows up as
+            # many one-count compile histograms
+            _OBS.histogram("executor/compile_ms",
+                           sig=_sig_digest(feed_sig)).observe(dt_ms)
+        else:
+            # steady-state host dispatch time (device work is async on
+            # real accelerators; on CPU this is the full step)
+            _EXECUTE_MS.observe(dt_ms)
 
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -983,10 +1042,22 @@ class Executor:
                 f"run_batched needs every persistable in scope (run the "
                 f"startup program and one plain run first); missing: "
                 f"{missing[:5]}")
+        stacked_sig = feed_signature(stacked)
         key_sig = (id(program), program._version, n,
-                   feed_signature(stacked), tuple(fetch_names))
+                   stacked_sig, tuple(fetch_names))
         fn = self._cache.get(key_sig)
-        if fn is None:
+        compiling = fn is None
+        if compiling:
+            _CACHE_MISSES.inc()
+            if _WATCHDOG.record_compile(
+                    (id(program), program._version, "batched",
+                     tuple(fetch_names)),
+                    stacked_sig,
+                    label=f"Executor program 0x{id(program):x} (batched)"):
+                weakref.finalize(
+                    program, _WATCHDOG.forget,
+                    (id(program), program._version, "batched",
+                     tuple(fetch_names)))
             inner = self._build(program, keys, fetch_names,
                                 state_names, state_names)
             raw_step = inner._step
@@ -1001,6 +1072,8 @@ class Executor:
 
             fn = _jax.jit(scan_fn, donate_argnums=(0,))
             self._cache[key_sig] = fn
+        else:
+            _CACHE_HITS.inc()
 
         state = {nm: scope.find_var(nm) for nm in state_names}
         state = {nm: (v if isinstance(v, jax.Array) else jnp.asarray(v))
@@ -1008,7 +1081,16 @@ class Executor:
         key = scope.find_var(_RNG_STATE)
         if key is None:
             key = _make_key(program.random_seed or 0)
-        ys, new_state, new_key = fn(state, stacked, key)
+        t0 = time.perf_counter()
+        with trace_span("executor/run_batched", steps=n,
+                        sig=_sig_digest(stacked_sig)):
+            ys, new_state, new_key = fn(state, stacked, key)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if compiling:
+            _OBS.histogram("executor/compile_ms",
+                           sig=_sig_digest(stacked_sig)).observe(dt_ms)
+        else:
+            _EXECUTE_MS.observe(dt_ms)
         for nm, v in new_state.items():
             scope.set_var(nm, v)
         scope.set_var(_RNG_STATE, new_key)

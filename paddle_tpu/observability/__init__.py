@@ -1,0 +1,48 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+Reference analog: the reference framework's observability pipeline was
+RecordEvent/DeviceTracer (platform/profiler.h:166) streaming into
+profiler.proto, converted to chrome://tracing by ``tools/timeline.py``,
+plus the sorted per-op profiler summary. The TPU build splits the same
+capability along its natural seam:
+
+- **Registry** (registry.py) — process-wide, thread-safe counters /
+  gauges / histograms (labeled, percentile snapshots), with a
+  Prometheus-style text exporter, JSON dump, and composition: per-server
+  `serving.Metrics` registries attach as children so ONE
+  ``get_registry().snapshot()`` shows executor cache hits/misses,
+  compile time, and serving latency together.
+- **trace_span / Tracer** (tracer.py) — host-side nested wall-clock
+  spans per thread, exported as chrome-trace JSON (chrome://tracing /
+  Perfetto). Device-side tracing stays with jax.profiler (XPlane);
+  ``paddle_tpu.profiler.record_event`` records into BOTH so host spans
+  and XPlane annotations line up, and
+  ``python -m paddle_tpu.tools.timeline`` merges/summarizes the files.
+- **RecompileWatchdog** (watchdog.py) — the executor reports every
+  executable-cache miss; past a threshold the watchdog warns once,
+  naming exactly which feed's shape/dtype diverged between the cached
+  and the new signature (the actionable diagnosis of a recompile storm).
+
+Quick start::
+
+    from paddle_tpu import observability as obs
+
+    with obs.trace_span("train/epoch", epoch=e):
+        exe.run(main, feed=..., fetch_list=[loss])
+
+    print(obs.get_registry().report())           # text table
+    obs.get_registry().dump_json("metrics.json") # registry export
+    obs.get_tracer().export_chrome_trace("host_trace.json")
+"""
+from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
+                       get_registry)
+from .tracer import Tracer, get_tracer, trace_span  # noqa: F401
+from .watchdog import (RecompileWarning, RecompileWatchdog,  # noqa: F401
+                       diff_signatures, get_watchdog)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "get_registry",
+    "Tracer", "get_tracer", "trace_span",
+    "RecompileWarning", "RecompileWatchdog", "diff_signatures",
+    "get_watchdog",
+]

@@ -1,0 +1,179 @@
+"""Host-side span tracer with chrome-trace export.
+
+Reference analog: RecordEvent + DeviceTracer (platform/profiler.h:166,
+device_tracer.cc) collected host/device event streams that
+``tools/timeline.py`` converted to chrome://tracing JSON. Device-side
+tracing belongs to jax.profiler (XPlane); this module is the HOST side:
+wall-clock spans recorded per thread with proper nesting, exported as
+chrome-trace JSON that loads directly in chrome://tracing or
+https://ui.perfetto.dev — and mergeable with a converted XPlane trace via
+``python -m paddle_tpu.tools.timeline``.
+
+Usage::
+
+    from paddle_tpu.observability import trace_span, get_tracer
+
+    with trace_span("train/step", step=i):
+        ...
+
+    @trace_span("load_batch")
+    def load_batch(...): ...
+
+    get_tracer().export_chrome_trace("host_trace.json")
+
+Spans are recorded as B/E (begin/end) event pairs, which chrome-trace
+nests by timestamp per thread — the context-manager protocol guarantees
+every B gets its E even when the body raises. Overhead per span is one
+``perf_counter`` call and one lock-protected list append at each end;
+when the tracer is disabled (``get_tracer().enabled = False``) a span is
+a no-op.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Tracer", "get_tracer", "trace_span"]
+
+# one process-wide timebase so spans from every thread share a clock;
+# chrome trace wants microseconds
+_T0 = time.perf_counter()
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _T0) * 1e6
+
+
+class Tracer:
+    """Collects completed span events; bounded so an unobserved long-running
+    process cannot grow without limit (past `max_events` new events are
+    dropped and counted in `dropped`)."""
+
+    def __init__(self, max_events: int = 200_000):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._thread_names: Dict[int, str] = {}
+        self.max_events = int(max_events)
+        self.dropped = 0
+        self.enabled = True
+
+    # -- recording ---------------------------------------------------------
+    def _emit(self, ev: dict) -> None:
+        tid = ev["tid"]
+        with self._lock:
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def begin(self, name: str, args: Optional[dict] = None) -> None:
+        ev = {"name": name, "ph": "B", "ts": _now_us(),
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def end(self, name: str) -> None:
+        self._emit({"name": name, "ph": "E", "ts": _now_us(),
+                    "pid": os.getpid(), "tid": threading.get_ident()})
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        """One timestamped marker (chrome-trace 'i' event)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "i", "s": "t", "ts": _now_us(),
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    # -- export ------------------------------------------------------------
+    def export_chrome_trace(self, path: Optional[str] = None) -> dict:
+        """Chrome-trace JSON object ({"traceEvents": [...]}); written to
+        `path` when given. Loadable in chrome://tracing and Perfetto."""
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._thread_names)
+        pid = os.getpid()
+        meta: List[dict] = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": "paddle_tpu host"}}]
+        for tid, tname in sorted(names.items()):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": tname}})
+        trace = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+        return trace
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._thread_names.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide host tracer every `trace_span` records into."""
+    return _tracer
+
+
+class trace_span:
+    """Record one named wall-clock span: context manager AND decorator.
+
+    ::
+
+        with trace_span("executor/compile", sig=digest):
+            ...
+
+        @trace_span("serve")          # span per call, named "serve"
+        def serve(...): ...
+
+    Keyword arguments become chrome-trace `args` (visible on click in the
+    trace viewer). Spans nest naturally per thread; the end event is
+    emitted even when the body raises.
+    """
+
+    __slots__ = ("name", "args", "_entered")
+
+    def __init__(self, name: str, **args):
+        self.name = name
+        self.args = args or None
+        self._entered = False
+
+    def __enter__(self):
+        t = _tracer
+        if t.enabled:
+            self._entered = True
+            t.begin(self.name, self.args)
+        return self
+
+    def __exit__(self, *exc):
+        if self._entered:
+            self._entered = False
+            _tracer.end(self.name)
+        return False
+
+    def __call__(self, fn):
+        name, args = self.name, self.args or {}
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with trace_span(name, **args):
+                return fn(*a, **kw)
+
+        return wrapper

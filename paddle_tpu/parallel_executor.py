@@ -12,6 +12,7 @@ from typing import Optional
 from .core.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
 from .core.executor import Executor, TPUPlace
 from .core.program import default_main_program
+from .observability import get_registry, trace_span
 
 __all__ = ["ParallelExecutor", "BuildStrategy", "ExecutionStrategy"]
 
@@ -27,12 +28,14 @@ class ParallelExecutor:
             share_vars_from=getattr(share_vars_from, "_compiled", None))
         self._exe = Executor(TPUPlace())
         self._scope = scope
+        get_registry().gauge("executor/device_count").set(self.device_count)
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict
-        return self._exe.run(self._compiled, feed=feed,
-                             fetch_list=list(fetch_list),
-                             scope=self._scope, return_numpy=return_numpy)
+        with trace_span("parallel_executor/run"):
+            return self._exe.run(self._compiled, feed=feed,
+                                 fetch_list=list(fetch_list),
+                                 scope=self._scope, return_numpy=return_numpy)
 
     @property
     def device_count(self):

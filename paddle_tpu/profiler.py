@@ -20,19 +20,38 @@ from typing import Optional
 import jax
 
 
+_active = {}
+
+
 def start_profiler(state: str = "All", tracer_option=None,
                    log_dir: str = "/tmp/paddle_tpu_profile"):
+    """Begin one jax.profiler trace session. Exactly one session can be
+    active per process (a jax.profiler limitation); a second start — e.g.
+    a nested `profiler()` context — raises a clear error instead of
+    clobbering the session state and crashing inside jax at stop time."""
+    if _active.get("dir") is not None:
+        raise RuntimeError(
+            f"start_profiler: a profiling session is already active "
+            f"(writing to {_active['dir']!r}) — nested profiler()/"
+            f"start_profiler calls are not supported; stop_profiler() "
+            f"first. For cheap always-on host spans inside a profiled "
+            f"region use observability.trace_span instead.")
     os.makedirs(log_dir, exist_ok=True)
     jax.profiler.start_trace(log_dir)
     _active["dir"] = log_dir
 
 
 def stop_profiler(sorted_key: Optional[str] = None, profile_path: Optional[str] = None):
-    jax.profiler.stop_trace()
-    return _active.get("dir")
-
-
-_active = {}
+    """End the active session and return its log dir. Raises a clear
+    error when no session is active (previously this surfaced as an
+    opaque failure from inside jax.profiler)."""
+    if _active.get("dir") is None:
+        raise RuntimeError(
+            "stop_profiler without a matching start_profiler: no "
+            "profiling session is active")
+    log_dir = _active.pop("dir")  # cleared even if stop_trace raises,
+    jax.profiler.stop_trace()     # so a new session can still start
+    return log_dir
 
 
 @contextlib.contextmanager
@@ -47,10 +66,18 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
         stop_profiler(sorted_key, profile_path)
 
 
-def record_event(name: str):
-    """RecordEvent RAII parity (platform/profiler.h:81): annotates the trace
-    AND the compiled HLO (shows up per-fusion in XLA tooling)."""
-    return jax.profiler.TraceAnnotation(name)
+@contextlib.contextmanager
+def record_event(name: str, **args):
+    """RecordEvent RAII parity (platform/profiler.h:81): annotates the
+    device trace AND the compiled HLO (jax.profiler.TraceAnnotation,
+    visible per-fusion in XLA tooling) AND records a host-side span in
+    `observability.get_tracer()` — so the same named region lines up in
+    the XPlane trace and the chrome-trace export of the host tracer.
+    Extra kwargs become chrome-trace span args."""
+    from .observability.tracer import trace_span
+
+    with trace_span(name, **args), jax.profiler.TraceAnnotation(name):
+        yield
 
 
 class _OpTimer:

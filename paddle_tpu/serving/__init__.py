@@ -17,9 +17,13 @@ is a batching scheduler in front of one AOT Predictor:
   per-request deadlines, graceful drain on stop().
 - `warmup` (warmup.py) — compiles every (signature x bucket) executable
   ahead of serving so no user request ever pays an XLA compile.
-- `Metrics` (metrics.py) — lock-protected counters/histograms (requests,
-  batch-size distribution, queue depth, latency percentiles, timeouts,
-  rejections) with a `snapshot()` dict and text `report()`.
+- `Metrics` (metrics.py) — per-server registry of lock-protected
+  counters/histograms (requests, batch-size distribution, queue depth,
+  latency percentiles, timeouts, rejections) with a `snapshot()` dict
+  and text `report()`. Built on `paddle_tpu.observability.Registry` and
+  attached to the process-wide registry, so `InferenceServer.stats()`
+  (or `observability.get_registry().snapshot()`) shows serving latency
+  next to executor cache-hit/compile-time metrics in one export.
 
 Minimal end-to-end::
 

@@ -128,10 +128,13 @@ class DynamicBatcher:
             chunk = {k: v[off:off + take] for k, v in concat.items()}
             m.counter("serving/padded_rows").inc(bucket - take)
             m.histogram("serving/bucket").observe(bucket)
-            # the annotation shows up in jax.profiler traces AND in the
-            # dispatched HLO metadata — per-bucket serving cost is visible
-            # in the same tooling as training steps (profiler.record_event)
-            with profiler.record_event(f"serving/dispatch_b{bucket}"):
+            # the annotation shows up in jax.profiler traces, in the
+            # dispatched HLO metadata, AND as a host span in the
+            # observability tracer's chrome-trace export — per-bucket
+            # serving cost is visible in the same tooling as training
+            # steps (profiler.record_event routes to both)
+            with profiler.record_event(f"serving/dispatch_b{bucket}",
+                                       rows=take, bucket=bucket):
                 out = self.predictor.run_padded(chunk, bucket)
             for o in out:
                 if not (getattr(o, "ndim", 0) and o.shape[0] == take):

@@ -1,186 +1,44 @@
-"""Serving metrics: lock-protected counters / gauges / histograms.
+"""Serving metrics: a per-server view onto the shared telemetry Registry.
 
 Reference analog: the reference framework's serving deployments counted
 QPS and latency outside the framework (Paddle Serving's grpc metrics);
 here the registry is in-process so the batcher/server can account every
 request, batch, rejection, and timeout at the exact point it happens.
 
-Design: tiny and allocation-light — a serving hot path touches these on
-every request, so each metric holds one small lock (contention is
-per-metric, not registry-wide) and `Histogram` keeps a fixed-size ring
-of recent observations rather than an unbounded list: percentiles are
-over the last `cap` samples, which is what a serving dashboard wants
-anyway (recent tail, not all-time tail).
+Since the observability subsystem landed, the metric primitives
+(`Counter`/`Gauge`/`Histogram`) and the registry machinery live in
+``paddle_tpu.observability.registry`` — one implementation shared by the
+executor, the serving tier, and user code. `Metrics` stays the serving
+public API: an instance-scoped registry (two servers in one process keep
+separate request counts) that ATTACHES itself to the process-wide
+registry, so ``observability.get_registry().snapshot()`` shows serving
+latency next to executor cache/compile metrics in one export, and
+`InferenceServer.stats()` can surface the unified view.
+
+Histogram snapshot/percentile reads are copy-on-read under the metric's
+lock (the ring is copied before any sorting), so concurrent `observe()`
+calls from serve workers can never corrupt a dashboard read — see the
+threaded regression test in tests/test_observability.py.
 """
 from __future__ import annotations
 
-import threading
-from typing import Dict, List, Optional
+from ..observability.registry import (Counter, Gauge, Histogram,  # noqa: F401
+                                      Registry, get_registry)
 
 __all__ = ["Counter", "Gauge", "Histogram", "Metrics"]
 
 
-class Counter:
-    """Monotonic counter (requests, batches, rejections, timeouts)."""
+class Metrics(Registry):
+    """Instance-scoped metric registry for one server/batcher.
 
-    def __init__(self, name: str):
-        self.name = name
-        self._lock = threading.Lock()
-        self._value = 0
+    Metrics are created on first use so the hot path never needs
+    None-checks. By default the instance attaches to the process-wide
+    registry (`observability.get_registry()`) as a child — weakly held,
+    so a dropped server's metrics leave the global export automatically.
+    Pass ``attach=False`` for a fully isolated registry.
+    """
 
-    def inc(self, n: int = 1) -> None:
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> int:
-        with self._lock:
-            return self._value
-
-
-class Gauge:
-    """Point-in-time value (queue depth)."""
-
-    def __init__(self, name: str):
-        self.name = name
-        self._lock = threading.Lock()
-        self._value = 0.0
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._value = float(v)
-
-    def add(self, d: float) -> None:
-        with self._lock:
-            self._value += float(d)
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-
-class Histogram:
-    """Observation stream with all-time count/sum/min/max and percentiles
-    over a fixed ring of the most recent `cap` observations."""
-
-    def __init__(self, name: str, cap: int = 8192):
-        self.name = name
-        self._lock = threading.Lock()
-        self._ring: List[float] = []
-        self._cap = int(cap)
-        self._idx = 0
-        self._count = 0
-        self._sum = 0.0
-        self._min: Optional[float] = None
-        self._max: Optional[float] = None
-
-    def observe(self, v: float) -> None:
-        v = float(v)
-        with self._lock:
-            self._count += 1
-            self._sum += v
-            self._min = v if self._min is None else min(self._min, v)
-            self._max = v if self._max is None else max(self._max, v)
-            if len(self._ring) < self._cap:
-                self._ring.append(v)
-            else:
-                self._ring[self._idx] = v
-                self._idx = (self._idx + 1) % self._cap
-
-    @property
-    def count(self) -> int:
-        with self._lock:
-            return self._count
-
-    def percentile(self, p: float) -> Optional[float]:
-        """Nearest-rank percentile (p in [0, 100]) over the retained ring."""
-        with self._lock:
-            data = sorted(self._ring)
-        if not data:
-            return None
-        rank = max(0, min(len(data) - 1,
-                          int(round(p / 100.0 * (len(data) - 1)))))
-        return data[rank]
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            n, s = self._count, self._sum
-            lo, hi = self._min, self._max
-            data = sorted(self._ring)
-
-        def pct(p):
-            if not data:
-                return None
-            return data[max(0, min(len(data) - 1,
-                                   int(round(p / 100.0 * (len(data) - 1)))))]
-
-        return {"count": n, "mean": (s / n) if n else None,
-                "min": lo, "max": hi,
-                "p50": pct(50), "p95": pct(95), "p99": pct(99)}
-
-
-class Metrics:
-    """Named registry; metrics are created on first use so the batcher and
-    server never need None-checks on the hot path."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._histograms: Dict[str, Histogram] = {}
-
-    def counter(self, name: str) -> Counter:
-        with self._lock:
-            m = self._counters.get(name)
-            if m is None:
-                m = self._counters[name] = Counter(name)
-            return m
-
-    def gauge(self, name: str) -> Gauge:
-        with self._lock:
-            m = self._gauges.get(name)
-            if m is None:
-                m = self._gauges[name] = Gauge(name)
-            return m
-
-    def histogram(self, name: str, cap: int = 8192) -> Histogram:
-        with self._lock:
-            m = self._histograms.get(name)
-            if m is None:
-                m = self._histograms[name] = Histogram(name, cap)
-            return m
-
-    def snapshot(self) -> dict:
-        """One plain dict of everything — counters/gauges as numbers,
-        histograms as their summary dicts."""
-        with self._lock:
-            counters = list(self._counters.values())
-            gauges = list(self._gauges.values())
-            hists = list(self._histograms.values())
-        out: dict = {}
-        for c in counters:
-            out[c.name] = c.value
-        for g in gauges:
-            out[g.name] = g.value
-        for h in hists:
-            out[h.name] = h.snapshot()
-        return out
-
-    def report(self) -> str:
-        """Human-readable text table of the snapshot."""
-        snap = self.snapshot()
-        lines = [f"{'metric':<36}{'value':>44}"]
-        for name in sorted(snap):
-            v = snap[name]
-            if isinstance(v, dict):
-                parts = []
-                for k in ("count", "mean", "p50", "p95", "p99", "max"):
-                    x = v.get(k)
-                    if x is None:
-                        continue
-                    parts.append(f"{k}={x:.3f}" if isinstance(x, float)
-                                 else f"{k}={x}")
-                v = " ".join(parts) or "-"
-            lines.append(f"{name:<36}{str(v):>44}")
-        return "\n".join(lines)
+    def __init__(self, attach: bool = True):
+        super().__init__()
+        if attach:
+            get_registry().attach(self)

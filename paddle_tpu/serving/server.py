@@ -149,6 +149,23 @@ class InferenceServer:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def stats(self) -> dict:
+        """Unified runtime snapshot: this server's serving metrics merged
+        with the process-wide observability registry — executor
+        executable-cache hits/misses, per-signature compile time, queue
+        depth, and latency percentiles in ONE dict (the server's
+        `Metrics` attaches itself to `observability.get_registry()` at
+        construction). For only this server's metrics use
+        ``server.metrics.snapshot()``."""
+        from ..observability import get_registry
+
+        snap = get_registry().snapshot(deep=True)
+        # a detached Metrics (Metrics(attach=False)) must still show up
+        # in its own server's stats
+        for k, v in self.metrics.snapshot().items():
+            snap.setdefault(k, v)
+        return snap
+
     def warmup(self, example_feed: Optional[Dict[str, np.ndarray]] = None):
         """Compile every (signature x bucket) executable before serving
         (see serving.warmup.warmup)."""

@@ -21,10 +21,18 @@ CLI::
 Output: one throughput + latency-percentile row per mode, plus the
 serving metrics report. Exit code 1 if batched throughput does not beat
 sequential (the property BENCH rounds assert).
+
+Telemetry sidecars: ``--metrics-out m.json`` dumps the unified
+observability Registry snapshot (serving counters AND executor
+cache-hit/compile-time metrics) and ``--trace-out t.json`` writes the
+host tracer's chrome-trace of the run, so BENCH rounds carry cache and
+compile telemetry alongside the throughput numbers for free
+(``python -m paddle_tpu.tools.timeline t.json --summary`` to read it).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import tempfile
 import threading
@@ -185,6 +193,13 @@ def main(argv=None) -> int:
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--skip-sequential", action="store_true")
+    ap.add_argument("--metrics-out", default=None,
+                    help="dump the unified observability Registry "
+                         "snapshot (serving + executor metrics) as JSON")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the host tracer's chrome-trace JSON of "
+                         "the run (load in Perfetto, or summarize with "
+                         "tools.timeline --summary)")
     args = ap.parse_args(argv)
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
@@ -211,6 +226,28 @@ def main(argv=None) -> int:
           f"mean_batch_rows={bs.get('mean') if bs else None} "
           f"padded_rows={served['metrics'].get('serving/padded_rows', 0)} "
           f"errors={served['errors']}")
+    if args.metrics_out:
+        from paddle_tpu.observability import get_registry
+
+        snap = get_registry().snapshot(deep=True)
+        # the bench server is gone by now (its Metrics child is attached
+        # to the registry by weakref), so overlay its final snapshot
+        for k, v in served["metrics"].items():
+            snap.setdefault(k, v)
+        snap["bench/served"] = {k: v for k, v in served.items()
+                                if k != "metrics"}
+        if seq is not None:
+            snap["bench/sequential"] = seq
+        with open(args.metrics_out, "w") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+        print(f"wrote registry snapshot to {args.metrics_out}")
+    if args.trace_out:
+        from paddle_tpu.observability import get_tracer
+
+        trace = get_tracer().export_chrome_trace(args.trace_out)
+        print(f"wrote {args.trace_out} "
+              f"({len(trace['traceEvents'])} events) — load in "
+              f"chrome://tracing or ui.perfetto.dev")
     if seq is not None:
         speedup = served["throughput_rps"] / max(seq["throughput_rps"], 1e-9)
         print(f"batched/sequential throughput: {speedup:.2f}x")

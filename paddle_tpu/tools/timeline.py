@@ -1,16 +1,28 @@
-"""Profile → chrome://tracing converter.
+"""Trace toolbox: XPlane conversion + chrome-trace merge + span summary.
 
 Reference analog: ``tools/timeline.py`` (profiler.proto → chrome trace
-JSON). The TPU build profiles through jax.profiler (XPlane protos under
-``<logdir>/plugins/profile/<run>/*.xplane.pb``, written by
-``paddle_tpu.profiler`` / ``jax.profiler.trace``); this tool converts a
-run's XPlane to the same chrome://tracing JSON the reference produced, via
-the xprof trace-viewer converter when available.
+JSON, with a --profile_path that accepted multiple "name=file" inputs)
+plus the profiler's sorted per-op summary. The TPU build produces TWO
+kinds of traces:
 
-CLI::
+- device-side XPlane protos (``paddle_tpu.profiler`` / jax.profiler,
+  under ``<logdir>/plugins/profile/<run>/*.xplane.pb``) — converted here
+  to chrome-trace JSON via the xprof converter when available;
+- host-side chrome-trace JSON written by the observability span tracer
+  (``observability.get_tracer().export_chrome_trace(path)``).
 
+This CLI converts, merges, and summarizes them into one file loadable in
+chrome://tracing or https://ui.perfetto.dev:
+
+    # convert a jax.profiler logdir (reference behavior, unchanged)
     python -m paddle_tpu.tools.timeline --logdir ./_trace --out trace.json
-    # then open chrome://tracing (or https://ui.perfetto.dev) and load it
+
+    # merge host + device traces into one timeline
+    python -m paddle_tpu.tools.timeline host.json device.json --out all.json
+
+    # per-span totals (count / total / avg / max ms), sorted like the
+    # reference profiler summary
+    python -m paddle_tpu.tools.timeline host.json --summary
 """
 from __future__ import annotations
 
@@ -18,7 +30,10 @@ import argparse
 import glob
 import json
 import os
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+__all__ = ["find_xplanes", "xplane_to_chrome_trace", "load_trace",
+           "merge_traces", "summarize", "format_summary", "main"]
 
 
 def find_xplanes(logdir: str) -> List[str]:
@@ -55,19 +70,143 @@ def xplane_to_chrome_trace(xplane_files: List[str]) -> dict:
     return out
 
 
+# -- chrome-trace plumbing ---------------------------------------------------
+
+def load_trace(path: str) -> dict:
+    """Read one chrome-trace JSON file; accepts both the object form
+    ({"traceEvents": [...]}) and the bare event-array form."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return {"traceEvents": data}
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(f"{path!r}: not a chrome-trace file "
+                         f"(no traceEvents)")
+    return data
+
+
+def merge_traces(traces: List[dict],
+                 names: Optional[List[str]] = None) -> dict:
+    """One trace from many: pids are remapped so same-numbered processes
+    from different files (e.g. a host trace and a converted device trace
+    both recorded under one OS pid) land on separate tracks, each tagged
+    with a process_name metadata row naming its source."""
+    out: List[dict] = []
+    next_pid = [0]
+    for i, trace in enumerate(traces):
+        src = names[i] if names and i < len(names) else f"trace{i}"
+        pid_map: Dict[object, int] = {}
+
+        def mapped(old):
+            if old not in pid_map:
+                pid_map[old] = next_pid[0]
+                next_pid[0] += 1
+            return pid_map[old]
+
+        renamed = set()
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            pid = mapped(ev.get("pid", 0))
+            ev["pid"] = pid
+            if (ev.get("ph") == "M" and ev.get("name") == "process_name"
+                    and pid not in renamed):
+                renamed.add(pid)
+                old_name = (ev.get("args") or {}).get("name", "")
+                ev["args"] = {"name": f"{src}: {old_name}".rstrip(": ")}
+            out.append(ev)
+        for old, pid in pid_map.items():
+            if pid not in renamed:
+                out.append({"name": "process_name", "ph": "M", "pid": pid,
+                            "tid": 0, "args": {"name": f"{src} (pid {old})"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def summarize(trace: dict) -> Dict[str, dict]:
+    """Per-span-name totals: {"name": {count, total_ms, avg_ms, max_ms}}.
+
+    Handles both duration forms: B/E pairs (matched per pid/tid with a
+    stack, so nesting is honored and stray E events are ignored) and
+    complete "X" events carrying an explicit dur."""
+    stats: Dict[str, dict] = {}
+
+    def add(name, dur_us):
+        s = stats.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                    "avg_ms": 0.0, "max_ms": 0.0})
+        ms = dur_us / 1e3
+        s["count"] += 1
+        s["total_ms"] += ms
+        s["max_ms"] = max(s["max_ms"], ms)
+
+    stacks: Dict[tuple, list] = {}
+    events = [ev for ev in trace.get("traceEvents", [])
+              if ev.get("ph") in ("B", "E", "X")]
+    events.sort(key=lambda ev: ev.get("ts", 0))
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X":
+            add(ev.get("name", "?"), float(ev.get("dur", 0)))
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(key, [])
+        if ph == "B":
+            stack.append((ev.get("name", "?"), float(ev.get("ts", 0))))
+        elif stack:  # E closes the innermost open B on this thread
+            name, ts0 = stack.pop()
+            add(name, float(ev.get("ts", 0)) - ts0)
+    for s in stats.values():
+        s["avg_ms"] = s["total_ms"] / max(s["count"], 1)
+    return stats
+
+
+def format_summary(stats: Dict[str, dict]) -> str:
+    """Sorted text table, total-time-descending — the analog of the
+    reference profiler's sorted per-op summary."""
+    lines = [f"{'span':<40}{'calls':>8}{'total_ms':>12}"
+             f"{'avg_ms':>10}{'max_ms':>10}"]
+    for name in sorted(stats, key=lambda n: -stats[n]["total_ms"]):
+        s = stats[name]
+        lines.append(f"{name:<40}{s['count']:>8}{s['total_ms']:>12.3f}"
+                     f"{s['avg_ms']:>10.4f}{s['max_ms']:>10.3f}")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--logdir", required=True,
-                    help="jax.profiler trace dir (the arg of profiler.start)")
-    ap.add_argument("--out", default="timeline.json",
-                    help="output chrome-trace JSON path")
+    ap.add_argument("traces", nargs="*",
+                    help="chrome-trace JSON files to merge/summarize "
+                         "(host tracer exports, prior conversions)")
+    ap.add_argument("--logdir",
+                    help="jax.profiler trace dir (the arg of "
+                         "profiler.start); converted and merged in")
+    ap.add_argument("--out",
+                    help="output chrome-trace JSON path "
+                         "(default timeline.json unless --summary only)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print per-span totals sorted by total time")
     args = ap.parse_args(argv)
-    files = find_xplanes(args.logdir)
-    trace = xplane_to_chrome_trace(files)
-    with open(args.out, "w") as f:
-        json.dump(trace, f)
-    print(f"wrote {args.out} ({len(trace.get('traceEvents', []))} events) — "
-          f"load in chrome://tracing or ui.perfetto.dev")
+    if not args.traces and not args.logdir:
+        ap.error("give chrome-trace files and/or --logdir")
+
+    traces, names = [], []
+    for path in args.traces:
+        traces.append(load_trace(path))
+        names.append(os.path.basename(path))
+    if args.logdir:
+        traces.append(xplane_to_chrome_trace(find_xplanes(args.logdir)))
+        names.append(os.path.basename(args.logdir.rstrip("/")) or "xplane")
+
+    merged = traces[0] if len(traces) == 1 else merge_traces(traces, names)
+    out_path = args.out
+    if out_path is None and not args.summary:
+        out_path = "timeline.json"
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+        print(f"wrote {out_path} "
+              f"({len(merged.get('traceEvents', []))} events) — "
+              f"load in chrome://tracing or ui.perfetto.dev")
+    if args.summary:
+        print(format_summary(summarize(merged)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,581 @@
+"""paddle_tpu.observability: registry, span tracer, recompile watchdog.
+
+Covers the telemetry acceptance surface: a single Registry export showing
+executor cache hit/miss + compile-time metrics next to serving latency,
+chrome-trace export that parses and is well-nested per thread, the
+timeline CLI's merge/summary, watchdog detection + diagnosis of a
+shape-changing feed (with zero false positives on steady shapes), the
+profiler start/stop guards, and the copy-on-read histogram snapshot
+under concurrent observers — all on the CPU backend.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Each test sees a fresh span stream (the tracer is process-global)."""
+    obs.get_tracer().clear()
+    yield
+    obs.get_tracer().clear()
+
+
+# -- Registry -------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = obs.Registry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    assert reg.counter("c").value == 5
+    reg.gauge("g").set(2.0)
+    reg.gauge("g").add(1.5)
+    assert reg.gauge("g").value == 3.5
+    for v in range(1, 101):
+        reg.histogram("h").observe(float(v))
+    snap = reg.snapshot()
+    assert snap["c"] == 5 and snap["g"] == 3.5
+    assert snap["h"]["count"] == 100
+    assert snap["h"]["p50"] == pytest.approx(50, abs=1)
+    assert snap["h"]["min"] == 1 and snap["h"]["max"] == 100
+
+
+def test_labels_key_separate_metrics_and_render_in_exports():
+    reg = obs.Registry()
+    reg.counter("compiles", sig="aa").inc(2)
+    reg.counter("compiles", sig="bb").inc(3)
+    assert reg.counter("compiles", sig="aa").value == 2
+    snap = reg.snapshot()
+    assert snap['compiles{sig="aa"}'] == 2
+    assert snap['compiles{sig="bb"}'] == 3
+    text = reg.prometheus_text()
+    assert 'compiles{sig="aa"} 2' in text
+    assert text.count("# TYPE compiles counter") == 1
+
+
+def test_prometheus_text_format():
+    reg = obs.Registry()
+    reg.counter("serving/requests").inc(7)
+    reg.gauge("queue_depth").set(3)
+    h = reg.histogram("latency_ms")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    text = reg.prometheus_text()
+    # names sanitized, TYPE lines present, summary carries quantiles
+    assert "# TYPE serving_requests counter" in text
+    assert "serving_requests 7" in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "# TYPE latency_ms summary" in text
+    assert 'latency_ms{quantile="0.5"} 2.0' in text
+    assert "latency_ms_count 3" in text
+    assert "latency_ms_sum 6.0" in text
+
+
+def test_registry_json_dump(tmp_path):
+    reg = obs.Registry()
+    reg.counter("a").inc()
+    reg.histogram("b").observe(1.0)
+    path = str(tmp_path / "metrics.json")
+    reg.dump_json(path)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["a"] == 1 and loaded["b"]["count"] == 1
+
+
+def test_attached_children_merge_into_deep_snapshot():
+    parent, child_a, child_b = obs.Registry(), obs.Registry(), obs.Registry()
+    parent.attach(child_a)
+    parent.attach(child_b)
+    parent.counter("own").inc()
+    child_a.counter("reqs").inc(2)
+    child_b.counter("reqs").inc(3)  # same name: counters sum
+    child_a.histogram("lat").observe(1.0)
+    child_b.histogram("lat").observe(9.0)  # same name: samples merge
+    snap = parent.snapshot(deep=True)
+    assert snap["own"] == 1
+    assert snap["reqs"] == 5
+    assert snap["lat"]["count"] == 2
+    assert snap["lat"]["min"] == 1.0 and snap["lat"]["max"] == 9.0
+    shallow = parent.snapshot(deep=False)
+    assert "reqs" not in shallow
+
+
+def test_detached_child_leaves_export_on_gc():
+    import gc
+
+    parent = obs.Registry()
+    child = obs.Registry()
+    parent.attach(child)
+    child.counter("temp").inc()
+    assert "temp" in parent.snapshot()
+    del child
+    gc.collect()
+    assert "temp" not in parent.snapshot()
+
+
+# -- satellite: histogram snapshot under concurrent observe ---------------
+
+def test_histogram_snapshot_copy_on_read_under_writer_threads():
+    """Hammer one histogram from writer threads while readers snapshot:
+    reads must never raise or see torn state, and the final count must
+    equal every observe() made (cap smaller than the write volume so the
+    ring wraps constantly — the hostile case for a torn read)."""
+    h = obs.Histogram("hammer", cap=64)
+    n_writers, per_writer = 8, 2000
+    stop = threading.Event()
+    errors = []
+
+    def write(seed):
+        for i in range(per_writer):
+            h.observe(float((seed * per_writer + i) % 997))
+
+    def read():
+        while not stop.is_set():
+            try:
+                s = h.snapshot()
+                assert (s["count"] == 0) == (s["p50"] is None)
+                if s["p50"] is not None:
+                    assert s["min"] <= s["p50"] <= s["p99"] <= s["max"]
+                h.percentile(95)
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    readers = [threading.Thread(target=read) for _ in range(4)]
+    writers = [threading.Thread(target=write, args=(i,))
+               for i in range(n_writers)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors, errors
+    assert h.count == n_writers * per_writer
+    assert h.snapshot()["count"] == n_writers * per_writer
+
+
+# -- tracer ----------------------------------------------------------------
+
+def _span_events(trace):
+    return [e for e in trace["traceEvents"] if e.get("ph") in ("B", "E")]
+
+
+def test_trace_span_nesting_and_chrome_export(tmp_path):
+    with obs.trace_span("outer", step=1):
+        with obs.trace_span("inner"):
+            pass
+        with obs.trace_span("inner"):
+            pass
+    path = str(tmp_path / "trace.json")
+    obs.get_tracer().export_chrome_trace(path)
+    with open(path) as f:
+        trace = json.load(f)  # valid JSON on disk
+    assert "traceEvents" in trace
+    evs = _span_events(trace)
+    assert [e["name"] for e in evs] == ["outer", "inner", "inner",
+                                       "inner", "inner", "outer"]
+    assert evs[0]["args"] == {"step": 1}
+    # B/E balanced and properly nested per thread
+    stack = []
+    for e in evs:
+        if e["ph"] == "B":
+            stack.append(e["name"])
+        else:
+            assert stack and stack.pop() == e["name"]
+    assert not stack
+    # timestamps are monotone non-decreasing within the thread
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # thread metadata present
+    assert any(e.get("name") == "thread_name" and e.get("ph") == "M"
+               for e in trace["traceEvents"])
+
+
+def test_trace_span_balances_on_exception():
+    with pytest.raises(RuntimeError):
+        with obs.trace_span("boom"):
+            raise RuntimeError("x")
+    evs = _span_events(obs.get_tracer().export_chrome_trace())
+    assert [e["ph"] for e in evs if e["name"] == "boom"] == ["B", "E"]
+
+
+def test_trace_span_decorator_and_disable():
+    @obs.trace_span("fn_span", kind="test")
+    def work(x):
+        return x + 1
+
+    assert work(1) == 2
+    assert work(2) == 3
+    tr = obs.get_tracer()
+    assert sum(1 for e in _span_events(tr.export_chrome_trace())
+               if e["name"] == "fn_span" and e["ph"] == "B") == 2
+    tr.enabled = False
+    try:
+        with obs.trace_span("hidden"):
+            pass
+    finally:
+        tr.enabled = True
+    assert not any(e["name"] == "hidden"
+                   for e in _span_events(tr.export_chrome_trace()))
+
+
+def test_tracer_spans_from_threads_keep_per_thread_nesting():
+    # all threads alive at once, else the OS reuses thread identifiers
+    barrier = threading.Barrier(4)
+
+    def run(name):
+        with obs.trace_span(name):
+            barrier.wait()
+            with obs.trace_span(name + "/leaf"):
+                pass
+
+    threads = [threading.Thread(target=run, args=(f"t{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    trace = obs.get_tracer().export_chrome_trace()
+    by_tid = {}
+    for e in _span_events(trace):
+        by_tid.setdefault(e["tid"], []).append(e)
+    assert len(by_tid) == 4
+    for evs in by_tid.values():
+        stack = []
+        for e in evs:
+            if e["ph"] == "B":
+                stack.append(e["name"])
+            else:
+                assert stack.pop() == e["name"]
+        assert not stack
+
+
+def test_tracer_event_cap_drops_and_counts():
+    t = obs.Tracer(max_events=4)
+    for i in range(4):
+        with _span_into(t, f"s{i}"):
+            pass
+    assert len(t) == 4 and t.dropped == 4  # first 2 spans kept, rest dropped
+
+
+class _span_into:
+    """Minimal span recorded into a specific tracer (trace_span always
+    targets the process tracer)."""
+
+    def __init__(self, tracer, name):
+        self.tracer, self.name = tracer, name
+
+    def __enter__(self):
+        self.tracer.begin(self.name)
+
+    def __exit__(self, *exc):
+        self.tracer.end(self.name)
+
+
+# -- timeline CLI ----------------------------------------------------------
+
+def test_timeline_summary_on_synthetic_trace():
+    from paddle_tpu.tools import timeline as tl
+
+    trace = {"traceEvents": [
+        {"name": "step", "ph": "B", "ts": 0, "pid": 1, "tid": 1},
+        {"name": "op", "ph": "B", "ts": 100, "pid": 1, "tid": 1},
+        {"name": "op", "ph": "E", "ts": 600, "pid": 1, "tid": 1},
+        {"name": "step", "ph": "E", "ts": 1000, "pid": 1, "tid": 1},
+        {"name": "op", "ph": "X", "ts": 0, "dur": 2000, "pid": 1, "tid": 2},
+        {"name": "stray_end", "ph": "E", "ts": 5, "pid": 9, "tid": 9},
+    ]}
+    stats = tl.summarize(trace)
+    assert stats["step"] == {"count": 1, "total_ms": 1.0,
+                             "avg_ms": 1.0, "max_ms": 1.0}
+    assert stats["op"]["count"] == 2
+    assert stats["op"]["total_ms"] == pytest.approx(2.5)
+    assert stats["op"]["max_ms"] == pytest.approx(2.0)
+    assert "stray_end" not in stats
+    table = tl.format_summary(stats)
+    assert table.splitlines()[1].startswith("op")  # sorted by total desc
+
+
+def test_timeline_merge_remaps_pids(tmp_path):
+    from paddle_tpu.tools import timeline as tl
+
+    a = {"traceEvents": [
+        {"name": "x", "ph": "X", "ts": 0, "dur": 10, "pid": 7, "tid": 1}]}
+    b = {"traceEvents": [
+        {"name": "y", "ph": "X", "ts": 0, "dur": 20, "pid": 7, "tid": 1}]}
+    merged = tl.merge_traces([a, b], names=["host", "device"])
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert xs[0]["pid"] != xs[1]["pid"]  # same source pid, separate tracks
+    pnames = {e["pid"]: e["args"]["name"]
+              for e in merged["traceEvents"]
+              if e.get("name") == "process_name"}
+    assert any("host" in v for v in pnames.values())
+    assert any("device" in v for v in pnames.values())
+
+
+def test_timeline_cli_merge_and_summary(tmp_path, capsys):
+    from paddle_tpu.tools import timeline as tl
+
+    with obs.trace_span("cli_span"):
+        pass
+    p1 = str(tmp_path / "a.json")
+    obs.get_tracer().export_chrome_trace(p1)
+    p2 = str(tmp_path / "b.json")
+    with open(p2, "w") as f:
+        json.dump({"traceEvents": [{"name": "dev", "ph": "X", "ts": 0,
+                                    "dur": 50, "pid": 0, "tid": 0}]}, f)
+    out = str(tmp_path / "merged.json")
+    tl.main([p1, p2, "--out", out, "--summary"])
+    printed = capsys.readouterr().out
+    assert "cli_span" in printed and "dev" in printed
+    with open(out) as f:
+        merged = json.load(f)
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert {"cli_span", "dev"} <= names
+
+
+# -- executor instrumentation ---------------------------------------------
+
+def _tiny_program():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3])
+        y = fluid.layers.fc(x, 2)
+    return main, startup, y
+
+
+def test_executor_cache_and_compile_metrics():
+    import paddle_tpu as fluid
+
+    reg = obs.get_registry()
+    hits0 = reg.counter("executor/cache_hits").value
+    miss0 = reg.counter("executor/cache_misses").value
+    exec0 = reg.histogram("executor/execute_ms").count
+
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    feed = {"x": np.zeros((2, 3), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[y])   # compile
+    exe.run(main, feed=feed, fetch_list=[y])   # hit
+    exe.run(main, feed=feed, fetch_list=[y])   # hit
+
+    assert reg.counter("executor/cache_misses").value - miss0 == 2  # startup+main
+    assert reg.counter("executor/cache_hits").value - hits0 == 2
+    assert reg.histogram("executor/execute_ms").count - exec0 == 2
+    snap = reg.snapshot()
+    compile_keys = [k for k in snap if k.startswith("executor/compile_ms")]
+    assert compile_keys, "per-signature compile histograms missing"
+    # the span tracer saw the runs too
+    names = [e["name"] for e in
+             _span_events(obs.get_tracer().export_chrome_trace())]
+    assert "executor/compile+run" in names and "executor/run" in names
+
+
+def test_record_event_routes_to_host_tracer():
+    from paddle_tpu import profiler
+
+    with profiler.record_event("annotated/region", tag=3):
+        pass
+    evs = _span_events(obs.get_tracer().export_chrome_trace())
+    assert [e["ph"] for e in evs if e["name"] == "annotated/region"] \
+        == ["B", "E"]
+
+
+# -- recompile watchdog ----------------------------------------------------
+
+def test_watchdog_diagnoses_shape_changing_feed():
+    import paddle_tpu as fluid
+
+    wd = obs.get_watchdog()
+    old_threshold = wd.threshold
+    wd.threshold = 3
+    try:
+        main, startup, y = _tiny_program()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        with pytest.warns(obs.RecompileWarning) as rec:
+            for n in range(1, 7):  # a new batch size every step
+                exe.run(main, feed={"x": np.zeros((n, 3), np.float32)},
+                        fetch_list=[y])
+        warns = [w for w in rec if issubclass(w.category,
+                                              obs.RecompileWarning)]
+        assert len(warns) == 1, "warning must fire exactly once"
+        msg = str(warns[0].message)
+        assert "'x'" in msg                      # names the diverging feed
+        assert "shape" in msg and "->" in msg    # says what changed
+        assert "recompiled 4 times" in msg       # past threshold 3
+    finally:
+        wd.threshold = old_threshold
+
+
+def test_watchdog_silent_on_steady_shapes():
+    import warnings as _warnings
+
+    import paddle_tpu as fluid
+
+    wd = obs.get_watchdog()
+    old_threshold = wd.threshold
+    wd.threshold = 1  # as twitchy as possible: any recompile would warn
+    try:
+        main, startup, y = _tiny_program()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        reg = obs.get_registry()
+        hits0 = reg.counter("executor/cache_hits").value
+        feed = {"x": np.zeros((4, 3), np.float32)}
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error", obs.RecompileWarning)
+            for _ in range(6):  # steady shape: one compile, then hits
+                exe.run(main, feed=feed, fetch_list=[y])
+        assert reg.counter("executor/cache_hits").value - hits0 == 5
+    finally:
+        wd.threshold = old_threshold
+
+
+def test_watchdog_diff_signatures_names_added_removed_changed():
+    prev = (("a", (2, 3), "float32"), ("b", (4,), "int32"))
+    new = (("a", (5, 3), "float32"), ("c", (1,), "float32"))
+    diffs = obs.diff_signatures(prev, new)
+    text = " | ".join(diffs)
+    assert "'a' changed shape (2, 3) -> (5, 3)" in text
+    assert "'b' removed" in text
+    assert "'c' added" in text
+
+
+def test_watchdog_dtype_change_reported():
+    wd = obs.RecompileWatchdog(threshold=1)
+    key = ("prog",)
+    wd.record_compile(key, (("x", (2,), "float32"),))
+    with pytest.warns(obs.RecompileWarning, match=r"dtype float32 -> int32"):
+        wd.record_compile(key, (("x", (2,), "int32"),))
+
+
+# -- profiler guards (satellite) ------------------------------------------
+
+def test_stop_profiler_without_start_raises_clear_error():
+    from paddle_tpu import profiler
+
+    with pytest.raises(RuntimeError, match="matching start_profiler"):
+        profiler.stop_profiler()
+
+
+def test_nested_profiler_rejected_with_clear_error(monkeypatch, tmp_path):
+    from paddle_tpu import profiler
+
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(profiler.jax.profiler, "start_trace",
+                        lambda d: calls.__setitem__("start",
+                                                    calls["start"] + 1))
+    monkeypatch.setattr(profiler.jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    d = str(tmp_path / "prof")
+    with profiler.profiler(profile_path=d):
+        with pytest.raises(RuntimeError, match="already active"):
+            profiler.start_profiler(log_dir=str(tmp_path / "nested"))
+    assert calls == {"start": 1, "stop": 1}
+    # the session closed cleanly: a fresh one can start
+    with profiler.profiler(profile_path=d):
+        pass
+    assert calls == {"start": 2, "stop": 2}
+
+
+# -- serving integration ---------------------------------------------------
+
+IN_DIM = 5
+
+
+@pytest.fixture(scope="module")
+def predictor(tmp_path_factory):
+    import paddle_tpu as fluid
+    from paddle_tpu import inference
+    from paddle_tpu.core import program as prog_mod
+
+    old = prog_mod._main_program, prog_mod._startup_program
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [IN_DIM])
+            out = fluid.layers.fc(x, 3, act="softmax")
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        model_dir = str(tmp_path_factory.mktemp("obs") / "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+        return inference.create_predictor(inference.Config(model_dir))
+    finally:
+        prog_mod._main_program, prog_mod._startup_program = old
+
+
+def test_server_stats_unifies_serving_and_executor_metrics(predictor):
+    """THE acceptance property: one export holds executor cache/compile
+    metrics and serving latency together."""
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=(2, 4),
+                                     max_batch_delay_ms=1.0)
+    with server:
+        for i in range(4):
+            server.infer({"x": np.random.RandomState(i)
+                          .rand(2, IN_DIM).astype(np.float32)})
+    stats = server.stats()
+    assert stats["serving/requests"] >= 4
+    assert stats["serving/latency_ms"]["count"] >= 4
+    assert "executor/cache_hits" in stats
+    assert "executor/cache_misses" in stats
+    assert any(k.startswith("executor/compile_ms") for k in stats)
+    # per-server view still isolated
+    assert server.metrics.snapshot()["serving/requests"] == 4
+    # and the global prometheus export renders the serving metrics too
+    text = obs.get_registry().prometheus_text()
+    assert "serving_requests" in text and "executor_cache_misses" in text
+
+
+def test_serving_dispatch_spans_in_chrome_trace(predictor):
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=(2, 4),
+                                     max_batch_delay_ms=1.0)
+    with server:
+        server.infer({"x": np.zeros((2, IN_DIM), np.float32)})
+    evs = _span_events(obs.get_tracer().export_chrome_trace())
+    dispatch = [e for e in evs if e["name"].startswith("serving/dispatch_b")]
+    assert dispatch and dispatch[0]["args"]["rows"] == 2
+
+
+def test_serving_bench_dumps_metrics_and_trace(tmp_path):
+    from paddle_tpu.core import program as prog_mod
+    from paddle_tpu.tools import serving_bench as sb
+
+    mpath = str(tmp_path / "m.json")
+    tpath = str(tmp_path / "t.json")
+    old = prog_mod._main_program, prog_mod._startup_program
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    try:
+        rc = sb.main(["--requests", "8", "--concurrency", "4",
+                      "--buckets", "2,4", "--batch-delay-ms", "1",
+                      "--in-dim", "6", "--hidden", "8", "--layers", "1",
+                      "--skip-sequential",
+                      "--metrics-out", mpath, "--trace-out", tpath])
+    finally:
+        prog_mod._main_program, prog_mod._startup_program = old
+    assert rc == 0
+    with open(mpath) as f:
+        loaded = json.load(f)
+    assert "executor/cache_misses" in loaded
+    assert loaded["serving/requests"] >= 8
+    assert loaded["bench/served"]["requests"] == 8
+    with open(tpath) as f:
+        trace = json.load(f)
+    assert any(e.get("name", "").startswith("serving/dispatch")
+               for e in trace["traceEvents"])
