@@ -728,6 +728,16 @@ def main():
             "median": dy.get("uncached_ms"),
             "iqr": dy.get("uncached_iqr_ms")}
 
+    # async input pipeline (dataio.DeviceLoader + FetchHandle): sync vs
+    # prefetch+in-flight steps/s with a slow reader (host cost ~50% of
+    # the synchronous step); outputs_identical doubles as the handle-path
+    # bitwise-equivalence check
+    try:
+        from paddle_tpu.tools.pipeline_bench import run_pipeline_bench
+        extras2["input_pipeline"] = run_pipeline_bench()
+    except Exception as e:  # pragma: no cover
+        extras2["input_pipeline"] = {"error": str(e)[:120]}
+
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms
     extras2["nmt_big_mfu"] = nmt_mfu

@@ -24,6 +24,8 @@ from . import clip  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import contrib  # noqa: F401
+from . import dataio  # noqa: F401
+from .dataio import DeviceLoader, FetchHandle  # noqa: F401
 from . import debugger  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import io  # noqa: F401

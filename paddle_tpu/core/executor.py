@@ -29,10 +29,12 @@ from . import registry
 from .program import Block, Program, Variable, default_main_program, grad_var_name
 from .scope import Scope, _scope, global_scope
 
+from ..dataio.handle import FetchHandle
 from ..observability.registry import get_registry
 from ..observability.tracer import trace_span
 from ..observability.watchdog import get_watchdog
 
+import collections
 import time
 import weakref
 
@@ -48,7 +50,81 @@ _EXECUTE_MS = _OBS.histogram("executor/execute_ms")
 _UPDATE_FLUSHES = _OBS.counter("executor/update_flushes")
 _FUSED_GROUPS = _OBS.counter("executor/fused_update_groups")
 _FUSED_OPS = _OBS.counter("executor/fused_update_ops")
+_INFLIGHT = _OBS.gauge("executor/inflight_steps")
 _WATCHDOG = get_watchdog()
+
+
+# -- persistent compilation cache -------------------------------------------
+_COMPILE_CACHE_ENABLED = [False]
+
+
+def _maybe_enable_compile_cache(cache_dir: Optional[str] = None) -> bool:
+    """Enable jax's on-disk compilation cache once per process when
+    ``compile_cache_dir`` (env: PDTPU_COMPILE_CACHE_DIR) is set — warm
+    process restarts then deserialize XLA executables instead of
+    recompiling. The entry count at enable time lands in the registry so
+    exports distinguish cold (0 entries) from warm starts."""
+    if _COMPILE_CACHE_ENABLED[0]:
+        return True
+    from ..flags import flag
+    d = cache_dir or flag("compile_cache_dir")
+    if not d:
+        return False
+    import os
+    os.makedirs(d, exist_ok=True)
+    entries = sum(1 for f in os.listdir(d) if not f.startswith("."))
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+    except Exception:  # jaxlib without persistent-cache support
+        return False
+    # default thresholds skip small/fast compiles — exactly the programs
+    # a restarted trainer recompiles most often; cache everything
+    for k, v in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                 ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(k, v)
+        except Exception:
+            pass
+    _COMPILE_CACHE_ENABLED[0] = True
+    _OBS.gauge("executor/compile_cache_enabled").set(1)
+    _OBS.gauge("executor/compile_cache_entries_at_start").set(entries)
+    return True
+
+
+# -- FLAGS_check_nan_inf device-side probe ----------------------------------
+_FINITE_PROBE = None
+
+
+def _check_finite(named_vals) -> None:
+    """FLAGS_check_nan_inf parity (operator.cc:949) without the per-step
+    host materialization of every state var: ONE jitted all-finite
+    reduction runs on device and only its scalar verdict crosses to host;
+    names/values are pulled only when it trips."""
+    global _FINITE_PROBE
+    floats = [(n, v) for n, v in named_vals
+              if jnp.issubdtype(getattr(v, "dtype", np.asarray(v).dtype),
+                                jnp.floating)]
+    if not floats:
+        return
+    if _FINITE_PROBE is None:
+        @jax.jit
+        def _probe(vals):
+            ok = jnp.bool_(True)
+            for v in vals:
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v)))
+            return ok
+        _FINITE_PROBE = _probe
+    if bool(_FINITE_PROBE([v for _, v in floats])):
+        return
+    for n, v in floats:  # slow path: find and name the offender(s)
+        a = np.asarray(v)
+        if not np.isfinite(a).all():
+            raise FloatingPointError(
+                f"NaN/Inf detected in variable {n!r} "
+                f"(FLAGS_check_nan_inf is on)")
+    raise FloatingPointError(
+        "NaN/Inf detected (FLAGS_check_nan_inf is on) but no single "
+        "variable reproduced it on host readback")
 
 
 def _sig_digest(feed_sig) -> str:
@@ -812,6 +888,10 @@ class Executor:
         self.place = place or TPUPlace()
         self._cache = {}
         self._state_names_cache = None
+        # DeviceLoaders this executor spun up (train_from_dataset); weak so
+        # a finished loop's loader can die without waiting for close()
+        self._loaders: "weakref.WeakSet" = weakref.WeakSet()
+        _maybe_enable_compile_cache()
 
     # -- lowering ----------------------------------------------------------
     def _state_names(self, program: Program, scope: Scope) -> List[str]:
@@ -871,17 +951,29 @@ class Executor:
         fetch_list: Optional[Sequence] = None,
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
+        return_handle: bool = False,
     ):
-        """Run `program`: feed → execute → fetch (reference executor.py:539)."""
+        """Run `program`: feed → execute → fetch (reference executor.py:539).
+
+        return_handle=True: skip the fetch materialization entirely and
+        return a :class:`FetchHandle` over the still-computing jax arrays
+        — jax's async dispatch keeps the device busy while the host
+        prepares the next step; `.numpy()` on the handle is the sync
+        point. Results are bitwise-identical to return_numpy=True."""
         from .compiler import CompiledProgram
 
         if isinstance(program, CompiledProgram):
-            out = program._run(self, feed, fetch_list, scope, return_numpy)
+            out = program._run(self, feed, fetch_list, scope,
+                               return_numpy and not return_handle)
             # maintenance epilogues must fire under the mesh too — the
             # deferred-row fold is cadence-critical (the append log
             # overflows silently if it never runs)
             self._advance_epilogues(program._program, scope or _scope(), 1,
                                     compiled=program)
+            if return_handle:
+                names = [f.name if isinstance(f, Variable) else f
+                         for f in (fetch_list or [])]
+                return FetchHandle(names, out)
             return out
         program = program or default_main_program()
         feed = feed or {}
@@ -950,15 +1042,23 @@ class Executor:
 
         from ..flags import flag
         if flag("check_nan_inf"):
-            # FLAGS_check_nan_inf parity (operator.cc:949): validate every
-            # fetched value and updated state var, naming the offender
-            for n, v in list(zip(fetch_names, fetches)) + list(new_state.items()):
-                a = np.asarray(v)
-                if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
-                    raise FloatingPointError(
-                        f"NaN/Inf detected in variable {n!r} "
-                        f"(FLAGS_check_nan_inf is on)")
+            # validate every fetched value and updated state var on
+            # device; the host pays one scalar readback unless it trips
+            _check_finite(list(zip(fetch_names, fetches))
+                          + list(new_state.items()))
 
+        if return_handle:
+            # fetch-less steps still need something to block on for
+            # in-flight bounding. Don't hold a new-state leaf directly:
+            # the NEXT step donates those buffers, which would invalidate
+            # the probe. A tiny dependent slice dispatched now lives in
+            # its own buffer and completes only after this step does.
+            probe = None
+            if not fetches:
+                leaf = next(iter(new_state.values()), None)
+                if leaf is not None:
+                    probe = jnp.ravel(leaf)[:1]
+            return FetchHandle(fetch_names, fetches, probe=probe)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
@@ -1171,27 +1271,66 @@ class Executor:
                            fetch_list=None, fetch_info=None, print_period: int = 100):
         """Dataset-driven training loop (reference executor.py:894 →
         Executor::RunFromDataset → MultiTrainer N-thread hot loop,
-        hogwild_worker.cc:163). TPU-native: the native C++ loader threads do
-        IO/parsing; the device runs one jitted step per batch — XLA's async
-        dispatch overlaps H2D with compute (buffered_reader.cc role)."""
+        hogwild_worker.cc:163). TPU-native, fully pipelined: a
+        DeviceLoader worker converts and device_puts batch N+1 while the
+        device runs step N (buffered_reader.cc role), and up to
+        ``max_inflight_steps`` (flags.py; env PDTPU_MAX_INFLIGHT_STEPS,
+        default 2) dispatches stay un-synced so jax's async dispatch
+        queues compute behind host work instead of serializing on a
+        per-step fetch."""
         program = program or default_main_program()
         fetch_list = list(fetch_list or [])
         if dataset is None:
             raise ValueError("dataset is required")
         if thread:
             dataset.set_thread(thread)
+        from ..dataio.loader import DeviceLoader
+        from ..flags import flag
+
+        max_inflight = max(1, int(flag("max_inflight_steps")))
+        block = program.global_block()
+        names = fetch_info or [getattr(f, "name", str(f))
+                               for f in fetch_list]
+
+        def batches():
+            for batch in dataset.batches():
+                yield {k: v for k, v in batch.items()
+                       if block._find_var_recursive(k) is not None}
+
+        inflight: "collections.deque" = collections.deque()
+
+        def retire(entry):
+            step_i, handle = entry
+            if debug and fetch_list and step_i % print_period == 0:
+                vals = handle.numpy()
+                print(f"step {step_i}: " + ", ".join(
+                    f"{n}={np.asarray(v).mean():.6f}"
+                    for n, v in zip(names, vals)))
+            else:
+                handle.block_until_ready()
+
+        loader = DeviceLoader(batches, capacity=max(2, max_inflight),
+                              program=program, name="train_from_dataset")
+        self._loaders.add(loader)
         step = 0
         last = None
-        for batch in dataset.batches():
-            feed = {k: v for k, v in batch.items()
-                    if program.global_block()._find_var_recursive(k) is not None}
-            last = self.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
-            if debug and fetch_list and step % print_period == 0:
-                names = fetch_info or [getattr(f, "name", str(f)) for f in fetch_list]
-                print(f"step {step}: " + ", ".join(
-                    f"{n}={np.asarray(v).mean():.6f}" for n, v in zip(names, last)))
-            step += 1
-        return last
+        try:
+            for feed in loader:
+                last = self.run(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope, return_handle=True)
+                inflight.append((step, last))
+                _INFLIGHT.set(len(inflight))
+                while len(inflight) > max_inflight:
+                    retire(inflight.popleft())
+                    _INFLIGHT.set(len(inflight))
+                step += 1
+            while inflight:
+                retire(inflight.popleft())
+                _INFLIGHT.set(len(inflight))
+        finally:
+            _INFLIGHT.set(0)
+            loader.close()
+        return last.numpy() if last is not None else None
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
@@ -1202,4 +1341,8 @@ class Executor:
                                        fetch_list, fetch_info, print_period)
 
     def close(self):
+        # tear down any prefetch workers this executor spun up (they hold
+        # queued device batches) before dropping the executable cache
+        for ld in list(self._loaders):
+            ld.close()
         self._cache.clear()

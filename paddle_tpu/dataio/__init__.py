@@ -1,0 +1,28 @@
+"""paddle_tpu.dataio — asynchronous host→device input pipeline.
+
+The reference framework kept the accelerator fed with a C++ double-
+buffered reader (``buffered_reader.cc`` behind
+``PyReader(use_double_buffer=True)``) and fetched through blocking
+device→host copies. This package is that capability in the XLA idiom:
+
+- **DeviceLoader** (loader.py) — a background worker that pulls batches
+  from any reader, runs feed validation/conversion and
+  ``jax.device_put`` into a bounded queue, so H2D transfer and host-side
+  batch prep overlap the running step. ``PyReader(use_double_buffer=
+  True)`` and ``Executor.train_from_dataset`` ride on it.
+- **FetchHandle** (handle.py) — un-materialized fetch results from
+  ``Executor.run(..., return_handle=True)``: jax's async dispatch keeps
+  computing while the host moves on; ``.numpy()`` is the explicit sync
+  point.
+
+Together they pipeline: step N computes on device while the loader
+converts/transfers batch N+1 and the trainer holds up to
+``PDTPU_MAX_INFLIGHT_STEPS`` un-synced dispatches. The overlap is
+visible in the observability exports (``dataio/prefetch_queue_depth``,
+``dataio/h2d_ms``, ``executor/fetch_wait_ms``,
+``executor/inflight_steps``).
+"""
+from .handle import FetchHandle  # noqa: F401
+from .loader import DeviceLoader, close_all_loaders  # noqa: F401
+
+__all__ = ["DeviceLoader", "FetchHandle", "close_all_loaders"]

@@ -1,0 +1,108 @@
+"""FetchHandle: un-materialized fetch results from a dispatched step.
+
+Reference analog: the reference executor's fetch path copied every fetch
+var to host inside Run (executor.cc:431 GetFetchVariable) — the Python
+caller always paid a device sync per step. On TPU the step is dispatched
+asynchronously by XLA; forcing `np.asarray` per fetch re-serializes host
+and device. A FetchHandle keeps the fetches as live jax arrays (device
+futures) so the caller decides WHEN to sync: touch nothing and the next
+step's host work (feed conversion, logging, checkpoint bookkeeping)
+overlaps device compute; call `.numpy()` when the values are actually
+needed.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..observability.registry import get_registry
+
+_FETCH_WAIT_MS = get_registry().histogram("executor/fetch_wait_ms")
+
+__all__ = ["FetchHandle"]
+
+
+class FetchHandle:
+    """Holds one step's fetch arrays un-materialized.
+
+    `names` aligns with `values` (the program's fetch_list order).
+    `probe` is an optional extra device array from the same dispatch
+    (e.g. one new-state leaf) so a fetch-less step still has something
+    to block on for in-flight bounding.
+    """
+
+    __slots__ = ("names", "_values", "_probe", "_numpy")
+
+    def __init__(self, names: Sequence[str], values: Sequence,
+                 probe=None):
+        self.names = list(names)
+        self._values = list(values)
+        self._probe = probe
+        self._numpy: Optional[List[np.ndarray]] = None
+
+    # -- sync points -------------------------------------------------------
+    def numpy(self) -> List[np.ndarray]:
+        """Materialize every fetch on host (the sync point). Cached: the
+        wait is paid once, repeat calls return the same arrays."""
+        if self._numpy is None:
+            import time
+            t0 = time.perf_counter()
+            self._numpy = [np.asarray(v) for v in self._values]
+            _FETCH_WAIT_MS.observe((time.perf_counter() - t0) * 1e3)
+        return self._numpy
+
+    def jax(self) -> list:
+        """The raw (possibly still-computing) jax arrays — no sync."""
+        return list(self._values)
+
+    def block_until_ready(self) -> "FetchHandle":
+        """Wait for the dispatch to finish WITHOUT copying to host
+        (bounds in-flight depth; cheaper than `.numpy()` for large
+        fetches)."""
+        import time
+        t0 = time.perf_counter()
+        vals = list(self._values)
+        if self._probe is not None:
+            vals.append(self._probe)
+        for v in vals:
+            if not hasattr(v, "block_until_ready"):
+                continue
+            # a buffer donated to a later step was, by construction,
+            # already consumed — nothing left to wait for
+            if getattr(v, "is_deleted", lambda: False)():
+                continue
+            try:
+                v.block_until_ready()
+            except RuntimeError as e:  # deleted between check and block
+                if "deleted" not in str(e) and "donated" not in str(e):
+                    raise
+        _FETCH_WAIT_MS.observe((time.perf_counter() - t0) * 1e3)
+        return self
+
+    def is_ready(self) -> bool:
+        """True when every fetch has finished computing (no sync)."""
+        vals = list(self._values)
+        if self._probe is not None:
+            vals.append(self._probe)
+        for v in vals:
+            f = getattr(v, "is_ready", None)
+            if callable(f) and not f():
+                return False
+        return True
+
+    # -- container protocol (materializing) --------------------------------
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __iter__(self):
+        return iter(self.numpy())
+
+    def __getitem__(self, i):
+        return self.numpy()[i]
+
+    def __repr__(self):
+        state = "materialized" if self._numpy is not None else "pending"
+        return (f"FetchHandle({len(self._values)} fetches "
+                f"[{', '.join(self.names[:4])}"
+                f"{', ...' if len(self.names) > 4 else ''}], {state})")

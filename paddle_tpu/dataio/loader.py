@@ -1,0 +1,256 @@
+"""DeviceLoader: background host→device prefetch over any batch reader.
+
+Reference analog: ``buffered_reader.cc`` — the double-buffered reader that
+``PyReader(use_double_buffer=True)`` promised: a worker thread pulls the
+next batch, converts it, and starts the H2D copy while the device still
+runs the current step. Here the worker does feed validation/conversion
+(`convert_feed_value`) and ``jax.device_put`` into a bounded queue, so by
+the time the training loop asks for batch N+1 it is already a set of live
+device arrays and ``Executor.run`` skips straight to dispatch.
+
+Threading contract:
+- ONE worker per epoch → batch order is exactly reader order;
+- a reader exception is captured and re-raised in the CONSUMER at the
+  point of the failed batch (never swallowed in the worker);
+- `close()` is idempotent and joins the worker (a mid-epoch `break`
+  through ``close()``/``PyReader.reset()`` leaves no live thread holding
+  device buffers); iterating to exhaustion closes automatically.
+
+Telemetry (process registry): ``dataio/prefetch_queue_depth`` gauge,
+``dataio/h2d_ms`` per-batch conversion+transfer histogram,
+``dataio/batches`` counter.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from queue import Empty, Full, Queue
+from typing import Callable, Dict, Iterable, Optional, Union
+
+from ..observability.registry import get_registry
+
+__all__ = ["DeviceLoader"]
+
+_OBS = get_registry()
+_QUEUE_DEPTH = _OBS.gauge("dataio/prefetch_queue_depth")
+_H2D_MS = _OBS.histogram("dataio/h2d_ms")
+_BATCHES = _OBS.counter("dataio/batches")
+
+# every live loader, so Executor.close() / interpreter teardown can sweep
+# stragglers without owning them
+_LIVE_LOADERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class _EndOfEpoch:
+    pass
+
+
+class _WorkerError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _default_convert(block):
+    """Batch dict → device dict. With a program block, feeds get the same
+    validation + x32 narrowing as a synchronous ``Executor.run`` (so the
+    prefetch path cannot silently accept what the sync path rejects);
+    names the block does not declare pass through as plain device
+    arrays (e.g. '<name>_len' companions)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.executor import convert_feed_value
+
+    def convert(batch: Dict[str, object]) -> Dict[str, object]:
+        out = {}
+        for name, val in batch.items():
+            if block is not None and \
+                    block._find_var_recursive(name) is not None:
+                out[name] = convert_feed_value(block, name, val)
+            else:
+                out[name] = jnp.asarray(val)
+        # device_put is a no-op for arrays already committed to the
+        # device; for host numpy it starts the async H2D copy NOW, on
+        # this worker thread, instead of on the run() critical path
+        return {n: jax.device_put(v) for n, v in out.items()}
+
+    return convert
+
+
+class DeviceLoader:
+    """Prefetch batches from `reader` onto the device via a worker thread.
+
+    reader: a callable returning an iterable of feed dicts (name → array),
+      or a plain iterable (single-epoch). Each ``__iter__`` starts a fresh
+      epoch (and tears down any previous one).
+    capacity: max prefetched device batches. 2 = classic double buffering;
+      more only helps when per-batch host cost is spiky.
+    program: optional Program whose global block provides feed
+      validation/dtype policy (same semantics as Executor.run's feeds).
+    convert: override the batch→device function entirely.
+    """
+
+    def __init__(self, reader: Union[Callable, Iterable], capacity: int = 2,
+                 program=None, convert: Optional[Callable] = None,
+                 name: str = "device_loader"):
+        if capacity < 1:
+            raise ValueError(f"DeviceLoader capacity must be >= 1, "
+                             f"got {capacity}")
+        self._reader = reader
+        self._capacity = int(capacity)
+        self._block = (program.global_block()
+                       if program is not None else None)
+        self._convert = convert
+        self.name = name
+        self._queue: Optional[Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._closed = False
+        _LIVE_LOADERS.add(self)
+
+    # -- epoch lifecycle ---------------------------------------------------
+    def _epoch_iterable(self):
+        r = self._reader
+        return r() if callable(r) else r
+
+    def start(self) -> "DeviceLoader":
+        """Spin up the prefetch worker for a fresh epoch (idempotent when
+        one is already running)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._closed = False
+        q: Queue = Queue(maxsize=self._capacity)
+        stop = threading.Event()
+        convert = self._convert or _default_convert(self._block)
+
+        def worker():
+            try:
+                for batch in self._epoch_iterable():
+                    if stop.is_set():
+                        return
+                    t0 = time.perf_counter()
+                    dev = convert(batch)
+                    _H2D_MS.observe((time.perf_counter() - t0) * 1e3)
+                    # bounded put that stays responsive to close(): a
+                    # plain q.put would deadlock a worker whose consumer
+                    # broke out of the epoch without draining
+                    while not stop.is_set():
+                        try:
+                            q.put(dev, timeout=0.1)
+                            break
+                        except Full:
+                            continue
+                    if stop.is_set():
+                        return
+                    _BATCHES.inc()
+                    _QUEUE_DEPTH.set(q.qsize())
+            except BaseException as e:  # re-raised in the consumer
+                while not stop.is_set():
+                    try:
+                        q.put(_WorkerError(e), timeout=0.1)
+                        return
+                    except Full:
+                        continue
+            finally:
+                while not stop.is_set():
+                    try:
+                        q.put(_EndOfEpoch, timeout=0.1)
+                        break
+                    except Full:
+                        continue
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name=f"pdtpu-{self.name}")
+        self._queue, self._stop, self._thread = q, stop, t
+        t.start()
+        return self
+
+    def __iter__(self):
+        # a fresh epoch per iteration, like calling a decorated reader;
+        # an unfinished previous epoch is torn down first
+        if self._thread is not None and self._thread.is_alive():
+            self.close()
+        self.start()
+        return self._drain()
+
+    def _drain(self):
+        q, stop, thread = self._queue, self._stop, self._thread
+        try:
+            while True:
+                item = q.get()
+                _QUEUE_DEPTH.set(q.qsize())
+                if item is _EndOfEpoch:
+                    return
+                if isinstance(item, _WorkerError):
+                    raise item.exc
+                yield item
+        finally:
+            # normal exhaustion, consumer break, or consumer exception:
+            # the worker must not outlive the iteration
+            stop.set()
+            if thread is not None:
+                thread.join(timeout=5)
+            if self._thread is thread:
+                self._thread = None
+                self._closed = True
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self) -> None:
+        """Tear down the prefetch thread and drop queued device batches.
+        Idempotent; safe from any thread."""
+        if self._closed and (self._thread is None
+                             or not self._thread.is_alive()):
+            return
+        self._closed = True
+        stop, q, t = self._stop, self._queue, self._thread
+        if stop is not None:
+            stop.set()
+        if q is not None:
+            # release a worker blocked on put() and free device buffers
+            while True:
+                try:
+                    q.get_nowait()
+                except Empty:
+                    break
+            _QUEUE_DEPTH.set(0)
+            # wake a consumer blocked in q.get() (close() from another
+            # thread may have drained the worker's own end sentinel)
+            try:
+                q.put_nowait(_EndOfEpoch)
+            except Full:
+                pass
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+        self._queue = None
+
+    def __enter__(self) -> "DeviceLoader":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def __del__(self):  # best-effort: a dropped loader stops its worker
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+
+def close_all_loaders() -> int:
+    """Close every live DeviceLoader (Executor.close / test teardown
+    sweep). Returns how many were still running."""
+    n = 0
+    for ld in list(_LIVE_LOADERS):
+        if ld.running:
+            n += 1
+        ld.close()
+    return n

@@ -15,6 +15,14 @@ _FLAGS: Dict[str, Any] = {
     "check_nan_inf": False,
     # matmul precision: 'default' (bf16 on MXU) | 'float32' | 'highest'
     "matmul_precision": "default",
+    # async input pipeline: max un-synced Executor.run dispatches
+    # train_from_dataset keeps in flight (1 = sync every step; 2 = classic
+    # double buffering — host prepares N+1 while the device runs N)
+    "max_inflight_steps": 2,
+    # non-empty: enable jax's persistent on-disk compilation cache at
+    # first Executor construction (warm process restarts skip XLA
+    # compiles; see executor._maybe_enable_compile_cache)
+    "compile_cache_dir": "",
     # inert reference-compat knobs
     "fraction_of_gpu_memory_to_use": 0.92,
     "allocator_strategy": "auto_growth",
@@ -53,22 +61,31 @@ def flag(key: str):
     return _FLAGS[key]
 
 
+# runtime knobs also honored under their PDTPU_* spelling (the env names
+# documented alongside PDTPU_FUSE_UPDATES / PDTPU_REMAT_OPS)
+_ENV_ALIASES = {
+    "PDTPU_MAX_INFLIGHT_STEPS": "max_inflight_steps",
+    "PDTPU_COMPILE_CACHE_DIR": "compile_cache_dir",
+}
+
+
+def _coerce(key: str, v: str):
+    cur = _FLAGS[key]
+    if isinstance(cur, bool):
+        return v.lower() in ("1", "true", "yes")
+    if isinstance(cur, float):
+        return float(v)
+    if isinstance(cur, int):
+        return int(v)
+    return v
+
+
 def _bootstrap_from_env():
     for k, v in os.environ.items():
-        if not k.startswith("FLAGS_"):
+        key = _ENV_ALIASES.get(k) if not k.startswith("FLAGS_") else k[6:]
+        if key is None or key not in _FLAGS:
             continue
-        key = k[6:]
-        if key not in _FLAGS:
-            continue
-        cur = _FLAGS[key]
-        if isinstance(cur, bool):
-            _FLAGS[key] = v.lower() in ("1", "true", "yes")
-        elif isinstance(cur, float):
-            _FLAGS[key] = float(v)
-        elif isinstance(cur, int):
-            _FLAGS[key] = int(v)
-        else:
-            _FLAGS[key] = v
+        _FLAGS[key] = _coerce(key, v)
 
 
 _bootstrap_from_env()

@@ -1268,22 +1268,33 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
               use_double_buffer=True):
     """layers/io.py py_reader: returns a PyReader-compatible object (the
     reader variable of the reference maps to the host-side PyReader here;
-    XLA async dispatch is the double buffer)."""
+    use_double_buffer engages the dataio.DeviceLoader prefetch stage)."""
     from ..reader import PyReader
     return PyReader(feed_list=None, capacity=capacity, shapes=shapes,
-                    dtypes=dtypes)
+                    dtypes=dtypes, name=name,
+                    use_double_buffer=use_double_buffer)
 
 
 def create_py_reader_by_data(capacity, feed_list, name=None,
                              use_double_buffer=True):
     from ..reader import PyReader
-    return PyReader(feed_list=feed_list, capacity=capacity)
+    return PyReader(feed_list=feed_list, capacity=capacity, name=name,
+                    use_double_buffer=use_double_buffer)
 
 
 def double_buffer(reader, place=None, name=None):
-    """buffered_reader.cc role: XLA's async dispatch already overlaps H2D
-    with compute — identity, kept for API parity."""
-    return reader
+    """buffered_reader.cc parity: wrap a batch reader so conversion +
+    device_put of the next batch run on a dataio.DeviceLoader worker
+    while the current step computes. Returns a reader callable; each
+    call is one prefetched epoch."""
+    from ..dataio import DeviceLoader
+
+    def double_buffered():
+        loader = DeviceLoader(reader, capacity=2,
+                              name=name or "double_buffer")
+        yield from loader
+
+    return double_buffered
 
 
 def read_file(reader):

@@ -185,14 +185,27 @@ class PyReader:
     with iterable=True (the only TPU mode): `for data in reader(): exe.run(
     feed=data, ...)`. Decorate with sample/batch generators like the
     reference.
+
+    use_double_buffer=True (the reference default, buffered_reader.cc) is
+    REAL here: batches are converted and ``jax.device_put`` on a
+    background :class:`~paddle_tpu.dataio.DeviceLoader` thread, so the
+    H2D transfer of batch N+1 overlaps step N. use_double_buffer=False
+    keeps the host-side `buffered` prefetch only (batches stay numpy).
     """
 
     def __init__(self, feed_list=None, capacity: int = 64, use_double_buffer=True,
-                 iterable: bool = True):
+                 iterable: bool = True, shapes=None, dtypes=None,
+                 lod_levels=None, name=None):
         self._feed_names = [v.name for v in (feed_list or [])]
         self._capacity = capacity
+        self._use_double_buffer = bool(use_double_buffer)
+        # shapes/dtypes: the layers.py_reader construction form — feed
+        # names come from the decorated generator's dicts (or slot order)
+        self._shapes = shapes
+        self._dtypes = dtypes
         self._batch_reader = None
         self._places = None
+        self._loader = None  # active DeviceLoader (double-buffer mode)
 
     def decorate_sample_list_generator(self, reader, places=None):
         from .data_feeder import pad_batch_column
@@ -222,17 +235,40 @@ class PyReader:
         self._batch_reader = gen
 
     def __call__(self):
-        return buffered(self._batch_reader, self._capacity)()
+        if self._batch_reader is None:
+            raise RuntimeError(
+                "PyReader: decorate_sample_list_generator / "
+                "decorate_batch_generator must be called before iterating")
+        if not self._use_double_buffer:
+            return buffered(self._batch_reader, self._capacity)()
+        # double-buffer mode: host prefetch (capacity) feeds a device
+        # prefetch stage (the classic 2-deep double buffer) — batches
+        # arrive as live device arrays, Executor.run skips conversion
+        from .dataio import DeviceLoader
+        self.reset()
+        self._loader = DeviceLoader(
+            buffered(self._batch_reader, self._capacity),
+            capacity=2, name="py_reader")
+        return iter(self._loader)
 
     def __iter__(self):
-        return iter(self())
+        return self()
 
-    # start/reset kept for non-iterable API compat
     def start(self):
-        pass
+        """Non-iterable API compat: spin up the prefetch pipeline now
+        (iterable mode does this lazily on iteration)."""
+        if self._use_double_buffer and self._batch_reader is not None:
+            if self._loader is None or not self._loader.running:
+                self()
 
     def reset(self):
-        pass
+        """Tear down the active prefetch thread/queue. A mid-epoch
+        ``break`` otherwise leaks a worker still holding device buffers
+        (reference PyReader.reset drained its blocking queue the same
+        way)."""
+        if self._loader is not None:
+            self._loader.close()
+            self._loader = None
 
 
 def bucket_by_sequence_length(reader, bucket_boundaries, batch_sizes,

@@ -1,0 +1,393 @@
+"""Async input pipeline: DeviceLoader prefetch, FetchHandle fetches,
+in-flight train_from_dataset, PyReader double buffering, and the
+device-side FLAGS_check_nan_inf path."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as fluid
+from paddle_tpu.dataio import DeviceLoader, FetchHandle
+
+
+def _batches(n, batch=2, dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(batch, dim).astype("float32")} for _ in range(n)]
+
+
+def _no_loader_threads():
+    return [t for t in threading.enumerate() if t.name.startswith("pdtpu-")]
+
+
+def _build_sgd(dim=4):
+    x = fluid.layers.data("x", [dim])
+    h = fluid.layers.fc(x, 8, act="relu")
+    loss = fluid.layers.mean(fluid.layers.fc(h, 3))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# DeviceLoader
+# ---------------------------------------------------------------------------
+
+class TestDeviceLoader:
+    def test_prefetch_preserves_order(self):
+        data = [{"x": np.full((2, 4), i, "float32")} for i in range(20)]
+
+        def jittery():
+            rng = np.random.RandomState(3)
+            for b in data:
+                time.sleep(float(rng.uniform(0, 0.002)))
+                yield b
+
+        got = [float(np.asarray(b["x"]).mean())
+               for b in DeviceLoader(jittery, capacity=3)]
+        assert got == [float(i) for i in range(20)]
+
+    def test_yields_device_arrays(self):
+        loader = DeviceLoader(lambda: iter(_batches(2)), capacity=2)
+        for b in loader:
+            assert isinstance(b["x"], jax.Array)
+
+    def test_reader_exception_reraises_in_consumer(self):
+        def bad():
+            yield {"x": np.zeros((2, 4), "float32")}
+            yield {"x": np.zeros((2, 4), "float32")}
+            raise ValueError("reader blew up")
+
+        loader = DeviceLoader(bad, capacity=2)
+        seen = 0
+        with pytest.raises(ValueError, match="reader blew up"):
+            for _ in loader:
+                seen += 1
+        assert seen == 2
+        assert not loader.running
+        assert _no_loader_threads() == []
+
+    def test_exhaustion_leaves_no_threads(self):
+        list(DeviceLoader(lambda: iter(_batches(5)), capacity=2))
+        assert _no_loader_threads() == []
+
+    def test_midepoch_break_then_close(self):
+        def slow():
+            for b in _batches(100):
+                time.sleep(0.001)
+                yield b
+
+        loader = DeviceLoader(slow, capacity=2)
+        for i, _ in enumerate(loader):
+            if i == 3:
+                break
+        loader.close()
+        loader.close()  # idempotent
+        assert not loader.running
+        assert _no_loader_threads() == []
+
+    def test_reiteration_is_a_fresh_epoch(self):
+        loader = DeviceLoader(lambda: iter(_batches(4)), capacity=2)
+        assert len(list(loader)) == 4
+        assert len(list(loader)) == 4
+
+    def test_close_from_other_thread_unblocks_consumer(self):
+        def endless():
+            i = 0
+            while True:
+                yield {"x": np.full((1,), i, "float32")}
+                i += 1
+
+        loader = DeviceLoader(endless, capacity=2)
+        it = iter(loader)
+        next(it)
+        threading.Timer(0.05, loader.close).start()
+        # consumer either sees end-of-epoch or keeps yielding until the
+        # close lands; it must not hang
+        for _ in it:
+            pass
+        assert not loader.running
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            DeviceLoader(lambda: iter([]), capacity=0)
+
+    def test_feed_validation_applies_in_worker(self):
+        # program-aware conversion: the prefetch path must reject what the
+        # sync path rejects (declared-shape mismatch), in the consumer
+        fluid.layers.data("x", [4])
+        prog = fluid.default_main_program()
+        loader = DeviceLoader(
+            lambda: iter([{"x": np.zeros((2, 5), "float32")}]),
+            capacity=2, program=prog)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            list(loader)
+
+    def test_telemetry_populated(self):
+        from paddle_tpu.observability import get_registry
+        list(DeviceLoader(lambda: iter(_batches(3)), capacity=2))
+        snap = get_registry().snapshot()
+        assert snap["dataio/batches"] >= 3
+        assert snap["dataio/h2d_ms"]["count"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# FetchHandle / Executor.run(return_handle=True)
+# ---------------------------------------------------------------------------
+
+class TestFetchHandle:
+    def test_bitwise_identical_to_sync_run(self):
+        loss = _build_sgd()
+        exe = fluid.Executor(fluid.TPUPlace())
+        feeds = _batches(4)
+
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(fluid.default_startup_program())
+            sync = [exe.run(feed=f, fetch_list=[loss])[0] for f in feeds]
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(fluid.default_startup_program())
+            handles = [exe.run(feed=f, fetch_list=[loss],
+                               return_handle=True) for f in feeds]
+            async_ = [h.numpy()[0] for h in handles]
+        for a, b in zip(sync, async_):
+            assert a.dtype == b.dtype
+            assert np.array_equal(a, b)
+
+    def test_handle_protocol(self):
+        loss = _build_sgd()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(fluid.default_startup_program())
+        h = exe.run(feed=_batches(1)[0], fetch_list=[loss],
+                    return_handle=True)
+        assert isinstance(h, FetchHandle)
+        assert len(h) == 1
+        assert h.names == [loss.name]
+        assert isinstance(h.jax()[0], jax.Array)
+        h.block_until_ready()
+        assert h.is_ready()
+        assert np.array_equal(h[0], h.numpy()[0])
+        assert "materialized" in repr(h)
+
+    def test_fetchless_handle_carries_probe(self):
+        _build_sgd()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(fluid.default_startup_program())
+        h = exe.run(feed=_batches(1)[0], fetch_list=[],
+                    return_handle=True)
+        assert len(h) == 0 and h.numpy() == []
+        h.block_until_ready()  # must not raise: blocks on the state probe
+
+
+# ---------------------------------------------------------------------------
+# train_from_dataset in-flight pipeline
+# ---------------------------------------------------------------------------
+
+class _FakeDataset:
+    """Anything with batches()/set_thread() drives train_from_dataset."""
+
+    def __init__(self, data):
+        self.data = data
+
+    def set_thread(self, n):
+        pass
+
+    def batches(self):
+        for b in self.data:
+            # extra key not declared by the program must be filtered out
+            yield dict(b, junk=np.zeros(3))
+
+
+class TestTrainFromDataset:
+    def test_inflight_2_matches_inflight_1(self):
+        loss = _build_sgd()
+        exe = fluid.Executor(fluid.TPUPlace())
+        data = _batches(7, seed=11)
+
+        def arm(inflight):
+            old = fluid.get_flags("max_inflight_steps")
+            fluid.set_flags({"max_inflight_steps": inflight})
+            try:
+                with fluid.scope_guard(fluid.Scope()):
+                    exe.run(fluid.default_startup_program())
+                    return exe.train_from_dataset(
+                        dataset=_FakeDataset(data), fetch_list=[loss])
+            finally:
+                fluid.set_flags(old)
+
+        a, b = arm(1), arm(2)
+        assert np.array_equal(a[0], b[0])
+        assert _no_loader_threads() == []
+
+    def test_no_fetch_list(self):
+        _build_sgd()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(fluid.default_startup_program())
+        out = exe.train_from_dataset(dataset=_FakeDataset(_batches(3)))
+        assert out == []
+
+    def test_empty_dataset_returns_none(self):
+        _build_sgd()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(fluid.default_startup_program())
+        assert exe.train_from_dataset(dataset=_FakeDataset([])) is None
+
+    def test_executor_close_sweeps_loaders(self):
+        exe = fluid.Executor(fluid.TPUPlace())
+        loader = DeviceLoader(lambda: iter(_batches(50)), capacity=2)
+        loader.start()
+        exe._loaders.add(loader)
+        assert loader.running
+        exe.close()
+        assert not loader.running
+
+
+# ---------------------------------------------------------------------------
+# PyReader double buffering
+# ---------------------------------------------------------------------------
+
+class TestPyReader:
+    def _gen(self, n=5):
+        def gen():
+            for i in range(n):
+                yield [(np.full(4, i, "float32"),) for _ in range(2)]
+        return gen
+
+    def test_double_buffer_yields_device_batches_in_order(self):
+        x = fluid.layers.data("x", [4])
+        r = fluid.PyReader(feed_list=[x], capacity=8, use_double_buffer=True)
+        r.decorate_sample_list_generator(self._gen())
+        vals = []
+        for feed in r():
+            assert isinstance(feed["x"], jax.Array)
+            vals.append(float(np.asarray(feed["x"]).mean()))
+        assert vals == [0.0, 1.0, 2.0, 3.0, 4.0]
+        assert _no_loader_threads() == []
+
+    def test_double_buffer_matches_plain(self):
+        x = fluid.layers.data("x", [4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 3))
+        exe = fluid.Executor(fluid.TPUPlace())
+
+        def arm(db):
+            r = fluid.PyReader(feed_list=[x], capacity=8,
+                               use_double_buffer=db)
+            r.decorate_sample_list_generator(self._gen())
+            with fluid.scope_guard(fluid.Scope()):
+                exe.run(fluid.default_startup_program())
+                return [exe.run(feed=f, fetch_list=[loss])[0] for f in r()]
+
+        for a, b in zip(arm(False), arm(True)):
+            assert np.array_equal(a, b)
+
+    def test_reset_tears_down_prefetch_thread(self):
+        x = fluid.layers.data("x", [4])
+        r = fluid.PyReader(feed_list=[x], capacity=8, use_double_buffer=True)
+        r.decorate_sample_list_generator(self._gen(100))
+        it = r()
+        next(it)
+        assert r._loader is not None and r._loader.running
+        r.reset()
+        r.reset()  # idempotent
+        assert r._loader is None
+        assert _no_loader_threads() == []
+
+    def test_undecorated_reader_raises(self):
+        r = fluid.PyReader(feed_list=[], capacity=4)
+        with pytest.raises(RuntimeError, match="decorate"):
+            r()
+
+    def test_layers_py_reader_constructs(self):
+        # regression: shapes/dtypes kwargs used to raise TypeError
+        r = fluid.layers.py_reader(4, [[4]], ["float32"])
+        assert isinstance(r, fluid.PyReader)
+        r2 = fluid.layers.create_py_reader_by_data(
+            4, [fluid.layers.data("x", [4])])
+        assert r2._feed_names == ["x"]
+
+    def test_layers_double_buffer_prefetches(self):
+        def reader():
+            for b in _batches(3):
+                yield b
+
+        db = fluid.layers.double_buffer(reader)
+        out = list(db())
+        assert len(out) == 3 and isinstance(out[0]["x"], jax.Array)
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_check_nan_inf device-side probe
+# ---------------------------------------------------------------------------
+
+class TestCheckNanInf:
+    def test_nan_feed_raises_with_name(self):
+        x = fluid.layers.data("x", [4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 3))
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(fluid.default_startup_program())
+        fluid.set_flags({"check_nan_inf": True})
+        try:
+            with pytest.raises(FloatingPointError, match="NaN/Inf"):
+                exe.run(feed={"x": np.full((2, 4), np.nan, "float32")},
+                        fetch_list=[loss])
+        finally:
+            fluid.set_flags({"check_nan_inf": False})
+
+    def test_finite_run_passes(self):
+        loss = _build_sgd()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(fluid.default_startup_program())
+        fluid.set_flags({"check_nan_inf": True})
+        try:
+            out = exe.run(feed=_batches(1)[0], fetch_list=[loss])
+            assert np.isfinite(out[0]).all()
+        finally:
+            fluid.set_flags({"check_nan_inf": False})
+
+
+# ---------------------------------------------------------------------------
+# flags / persistent compilation cache
+# ---------------------------------------------------------------------------
+
+class TestFlagsAndCompileCache:
+    def test_env_aliases_bootstrap(self, monkeypatch):
+        from paddle_tpu import flags as flags_mod
+        old = dict(flags_mod._FLAGS)
+        monkeypatch.setenv("PDTPU_MAX_INFLIGHT_STEPS", "4")
+        monkeypatch.setenv("PDTPU_COMPILE_CACHE_DIR", "/tmp/xyz")
+        try:
+            flags_mod._bootstrap_from_env()
+            assert flags_mod.flag("max_inflight_steps") == 4
+            assert flags_mod.flag("compile_cache_dir") == "/tmp/xyz"
+        finally:
+            flags_mod._FLAGS.update(old)
+
+    def test_compile_cache_enable_records_entry_count(self, tmp_path,
+                                                      monkeypatch):
+        from paddle_tpu.core import executor as exe_mod
+        (tmp_path / "entry0").write_bytes(b"x")
+        calls = {}
+        monkeypatch.setattr(jax.config, "update",
+                            lambda k, v: calls.setdefault(k, v))
+        was = exe_mod._COMPILE_CACHE_ENABLED[0]
+        exe_mod._COMPILE_CACHE_ENABLED[0] = False
+        try:
+            assert exe_mod._maybe_enable_compile_cache(str(tmp_path))
+            assert calls["jax_compilation_cache_dir"] == str(tmp_path)
+            from paddle_tpu.observability import get_registry
+            snap = get_registry().snapshot()
+            assert snap["executor/compile_cache_enabled"] == 1
+            assert snap["executor/compile_cache_entries_at_start"] == 1
+            # and it is once-per-process from here on
+            assert exe_mod._maybe_enable_compile_cache("/elsewhere")
+            assert calls["jax_compilation_cache_dir"] == str(tmp_path)
+        finally:
+            exe_mod._COMPILE_CACHE_ENABLED[0] = was
+
+    def test_disabled_without_flag(self):
+        from paddle_tpu.core import executor as exe_mod
+        was = exe_mod._COMPILE_CACHE_ENABLED[0]
+        exe_mod._COMPILE_CACHE_ENABLED[0] = False
+        try:
+            assert not exe_mod._maybe_enable_compile_cache("")
+        finally:
+            exe_mod._COMPILE_CACHE_ENABLED[0] = was
