@@ -19,6 +19,41 @@ def _peak_flops(on_tpu):
     return 197e12 if on_tpu else 1e12
 
 
+def _device_memory_snapshot():
+    """Allocator stats of device 0, or None on backends without them
+    (CPU). Keys kept small and stable for the bench JSON."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size")
+    return {k: int(stats[k]) for k in keep if k in stats}
+
+
+def _end_section(extras, name):
+    """Section isolation (BENCH_r05: one section's RESOURCE_EXHAUSTED
+    cascaded into every later section): record the allocator state the
+    section ended at, then drop its live buffers and compiled executables
+    so the next section starts from a clean heap. peak_bytes_in_use is
+    cumulative across the process — attribute a spike to the first
+    section whose snapshot shows the jump."""
+    import gc
+
+    import jax
+
+    extras.setdefault("section_memory", {})[name] = _device_memory_snapshot()
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    gc.collect()
+
+
 def _time_steps(exe, prog, feed, loss, iters):
     """Shared measurement protocol: 2 compile/warmup runs, `iters` async
     steps (return_numpy=False so dispatch overlaps device compute), one
@@ -548,6 +583,79 @@ def bench_nmt(on_tpu):
             len(results), results)
 
 
+def _bench_ring_attn(extras2):
+    """Pallas ring-attention arms in their own frame: the 4×16×4096×64
+    bf16 q/k/v and the four jitted arms die when this returns, so the
+    section's ~RESOURCE_EXHAUSTED ceiling can't leak into later sections
+    (they used to live in main()'s frame until process exit)."""
+    import importlib
+    import statistics
+
+    import jax as _jax
+    import jax.numpy as _jnp
+    from jax.sharding import Mesh as _Mesh
+    _RA = importlib.import_module(
+        "paddle_tpu.parallel.ring_attention")
+    _mesh1 = _Mesh(np.array(_jax.devices()[:1]), ("sp",))
+    _key = _jax.random.PRNGKey(0)
+    _q, _k, _v = (_jax.random.normal(kk, (4, 16, 4096, 64),
+                                     _jnp.bfloat16)
+                  for kk in _jax.random.split(_key, 3))
+    _fns = {impl: _jax.jit(
+        lambda q, k, v, impl=impl: _RA.ring_self_attention(
+            q, k, v, _mesh1, causal=True, impl=impl))
+        for impl in ("jnp", "pallas")}
+    # fwd+bwd arms (VERDICT r4 #3: the Pallas ring BACKWARD —
+    # per-block dq/dkv kernels — vs the oracle vjp)
+    _gfns = {impl: _jax.jit(_jax.grad(
+        lambda q, k, v, impl=impl: _RA.ring_self_attention(
+            q, k, v, _mesh1, causal=True,
+            impl=impl).astype(_jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+        for impl in ("jnp", "pallas")}
+    for f in _fns.values():  # compile all arms first
+        np.asarray(f(_q, _k, _v).ravel()[0])
+    for f in _gfns.values():
+        np.asarray(f(_q, _k, _v)[0].ravel()[0])
+
+    def _seg(fns, impl, iters=6):
+        f = fns[impl]
+        t0 = time.time()
+        for _ in range(iters):
+            o = f(_q, _k, _v)
+        np.asarray(_jax.tree_util.tree_leaves(o)[0].ravel()[0])
+        return (time.time() - t0) / iters * 1e3
+
+    arms = {"jnp": [], "pallas": []}
+    garms = {"jnp": [], "pallas": []}
+    for _ in range(5):
+        arms["jnp"].append(_seg(_fns, "jnp"))
+        arms["pallas"].append(_seg(_fns, "pallas"))
+        garms["jnp"].append(_seg(_gfns, "jnp", 3))
+        garms["pallas"].append(_seg(_gfns, "pallas", 3))
+
+    def _iqr(xs):
+        qs = statistics.quantiles(xs, n=4)
+        return round(qs[2] - qs[0], 3)
+
+    med = {k: statistics.median(v) for k, v in arms.items()}
+    gmed = {k: statistics.median(v) for k, v in garms.items()}
+    ring_speedup = round(med["jnp"] / med["pallas"], 2)
+    extras2["ring_attn_pallas_ms"] = {
+        "median": round(med["pallas"], 3),
+        "iqr": _iqr(arms["pallas"]), "n_segments": 5}
+    extras2["ring_attn_oracle_ms"] = {
+        "median": round(med["jnp"], 3), "iqr": _iqr(arms["jnp"])}
+    extras2["ring_attn_bwd_pallas_ms"] = {
+        "median": round(gmed["pallas"], 3),
+        "iqr": _iqr(garms["pallas"]), "n_segments": 5}
+    extras2["ring_attn_bwd_oracle_ms"] = {
+        "median": round(gmed["jnp"], 3), "iqr": _iqr(garms["jnp"])}
+    extras2["ring_attn_bwd_pallas_speedup_t4k"] = round(
+        gmed["jnp"] / gmed["pallas"], 2)
+    return ring_speedup
+
+
 def main():
     import jax
 
@@ -592,6 +700,8 @@ def main():
 
         dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 3)
 
+    extras2 = {}
+    _end_section(extras2, "bert")
     tokens_per_sec = batch * seq / dt
     n_params = bert.param_count(cfg)
     flops_per_token = 6 * n_params  # fwd+bwd dense estimate
@@ -610,10 +720,10 @@ def main():
     except Exception as e:  # pragma: no cover
         rn_ips, rn_mfu, rn_ms = None, None, None
         rn_err = str(e)[:120]
+    _end_section(extras2, "resnet50")
 
     # remaining BASELINE workload configs (4: Transformer-big NMT,
     # 5: DeepFM CTR) — step-throughput evidence, same failure isolation
-    extras2 = {}
     rate = ms = err = None
     dfm_roofline = None
     try:
@@ -625,12 +735,14 @@ def main():
     extras2["deepfm_error"] = err
     extras2["deepfm_vs_baseline"] = (dfm_roofline or {}).get("frac")
     extras2["deepfm_roofline"] = dfm_roofline
+    _end_section(extras2, "deepfm")
     rate = ms = nmt_mfu = nb = err = None
     nmt_shapes = None
     try:
         rate, ms, nmt_mfu, nb, nmt_shapes = bench_nmt(on_tpu)
     except Exception as e:  # pragma: no cover
         err = str(e)[:120]
+    _end_section(extras2, "nmt_big")
     # Pallas ring attention evidence (VERDICT r3 #5, protocol per r4 #7):
     # fwd speedup over the jnp-oracle ring at T=4096 causal on this chip
     # (sp=1 ring — the kernel is the variable; multi-chip ICI isn't
@@ -640,74 +752,11 @@ def main():
     ring_speedup = None
     try:
         if on_tpu:
-            import importlib
-            import statistics
-
-            import jax as _jax
-            import jax.numpy as _jnp
-            from jax.sharding import Mesh as _Mesh
-            _RA = importlib.import_module(
-                "paddle_tpu.parallel.ring_attention")
-            _mesh1 = _Mesh(np.array(_jax.devices()[:1]), ("sp",))
-            _key = _jax.random.PRNGKey(0)
-            _q, _k, _v = (_jax.random.normal(kk, (4, 16, 4096, 64),
-                                             _jnp.bfloat16)
-                          for kk in _jax.random.split(_key, 3))
-            _fns = {impl: _jax.jit(
-                lambda q, k, v, impl=impl: _RA.ring_self_attention(
-                    q, k, v, _mesh1, causal=True, impl=impl))
-                for impl in ("jnp", "pallas")}
-            # fwd+bwd arms (VERDICT r4 #3: the Pallas ring BACKWARD —
-            # per-block dq/dkv kernels — vs the oracle vjp)
-            _gfns = {impl: _jax.jit(_jax.grad(
-                lambda q, k, v, impl=impl: _RA.ring_self_attention(
-                    q, k, v, _mesh1, causal=True,
-                    impl=impl).astype(_jnp.float32).sum(),
-                argnums=(0, 1, 2)))
-                for impl in ("jnp", "pallas")}
-            for f in _fns.values():  # compile all arms first
-                np.asarray(f(_q, _k, _v).ravel()[0])
-            for f in _gfns.values():
-                np.asarray(f(_q, _k, _v)[0].ravel()[0])
-
-            def _seg(fns, impl, iters=6):
-                f = fns[impl]
-                t0 = time.time()
-                for _ in range(iters):
-                    o = f(_q, _k, _v)
-                np.asarray(_jax.tree_util.tree_leaves(o)[0].ravel()[0])
-                return (time.time() - t0) / iters * 1e3
-
-            arms = {"jnp": [], "pallas": []}
-            garms = {"jnp": [], "pallas": []}
-            for _ in range(5):
-                arms["jnp"].append(_seg(_fns, "jnp"))
-                arms["pallas"].append(_seg(_fns, "pallas"))
-                garms["jnp"].append(_seg(_gfns, "jnp", 3))
-                garms["pallas"].append(_seg(_gfns, "pallas", 3))
-
-            def _iqr(xs):
-                qs = statistics.quantiles(xs, n=4)
-                return round(qs[2] - qs[0], 3)
-
-            med = {k: statistics.median(v) for k, v in arms.items()}
-            gmed = {k: statistics.median(v) for k, v in garms.items()}
-            ring_speedup = round(med["jnp"] / med["pallas"], 2)
-            extras2["ring_attn_pallas_ms"] = {
-                "median": round(med["pallas"], 3),
-                "iqr": _iqr(arms["pallas"]), "n_segments": 5}
-            extras2["ring_attn_oracle_ms"] = {
-                "median": round(med["jnp"], 3), "iqr": _iqr(arms["jnp"])}
-            extras2["ring_attn_bwd_pallas_ms"] = {
-                "median": round(gmed["pallas"], 3),
-                "iqr": _iqr(garms["pallas"]), "n_segments": 5}
-            extras2["ring_attn_bwd_oracle_ms"] = {
-                "median": round(gmed["jnp"], 3), "iqr": _iqr(garms["jnp"])}
-            extras2["ring_attn_bwd_pallas_speedup_t4k"] = round(
-                gmed["jnp"] / gmed["pallas"], 2)
+            ring_speedup = _bench_ring_attn(extras2)
     except Exception as e:  # pragma: no cover
         extras2["ring_attn_error"] = str(e)[:120]
     extras2["ring_attn_pallas_speedup_t4k"] = ring_speedup
+    _end_section(extras2, "ring_attn")
 
     # dygraph PreparedOp jit-cache evidence (VERDICT r3 #9): transformer-
     # style MLP train step, cached vs raw per-primitive dispatch
@@ -727,6 +776,7 @@ def main():
         extras2["dygraph_uncached_ms"] = {
             "median": dy.get("uncached_ms"),
             "iqr": dy.get("uncached_iqr_ms")}
+    _end_section(extras2, "dygraph")
 
     # async input pipeline (dataio.DeviceLoader + FetchHandle): sync vs
     # prefetch+in-flight steps/s with a slow reader (host cost ~50% of
@@ -737,6 +787,7 @@ def main():
         extras2["input_pipeline"] = run_pipeline_bench()
     except Exception as e:  # pragma: no cover
         extras2["input_pipeline"] = {"error": str(e)[:120]}
+    _end_section(extras2, "input_pipeline")
 
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms

@@ -55,6 +55,7 @@ from .core import (  # noqa: F401
     Place,
     Program,
     Scope,
+    ShardingStrategy,
     TPUPlace,
     Variable,
     append_backward,

@@ -1,6 +1,7 @@
 """Core framework: IR, registry, scope, executor, autodiff, compiler."""
 from .backward import append_backward, calc_gradient, gradients  # noqa: F401
-from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                       ExecutionStrategy, ShardingStrategy)
 from .executor import CPUPlace, CUDAPlace, Executor, Place, TPUPlace  # noqa: F401
 from .program import (  # noqa: F401
     Block,

@@ -32,6 +32,47 @@ import time
 import weakref
 
 
+class ShardingStrategy:
+    """ZeRO-style sharding of model state over the data-parallel mesh axis
+    (Rajbhandari et al. 2020, expressed as GSPMD sharding annotations per
+    Xu et al. 2021 — XLA lowers the annotations to reduce-scatter +
+    all-gather, no manual collectives).
+
+    - ``off``    — every state leaf replicated on every device (legacy).
+    - ``stage1`` — optimizer accumulators and master weights shard over the
+      dp axis: per-device state bytes drop by ~1/dp.
+    - ``stage2`` — stage1 plus gradients constrained to the same layout at
+      trace time, so persistent gradient buffers (GradientMergeOptimizer's
+      ``@GradientMerge`` accumulators) shard too and XLA reduce-scatters
+      instead of all-reducing into a replicated buffer.
+
+    Parameters themselves stay replicated (this is not ZeRO-3); losses are
+    unchanged — sharding only relays where each state element lives.
+    """
+
+    off = 0
+    stage1 = 1
+    stage2 = 2
+    # CamelCase aliases matching ReduceStrategy naming
+    Off = off
+    Stage1 = stage1
+    Stage2 = stage2
+
+
+def _zero_axis(shape, dp: int) -> Optional[int]:
+    """Pick the dim of `shape` to shard over a dp-sized axis: the largest
+    dp-divisible dim, else dim 0 when it is at least dp long (GSPMD pads
+    the ragged last shards, per-device extent ⌈shape[0]/dp⌉). None means
+    the leaf stays replicated (scalars, tiny leaves)."""
+    dims = [d if isinstance(d, int) else -1 for d in (shape or ())]
+    divisible = [i for i, d in enumerate(dims) if d > 0 and d % dp == 0]
+    if divisible:
+        return max(divisible, key=lambda i: dims[i])
+    if dims and dims[0] >= dp:
+        return 0
+    return None
+
+
 class BuildStrategy:
     """Knob bag kept for API parity (reference build_strategy.h:37-186).
     Most knobs are no-ops on TPU — XLA owns fusion and memory reuse. The ones
@@ -55,6 +96,7 @@ class BuildStrategy:
         self.memory_optimize = True
         self.enable_inplace = True
         self.remat = False                     # TPU-native: jax.checkpoint policy
+        self.sharding_strategy = ShardingStrategy.off
         self.sync_batch_norm = False
         self.num_trainers = 1
         self.trainer_id = 0
@@ -117,11 +159,13 @@ class CompiledProgram:
                 f"with_mesh: seq_axis and data_axis are both "
                 f"{seq_axis!r} — a feed dim cannot shard over the same "
                 f"mesh axis twice; use distinct axes")
-        self._zero_shard = False       # re-derived per call, never sticky
+        self._strategy_stage = 0       # re-derived per call, never sticky
         self._strategy_remat = False   # ditto; build_strategy.remat is the
         if strategy is not None:       # user's own knob and is left alone
             if getattr(strategy, "sharding_degree", 1) > 1:
-                self._zero_shard = True
+                # sharding on; sharding_stage picks ZeRO-1 vs ZeRO-2
+                self._strategy_stage = max(
+                    1, int(getattr(strategy, "sharding_stage", 1) or 1))
             if getattr(strategy, "recompute", False):
                 self._strategy_remat = True
             if getattr(strategy, "gradient_merge_steps", 1) > 1:
@@ -135,25 +179,84 @@ class CompiledProgram:
         return self
 
     # -- lowering ----------------------------------------------------------
+    def _zero_stage(self) -> int:
+        """Effective ShardingStrategy stage: the stronger of the fleet
+        DistributedStrategy wiring (with_mesh) and build_strategy's own
+        knob, resolved lazily so `c.build_strategy = bs` after
+        with_data_parallel/with_mesh still takes effect."""
+        if self._data_axis is None or self._mesh is None:
+            return ShardingStrategy.off
+        stage = int(getattr(self, "_strategy_stage", 0) or 0)
+        bs = self.build_strategy
+        if bs is not None:
+            stage = max(stage, int(getattr(bs, "sharding_strategy", 0) or 0))
+        return stage
+
+    def _zero_plan(self, var):
+        """(axis, pad_to) sharding plan for `var` over the data axis under
+        the effective ZeRO stage, or None to leave it replicated. Eligible
+        leaves — optimizer accumulators, master weights, and (stage2)
+        persistent gradient buffers, all tagged at creation so this is
+        robust against naming schemes — shard along their largest
+        dp-divisible dim; the dim-0 fallback (see _zero_axis) pads the
+        BOUNDARY representation to ⌈d/dp⌉·dp (pad_to), because jax requires
+        jit argument/result shardings to divide evenly — the step slices
+        the pad off on entry and re-pads on exit (_make_step)."""
+        stage = self._zero_stage()
+        if stage < ShardingStrategy.stage1 or var is None:
+            return None
+        shardable = (getattr(var, "is_optimizer_state", False)
+                     or getattr(var, "is_master_weight", False)
+                     or (stage >= ShardingStrategy.stage2
+                         and getattr(var, "is_grad_buffer", False)))
+        if not shardable or not getattr(var, "zero_shardable", True):
+            return None
+        dp = self._mesh.shape[self._data_axis]
+        axis = _zero_axis(var.shape, dp)
+        if axis is None:
+            return None
+        d = var.shape[axis]
+        pad_to = None if d % dp == 0 else -(-d // dp) * dp
+        return axis, pad_to
+
+    def _zero_pspec(self, var) -> Optional[P]:
+        plan = self._zero_plan(var)
+        if plan is None:
+            return None
+        return P(*([None] * plan[0]), self._data_axis)
+
+    def _zero_pad_map(self):
+        """{name: (logical_dim0, padded_dim0)} for every persistable on the
+        padding fallback under the current mesh/stage. Also recorded on the
+        Program (`_zero_padded`: name -> logical shape) so layout-unaware
+        paths (plain Executor, checkpoint save) can slice the pad off a
+        scope value that last crossed a sharded boundary."""
+        pads = {}
+        for v in self._program.list_vars():
+            if not v.persistable:
+                continue
+            plan = self._zero_plan(v)
+            if plan is not None and plan[1] is not None:
+                pads[v.name] = (v.shape[0], plan[1])
+        if pads:
+            rec = getattr(self._program, "_zero_padded", None)
+            if rec is None:
+                rec = self._program._zero_padded = {}
+            for n, (d, _) in pads.items():
+                var = self._program.global_block()._find_var_recursive(n)
+                rec[n] = tuple(var.shape)
+        return pads
+
     def _state_sharding(self, name: str):
         var = self._program.global_block()._find_var_recursive(name)
         spec = getattr(var, "shard_spec", None) if var is not None else None
         if spec is None:
-            # ZeRO-1 (DistributedStrategy.sharding_degree): optimizer
-            # accumulators shard dim 0 over the data axis — GSPMD inserts
-            # the gathers, the reference's sharding pass
-            # (fleet meta sharding) becomes a sharding annotation.
-            # Accumulators are tagged at creation (_add_accumulator) —
-            # robust against each optimizer's naming scheme.
-            if (getattr(self, "_zero_shard", False)
-                    and self._data_axis is not None and var is not None
-                    and getattr(var, "is_optimizer_state", False)
-                    and var.shape and len(var.shape) >= 1
-                    and var.shape[0] is not None and var.shape[0] > 0
-                    and var.shape[0] % self._mesh.shape[self._data_axis] == 0):
-                return NamedSharding(
-                    self._mesh, P(self._data_axis,
-                                  *([None] * (len(var.shape) - 1))))
+            # ZeRO (ShardingStrategy / DistributedStrategy.sharding_degree):
+            # GSPMD inserts the reduce-scatter/all-gather, the reference's
+            # sharding pass (fleet meta sharding) becomes an annotation.
+            spec = self._zero_pspec(var)
+            if spec is not None:
+                return NamedSharding(self._mesh, spec)
             return NamedSharding(self._mesh, P())
         spec = P(*spec) if not isinstance(spec, P) else spec
         return NamedSharding(self._mesh, spec)
@@ -166,22 +269,74 @@ class CompiledProgram:
             return NamedSharding(self._mesh, P(self._data_axis, seq))
         return NamedSharding(self._mesh, P(self._data_axis))
 
-    def _build(self, feed_names, fetch_names, state_names, out_state_names,
-               feed_ndims=None):
+    def _grad_shard_fn(self):
+        """Stage2: trace-time hook constraining each parameter gradient to
+        the ZeRO layout of its parameter, so XLA emits a reduce-scatter for
+        the cross-replica sum instead of an all-reduce into a replicated
+        buffer (and `@GradientMerge` accumulation stays sharded)."""
+        if self._zero_stage() < ShardingStrategy.stage2:
+            return None
+        mesh, data_axis = self._mesh, self._data_axis
+        dp = mesh.shape[data_axis]
+        block = self._program.global_block()
+
+        def shard_grad(target_name, g):
+            shape = getattr(g, "shape", None)
+            if shape is None or not hasattr(g, "dtype"):
+                return g  # SelectedRows-style sparse grads stay untouched
+            var = block._find_var_recursive(target_name)
+            if var is not None and getattr(var, "shard_spec", None) is not None:
+                return g  # TP parameters own their layout
+            axis = _zero_axis(shape, dp)
+            if axis is None:
+                return g
+            return jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, P(*([None] * axis), data_axis)))
+
+        return shard_grad
+
+    def _make_step(self, fetch_names, out_state_names):
+        """The pure (state, feed, key) -> (fetches, new_state, key) step —
+        shared by _build and Executor.run_batched's scan carry."""
         block = self._program.global_block()
         mesh = self._mesh
         amp = getattr(self._program, "_amp", None)
         remat = bool((self.build_strategy and self.build_strategy.remat)
                      or getattr(self, "_strategy_remat", False))
+        shard_grad = self._grad_shard_fn()
+        pads = self._zero_pad_map()
 
         def step(state, feed, key):
             env = dict(state)
+            # padded-boundary leaves: drop the pad rows before any op sees
+            # the value (ops run on the logical shape; GSPMD keeps the
+            # slice sharded — uneven tiles are legal INSIDE the program)
+            for n, (d, _dpad) in pads.items():
+                if n in env and env[n].shape[0] != d:
+                    env[n] = jax.lax.slice_in_dim(env[n], 0, d, axis=0)
             env.update(feed)
-            ctx = ExecContext(key, mesh=mesh, amp=amp, remat=remat)
+            ctx = ExecContext(key, mesh=mesh, amp=amp, remat=remat,
+                              shard_grad=shard_grad)
             _run_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
-            new_state = {n: env[n] for n in out_state_names if n in env}
+            new_state = {}
+            for n in out_state_names:
+                if n not in env:
+                    continue
+                v = env[n]
+                pad = pads.get(n)
+                if pad is not None and v.shape[0] == pad[0]:
+                    v = jnp.pad(v, [(0, pad[1] - pad[0])]
+                                + [(0, 0)] * (v.ndim - 1))
+                new_state[n] = v
             return fetches, new_state, ctx.final_key()
+
+        return step
+
+    def _build(self, feed_names, fetch_names, state_names, out_state_names,
+               feed_ndims=None):
+        mesh = self._mesh
+        step = self._make_step(fetch_names, out_state_names)
 
         state_sh = {n: self._state_sharding(n) for n in state_names}
         feed_sh = {n: self._feed_sharding((feed_ndims or {}).get(n))
@@ -248,7 +403,7 @@ class CompiledProgram:
                    tuple(state_names),
                    bool((self.build_strategy and self.build_strategy.remat)
                         or getattr(self, "_strategy_remat", False)),
-                   getattr(self, "_zero_shard", False),
+                   self._zero_stage(),
                    id(self._mesh), self._data_axis,
                    getattr(self, "_seq_axis", None))
         fn = self._cache.get(key_sig)
@@ -269,9 +424,20 @@ class CompiledProgram:
         else:
             _CACHE_HITS.inc()
 
+        pads = self._zero_pad_map()
         state = {}
         for n in state_names:
             v = scope.find_var(n)
+            pad = pads.get(n)
+            if (pad is not None and getattr(v, "shape", None)
+                    and v.shape[0] == pad[0]):
+                # logical-shape value headed for a padded boundary (startup
+                # init, checkpoint restore, or a relayout from an unsharded
+                # run): pad on host — these are the small non-divisible
+                # leaves, the round-trip is cheap
+                arr = np.asarray(v)
+                v = np.pad(arr, [(0, pad[1] - pad[0])]
+                           + [(0, 0)] * (arr.ndim - 1))
             if multiproc and not isinstance(v, jax.Array):
                 # process-local startup values are identical across ranks
                 # (same seed) and hold the FULL value; the callback slices
@@ -283,8 +449,16 @@ class CompiledProgram:
                 state[n] = jax.make_array_from_callback(
                     full.shape, self._state_sharding(n),
                     lambda idx, _full=full: _full[idx])
+            elif not isinstance(v, jax.Array):
+                # host value (startup init or a checkpoint restore): place it
+                # straight into its compiled layout, so ZeRO/TP state never
+                # holds a fully-replicated transient on every device
+                try:
+                    state[n] = jax.device_put(v, self._state_sharding(n))
+                except (TypeError, ValueError):
+                    state[n] = jnp.asarray(v)
             else:
-                state[n] = jnp.asarray(v)
+                state[n] = v
         key = scope.find_var(_RNG_STATE)
         if key is None:
             from .executor import _make_key
@@ -318,6 +492,11 @@ class CompiledProgram:
         for n, v in new_state.items():
             scope.set_var(n, v)
         scope.set_var(_RNG_STATE, new_key)
+        if compiling:
+            # gauge the state footprint once per compiled signature — the
+            # number ShardingStrategy shrinks — plus allocator occupancy
+            from ..observability.memory import record_state_memory
+            record_state_memory(new_state.values())
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

@@ -212,11 +212,15 @@ class ExecContext:
     """Per-trace context handed to op implementations."""
 
     def __init__(self, key, is_test: bool = False, mesh=None, amp=None,
-                 remat: bool = False):
+                 remat: bool = False, shard_grad=None):
         self._key = key
         self.is_test = is_test
         self.mesh = mesh
         self.amp = amp  # {'dtype', 'white_list', 'black_list'} or None
+        # ShardingStrategy.stage2 hook (CompiledProgram._grad_shard_fn):
+        # (target_name, grad) -> grad with a dp sharding constraint, making
+        # XLA reduce-scatter the cross-replica gradient sum
+        self.shard_grad = shard_grad
         # BuildStrategy.remat: op-level jax.checkpoint — recompute op
         # internals in the backward instead of saving residuals (trades
         # FLOPs for HBM; the win is on elementwise-heavy ops)
@@ -536,9 +540,12 @@ def _run_autodiff(op, env, ctx: ExecContext):
     for t in targets:
         gname = grad_var_name(t)
         if t in finished:
-            env[gname] = finished[t]
+            g = finished[t]
         else:
-            env[gname] = cots.get(t, jnp.zeros_like(env[t]))
+            g = cots.get(t, jnp.zeros_like(env[t]))
+        if ctx.shard_grad is not None:
+            g = ctx.shard_grad(t, g)
+        env[gname] = g
 
 
 # Horizontally-fusable parameter-update ops: N independent per-parameter
@@ -1012,6 +1019,17 @@ class Executor:
         key = scope.find_var(_RNG_STATE)
         if key is None:
             key = _make_key(program.random_seed or 0)
+        # a scope that last ran through a ZeRO-padded CompiledProgram
+        # boundary holds some leaves padded past their declared shape —
+        # slice the pad off before tracing the unsharded step
+        zero_pads = getattr(program, "_zero_padded", None)
+        if zero_pads:
+            for n, shp in zero_pads.items():
+                v = state.get(n)
+                if (v is not None and shp and getattr(v, "shape", None)
+                        and tuple(v.shape) != tuple(shp)
+                        and v.shape[0] > shp[0]):
+                    state[n] = jnp.asarray(v)[:shp[0]]
         state = {n: (v if isinstance(v, jax.Array) else jnp.asarray(v))
                  for n, v in state.items()}
 
@@ -1088,9 +1106,21 @@ class Executor:
         rejected — the log would overflow mid-scan).
 
         Returns one stacked np/jax array of shape [N, ...] per fetch.
+
+        Also accepts a CompiledProgram: the scan carry then keeps the
+        compiled mesh layout — ZeRO-sharded optimizer state stays sharded
+        across all N steps (donated, no per-step relayout) and feeds shard
+        over the data axis per step.
         """
         import jax as _jax
         from jax import lax as _lax
+        from .compiler import CompiledProgram
+
+        compiled = program if isinstance(program, CompiledProgram) else None
+        if compiled is not None:
+            if compiled._mesh is None:
+                compiled.with_data_parallel()
+            program = compiled._program
 
         feed_list = list(feed_list)
         if not feed_list:
@@ -1112,7 +1142,7 @@ class Executor:
                                       else (*entry, None))
                 pend, key, _ = self._epilogue_pending(program, sc, i, meta)
                 if pend[key] + n > every:
-                    self.run(eprog, scope=sc, return_numpy=False)
+                    self._run_epilogue(eprog, sc, compiled)
                     pend[key] = 0
         fetch_list = list(fetch_list or [])
         scope = scope or _scope()
@@ -1144,7 +1174,11 @@ class Executor:
                 f"{missing[:5]}")
         stacked_sig = feed_signature(stacked)
         key_sig = (id(program), program._version, n,
-                   stacked_sig, tuple(fetch_names))
+                   stacked_sig, tuple(fetch_names),
+                   (id(compiled._mesh), compiled._data_axis,
+                    compiled._zero_stage(),
+                    getattr(compiled, "_seq_axis", None))
+                   if compiled is not None else None)
         fn = self._cache.get(key_sig)
         compiling = fn is None
         if compiling:
@@ -1158,9 +1192,12 @@ class Executor:
                     program, _WATCHDOG.forget,
                     (id(program), program._version, "batched",
                      tuple(fetch_names)))
-            inner = self._build(program, keys, fetch_names,
-                                state_names, state_names)
-            raw_step = inner._step
+            if compiled is not None:
+                raw_step = compiled._make_step(fetch_names, state_names)
+            else:
+                inner = self._build(program, keys, fetch_names,
+                                    state_names, state_names)
+                raw_step = inner._step
 
             def scan_fn(state, feeds, key):
                 def body(carry, feed):
@@ -1170,14 +1207,62 @@ class Executor:
                 (st, k2), ys = _lax.scan(body, (state, key), feeds)
                 return ys, st, k2
 
-            fn = _jax.jit(scan_fn, donate_argnums=(0,))
+            if compiled is not None:
+                # pin the scan carry to the compiled layout: ZeRO-sharded
+                # state enters sharded, is donated, and leaves sharded —
+                # no relayout between dispatches; stacked feeds shard over
+                # the data axis in their per-step dims
+                from jax.sharding import NamedSharding as _NS, \
+                    PartitionSpec as _P
+                mesh = compiled._mesh
+                repl = _NS(mesh, _P())
+                state_sh = {nm: compiled._state_sharding(nm)
+                            for nm in state_names}
+                feed_sh = {
+                    k: _NS(mesh, _P(None, *compiled._feed_sharding(
+                        stacked[k].ndim - 1).spec))
+                    for k in keys}
+                fn = _jax.jit(
+                    scan_fn,
+                    in_shardings=(state_sh, feed_sh, repl),
+                    out_shardings=([repl for _ in fetch_names],
+                                   state_sh, repl),
+                    donate_argnums=(0,))
+            else:
+                fn = _jax.jit(scan_fn, donate_argnums=(0,))
             self._cache[key_sig] = fn
         else:
             _CACHE_HITS.inc()
 
-        state = {nm: scope.find_var(nm) for nm in state_names}
-        state = {nm: (v if isinstance(v, jax.Array) else jnp.asarray(v))
-                 for nm, v in state.items()}
+        pads = compiled._zero_pad_map() if compiled is not None else {}
+        zero_pads = getattr(program, "_zero_padded", None) or {}
+        state = {}
+        for nm in state_names:
+            v = scope.find_var(nm)
+            pad = pads.get(nm)
+            if (pad is not None and getattr(v, "shape", None)
+                    and v.shape[0] == pad[0]):
+                # logical-shape value headed for a padded ZeRO boundary
+                arr = np.asarray(v)
+                v = np.pad(arr, [(0, pad[1] - pad[0])]
+                           + [(0, 0)] * (arr.ndim - 1))
+            elif (compiled is None and nm in zero_pads
+                  and getattr(v, "shape", None)
+                  and zero_pads[nm] and v.shape[0] > zero_pads[nm][0]):
+                # inverse: padded scope value entering an unsharded scan
+                v = jnp.asarray(v)[:zero_pads[nm][0]]
+            if isinstance(v, jax.Array):
+                state[nm] = v
+            elif compiled is not None:
+                # host value: place straight into the compiled layout so a
+                # ZeRO shard never materializes fully replicated
+                try:
+                    state[nm] = jax.device_put(
+                        v, compiled._state_sharding(nm))
+                except (TypeError, ValueError):
+                    state[nm] = jnp.asarray(v)
+            else:
+                state[nm] = jnp.asarray(v)
         key = scope.find_var(_RNG_STATE)
         if key is None:
             key = _make_key(program.random_seed or 0)
@@ -1194,8 +1279,11 @@ class Executor:
         for nm, v in new_state.items():
             scope.set_var(nm, v)
         scope.set_var(_RNG_STATE, new_key)
+        if compiling and compiled is not None:
+            from ..observability.memory import record_state_memory
+            record_state_memory(new_state.values())
 
-        self._advance_epilogues(program, scope, n)
+        self._advance_epilogues(program, scope, n, compiled=compiled)
         if return_numpy:
             return [np.asarray(y) for y in ys]
         return list(ys)

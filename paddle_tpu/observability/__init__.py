@@ -34,6 +34,8 @@ Quick start::
     obs.get_registry().dump_json("metrics.json") # registry export
     obs.get_tracer().export_chrome_trace("host_trace.json")
 """
+from .memory import (device_memory_stats,  # noqa: F401
+                     per_device_state_bytes, record_state_memory)
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
                        get_registry)
 from .tracer import Tracer, get_tracer, trace_span  # noqa: F401
@@ -42,6 +44,7 @@ from .watchdog import (RecompileWarning, RecompileWatchdog,  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "get_registry",
+    "device_memory_stats", "per_device_state_bytes", "record_state_memory",
     "Tracer", "get_tracer", "trace_span",
     "RecompileWarning", "RecompileWatchdog", "diff_signatures",
     "get_watchdog",

@@ -1,0 +1,80 @@
+"""Device-memory gauges: what ZeRO sharding actually buys.
+
+Two gauges, sampled by the executor after each compiling dispatch (once
+per executable signature — cheap, and that is exactly when layouts can
+have changed):
+
+- ``memory/state_bytes_per_device`` — bytes of model state (parameters,
+  optimizer accumulators, master weights) resident on ONE device: each
+  leaf contributes its per-device shard size, so a replicated leaf counts
+  in full and a dp-sharded leaf counts ~1/dp. This is the number
+  ``ShardingStrategy.stage1/stage2`` shrinks.
+- ``memory/hbm_bytes_in_use`` — the allocator's ``bytes_in_use`` for the
+  first local device. TPU/GPU backends report it; CPU's allocator has no
+  stats, so the gauge is simply absent there.
+
+Reference analog: the reference framework surfaced allocator occupancy
+through ``memory_optimize`` logs and gperf tooling; here it is a registry
+gauge next to the executor counters.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .registry import get_registry
+
+__all__ = [
+    "device_memory_stats",
+    "per_device_state_bytes",
+    "record_state_memory",
+]
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """`memory_stats()` of `device` (default: first local device), or None
+    when the backend exposes no allocator stats (CPU)."""
+    import jax
+
+    try:
+        device = device or jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    return dict(stats) if stats else None
+
+
+def _leaf_bytes_on_device(v) -> int:
+    """Bytes `v` occupies on the first device that holds a shard of it."""
+    shards = getattr(v, "addressable_shards", None)
+    if not shards:
+        return int(getattr(v, "nbytes", 0))
+    first = min(shards,
+                key=lambda s: getattr(getattr(s, "device", None), "id", 0))
+    return int(getattr(first.data, "nbytes", 0))
+
+
+def per_device_state_bytes(leaves: Iterable) -> int:
+    """Sum of per-device shard bytes across `leaves` — the one-device
+    footprint of the model state under its current shardings."""
+    return sum(_leaf_bytes_on_device(v) for v in leaves)
+
+
+def record_state_memory(leaves: Optional[Iterable] = None,
+                        device=None) -> dict:
+    """Set the memory gauges; returns what was recorded. Never raises —
+    sampling must not take down a training dispatch."""
+    reg = get_registry()
+    out = {}
+    if leaves is not None:
+        try:
+            b = per_device_state_bytes(leaves)
+        except Exception:
+            b = None
+        if b is not None:
+            reg.gauge("memory/state_bytes_per_device").set(b)
+            out["state_bytes_per_device"] = b
+    stats = device_memory_stats(device)
+    if stats and stats.get("bytes_in_use") is not None:
+        reg.gauge("memory/hbm_bytes_in_use").set(int(stats["bytes_in_use"]))
+        out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+    return out

@@ -79,6 +79,12 @@ class Optimizer:
         # marks the var for ZeRO optimizer-state sharding
         # (compiler._state_sharding) — robust against accumulator naming
         acc.is_optimizer_state = True
+        # param-shaped accumulators (moments, velocities) shard over the dp
+        # axis under ShardingStrategy; scalar side-state (beta pows, loss
+        # scaling counters) must stay replicated — every device reads it
+        acc.zero_shardable = (
+            shape is None
+            and int(np.prod(param.shape or [1])) > 1)
         self._accumulators[key] = acc
         return acc
 
@@ -1165,6 +1171,10 @@ class GradientMergeOptimizer:
             acc = helper.create_global_variable(
                 list(p.shape), p.dtype, name=f"{p.name}@GradientMerge",
                 initializer=ConstantInitializer(0.0))
+            # the persistent gradient buffer ShardingStrategy.stage2 shards:
+            # with grads reduce-scattered to the same layout, accumulation
+            # happens shard-local and never materializes replicated
+            acc.is_grad_buffer = True
             acc_new = ops_layers.elementwise_add(acc, g)
             tensor_layers.assign(acc_new, acc)
             merged.append((p, acc))

@@ -44,16 +44,34 @@ def _is_replicated(v) -> bool:
     return all(tuple(s.index) == full for s in shards)
 
 
+def _zero_state_var(var) -> bool:
+    """ZeRO-shardable state (ShardingStrategy): optimizer accumulators,
+    master weights, persistent gradient buffers — tagged at creation."""
+    return bool(var is not None
+                and (getattr(var, "is_optimizer_state", False)
+                     or getattr(var, "is_master_weight", False)
+                     or getattr(var, "is_grad_buffer", False)))
+
+
 def _snapshot(program: Program, scope: Scope):
     """(replicated_vals, shard_records): shard_records holds
     (var, index, device_buffer) triples for THIS process's addressable,
     replica-0 shards only — a sharded parameter is never all-gathered to
     host on the save path (VERDICT r2 #7; at pod scale the gather would
-    materialize every parameter fully on every host)."""
+    materialize every parameter fully on every host).
+
+    Exception: ZeRO-sharded optimizer state that is fully addressable and
+    small (≤ PDTPU_CKPT_GATHER_MAX_BYTES, default 64 MiB) is gathered into
+    the main bundle — the save gathers, the load re-shards, and the
+    checkpoint stays a plain layout-independent bundle with no shard-file
+    proliferation for every accumulator of every parameter."""
     import jax
     import jax.numpy as jnp
 
-    names = [v.name for v in program.list_vars() if v.persistable]
+    gather_max = int(os.environ.get("PDTPU_CKPT_GATHER_MAX_BYTES",
+                                    str(64 << 20)))
+    pvars = {v.name: v for v in program.list_vars() if v.persistable}
+    names = list(pvars)
     out = {}
     shard_records = []
     for n in names:
@@ -62,6 +80,18 @@ def _snapshot(program: Program, scope: Scope):
             continue
         if isinstance(v, jax.Array):
             if not _is_replicated(v):
+                if (_zero_state_var(pvars.get(n))
+                        and v.is_fully_addressable
+                        and v.nbytes <= gather_max):
+                    arr = np.asarray(v)  # host gather, layout erased
+                    shp = tuple(pvars[n].shape or ())
+                    if (shp and arr.shape != shp and len(arr.shape) == len(shp)
+                            and all(a >= b for a, b in zip(arr.shape, shp))):
+                        # ZeRO padding fallback stores the leaf padded to a
+                        # dp multiple — persist the declared (logical) shape
+                        arr = arr[tuple(slice(0, d) for d in shp)]
+                    out[n] = arr
+                    continue
                 for s in v.addressable_shards:
                     if s.replica_id == 0:  # one copy of each distinct piece
                         # own copy: the next training step DONATES the live
@@ -252,6 +282,8 @@ class Checkpointer:
             # background write — there is no cross-rank barrier) could
             # leave a var it exclusively held at its init value, silently.
             sharded = [v.name for v in program.list_vars() if v.persistable
+                       and v.name not in vals  # gathered ZeRO state is
+                       # already in the bundle — not shard-file material
                        and isinstance(scope.find_var(v.name), jax.Array)
                        and not _is_replicated(scope.find_var(v.name))]
             if sharded:

@@ -182,7 +182,7 @@ class DistributedOptimizer:
             annotate_tp(program)
         mesh = self._strategy.build_mesh()
         self._fleet.main_program = CompiledProgram(program).with_mesh(
-            mesh, data_axis="dp")
+            mesh, data_axis="dp", strategy=self._strategy)
         return ops, pg
 
     def __getattr__(self, name):

@@ -52,6 +52,9 @@ class DistributedStrategy:
         self.pipeline_parallel_degree = 1
         self.sequence_parallel_degree = 1
         self.sharding_degree = 1          # ZeRO-style optimizer sharding
+        # ShardingStrategy stage once sharding is on: 1 = state sharding,
+        # 2 = state + gradient reduce-scatter (compiler.ShardingStrategy)
+        self.sharding_stage = 1
         self.amp = False
         self.recompute = False            # jax.checkpoint on blocks
         self.gradient_merge_steps = 1     # microbatch accumulation

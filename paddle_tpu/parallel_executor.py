@@ -9,12 +9,14 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .core.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .core.compiler import (BuildStrategy, CompiledProgram,
+                            ExecutionStrategy, ShardingStrategy)
 from .core.executor import Executor, TPUPlace
 from .core.program import default_main_program
 from .observability import get_registry, trace_span
 
-__all__ = ["ParallelExecutor", "BuildStrategy", "ExecutionStrategy"]
+__all__ = ["ParallelExecutor", "BuildStrategy", "ExecutionStrategy",
+           "ShardingStrategy"]
 
 
 class ParallelExecutor:
@@ -22,12 +24,19 @@ class ParallelExecutor:
                  share_vars_from=None, exec_strategy=None, build_strategy=None,
                  num_trainers=1, trainer_id=0, scope=None):
         self._program = main_program or default_main_program()
+        build_strategy = build_strategy or BuildStrategy()
         self._compiled = CompiledProgram(self._program).with_data_parallel(
             loss_name=loss_name, build_strategy=build_strategy,
             exec_strategy=exec_strategy,
             share_vars_from=getattr(share_vars_from, "_compiled", None))
         self._exe = Executor(TPUPlace())
         self._scope = scope
+        # build_strategy.sharding_strategy (ZeRO state sharding) is honored
+        # by the compiled program; surfaced here for introspection
+        self.sharding_strategy = getattr(
+            build_strategy, "sharding_strategy", ShardingStrategy.off)
+        # set on EVERY construction — a later ParallelExecutor over a
+        # different device set must not leave the first one's count exported
         get_registry().gauge("executor/device_count").set(self.device_count)
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
@@ -39,5 +48,8 @@ class ParallelExecutor:
 
     @property
     def device_count(self):
+        mesh = getattr(self._compiled, "_mesh", None)
+        if mesh is not None:
+            return int(mesh.size)
         import jax
         return jax.local_device_count()

@@ -17,6 +17,17 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture()
+def xla_8dev_subprocess_env():
+    """Env for subprocess runners that must see 8 fake CPU devices from a
+    clean interpreter (the CI sharding smoke job — mirrors how
+    dist_mlp_runner.py forces its own XLA_FLAGS before importing jax)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Give every test fresh default programs + scope + unique names."""

@@ -1,0 +1,102 @@
+"""ZeRO stage1 smoke runner (CI 8-fake-device job, dist_mlp_runner.py shape).
+
+Launched by tests/test_zero_sharding.py::test_zero_smoke_subprocess in a
+clean interpreter whose env carries --xla_force_host_platform_device_count=8
+(the xla_8dev_subprocess_env conftest fixture). Trains the same MLP+Adam
+with ShardingStrategy.off and .stage1 and prints ONE JSON line:
+
+  {"device_count": 8, "losses_off": [hex...], "losses_stage1": [hex...],
+   "max_shard_frac": f, "state_bytes_off": n, "state_bytes_stage1": n}
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build(seed=11):
+    import paddle_tpu as fluid
+    from paddle_tpu.initializer import NumpyArrayInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    rng = np.random.RandomState(seed)
+
+    def attr(name, shape):
+        w = (rng.rand(*shape).astype("float32") - 0.5) * 0.2
+        return ParamAttr(name=name, initializer=NumpyArrayInitializer(w))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 32, act="relu",
+                            param_attr=attr("sw0", (16, 32)),
+                            bias_attr=attr("sb0", (32,)))
+        out = fluid.layers.fc(h, 1,
+                              param_attr=attr("sw1", (32, 1)),
+                              bias_attr=attr("sb1", (1,)))
+        loss = fluid.layers.mean(fluid.layers.square(out - y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.rand(32, 16).astype("float32"),
+            "y": rng.rand(32, 1).astype("float32")}
+    return main, startup, feed, loss
+
+
+def run(stage, steps=3):
+    import paddle_tpu as fluid
+    from paddle_tpu.observability import get_registry
+
+    main, startup, feed, loss = build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        bs = fluid.BuildStrategy()
+        bs.sharding_strategy = stage
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+        losses = [np.asarray(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+                  .tobytes().hex() for _ in range(steps)]
+    state_bytes = get_registry().gauge("memory/state_bytes_per_device").value
+    frac = 0.0
+    for v in main.global_block().vars.values():
+        if not getattr(v, "is_optimizer_state", False):
+            continue
+        arr = scope.find_var(v.name)
+        n = int(np.prod(tuple(v.shape) or (1,)))
+        if arr is None or n <= 1:
+            continue
+        shard = arr.addressable_shards[0].data
+        if stage:  # sharded leaves must be split; padded ones round up
+            frac = max(frac, float(np.prod(shard.shape)) / float(n))
+    return losses, state_bytes, frac
+
+
+def main():
+    import paddle_tpu as fluid
+
+    assert len(jax.devices()) == 8, len(jax.devices())
+    losses_off, bytes_off, _ = run(fluid.ShardingStrategy.off)
+    losses_s1, bytes_s1, frac = run(fluid.ShardingStrategy.stage1)
+    print(json.dumps({
+        "device_count": len(jax.devices()),
+        "losses_off": losses_off,
+        "losses_stage1": losses_s1,
+        "max_shard_frac": frac,
+        "state_bytes_off": bytes_off,
+        "state_bytes_stage1": bytes_s1,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
