@@ -478,10 +478,16 @@ class CompiledProgram:
                 key = jax.make_array_from_process_local_data(
                     sh, np.asarray(key))
 
+        from ..observability.flight import get_flight_recorder
+        from ..observability.steps import get_step_profiler
         t0 = time.perf_counter()
-        with trace_span("compiled_program/compile+run" if compiling
-                        else "compiled_program/run",
-                        sig=_sig_digest(feed_sig)):
+        with get_flight_recorder().guard(
+                "CompiledProgram._run",
+                program=f"0x{id(self._program):x}",
+                sig=_sig_digest(feed_sig), compiling=compiling), \
+                trace_span("compiled_program/compile+run" if compiling
+                           else "compiled_program/run",
+                           sig=_sig_digest(feed_sig)):
             fetches, new_state, new_key = fn(state, feed_vals, key)
         dt_ms = (time.perf_counter() - t0) * 1e3
         if compiling:
@@ -489,6 +495,9 @@ class CompiledProgram:
                            sig=_sig_digest(feed_sig)).observe(dt_ms)
         else:
             _EXECUTE_MS.observe(dt_ms)
+        get_step_profiler().record(dt_ms, program_id=id(self._program),
+                                   sig=_sig_digest(feed_sig),
+                                   compiled=compiling)
         for n, v in new_state.items():
             scope.set_var(n, v)
         scope.set_var(_RNG_STATE, new_key)

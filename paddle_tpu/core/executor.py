@@ -30,7 +30,11 @@ from .program import Block, Program, Variable, default_main_program, grad_var_na
 from .scope import Scope, _scope, global_scope
 
 from ..dataio.handle import FetchHandle
+from ..observability.flight import (get_flight_recorder,
+                                    register_dump_section)
+from ..observability.http import maybe_serve_from_env
 from ..observability.registry import get_registry
+from ..observability.steps import get_step_profiler
 from ..observability.tracer import trace_span
 from ..observability.watchdog import get_watchdog
 
@@ -52,6 +56,31 @@ _FUSED_GROUPS = _OBS.counter("executor/fused_update_groups")
 _FUSED_OPS = _OBS.counter("executor/fused_update_ops")
 _INFLIGHT = _OBS.gauge("executor/inflight_steps")
 _WATCHDOG = get_watchdog()
+_STEPS = get_step_profiler()
+_FLIGHT = get_flight_recorder()
+
+# live executors, so the flight recorder can dump which compiled
+# signatures were resident when a run died (weak: a GC'd executor's
+# cache should not appear in forensics)
+_LIVE_EXECUTORS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _fmt_cache_key(key_sig) -> dict:
+    try:
+        return {"program": f"0x{key_sig[0]:x}", "version": key_sig[1],
+                "key": repr(key_sig[2:])[:400]}
+    except Exception:
+        return {"key": repr(key_sig)[:400]}
+
+
+def _compiled_signatures_section() -> list:
+    out = []
+    for exe in list(_LIVE_EXECUTORS):
+        out.extend(_fmt_cache_key(k) for k in list(exe._cache))
+    return out
+
+
+register_dump_section("compiled_signatures", _compiled_signatures_section)
 
 
 # -- persistent compilation cache -------------------------------------------
@@ -898,7 +927,11 @@ class Executor:
         # DeviceLoaders this executor spun up (train_from_dataset); weak so
         # a finished loop's loader can die without waiting for close()
         self._loaders: "weakref.WeakSet" = weakref.WeakSet()
+        _LIVE_EXECUTORS.add(self)
         _maybe_enable_compile_cache()
+        # live introspection plane: PDTPU_INTROSPECT_PORT alone makes
+        # any training process scrapeable (/metrics, /healthz, /debug)
+        maybe_serve_from_env()
 
     # -- lowering ----------------------------------------------------------
     def _state_names(self, program: Program, scope: Scope) -> List[str]:
@@ -1034,8 +1067,10 @@ class Executor:
                  for n, v in state.items()}
 
         t0 = time.perf_counter()
-        with trace_span("executor/compile+run" if compiling
-                        else "executor/run", sig=_sig_digest(feed_sig)):
+        with _FLIGHT.guard("Executor.run", program=f"0x{id(program):x}",
+                           sig=_sig_digest(feed_sig), compiling=compiling), \
+                trace_span("executor/compile+run" if compiling
+                           else "executor/run", sig=_sig_digest(feed_sig)):
             fetches, new_state, new_key = fn(state, feed_vals, key)
         dt_ms = (time.perf_counter() - t0) * 1e3
         if compiling:
@@ -1048,6 +1083,8 @@ class Executor:
             # steady-state host dispatch time (device work is async on
             # real accelerators; on CPU this is the full step)
             _EXECUTE_MS.observe(dt_ms)
+        _STEPS.record(dt_ms, program_id=id(program),
+                      sig=_sig_digest(feed_sig), compiled=compiling)
 
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -1267,8 +1304,12 @@ class Executor:
         if key is None:
             key = _make_key(program.random_seed or 0)
         t0 = time.perf_counter()
-        with trace_span("executor/run_batched", steps=n,
-                        sig=_sig_digest(stacked_sig)):
+        with _FLIGHT.guard("Executor.run_batched",
+                           program=f"0x{id(program):x}",
+                           sig=_sig_digest(stacked_sig), steps=n,
+                           compiling=compiling), \
+                trace_span("executor/run_batched", steps=n,
+                           sig=_sig_digest(stacked_sig)):
             ys, new_state, new_key = fn(state, stacked, key)
         dt_ms = (time.perf_counter() - t0) * 1e3
         if compiling:
@@ -1276,6 +1317,9 @@ class Executor:
                            sig=_sig_digest(stacked_sig)).observe(dt_ms)
         else:
             _EXECUTE_MS.observe(dt_ms)
+        _STEPS.record(dt_ms, program_id=id(program),
+                      sig=_sig_digest(stacked_sig), compiled=compiling,
+                      steps=n)
         for nm, v in new_state.items():
             scope.set_var(nm, v)
         scope.set_var(_RNG_STATE, new_key)

@@ -19,6 +19,8 @@ import numpy as np
 from ..observability.registry import get_registry
 
 _FETCH_WAIT_MS = get_registry().histogram("executor/fetch_wait_ms")
+# last wait as a gauge: the StepProfiler stamps step records with it
+_LAST_FETCH_WAIT_MS = get_registry().gauge("executor/last_fetch_wait_ms")
 
 __all__ = ["FetchHandle"]
 
@@ -49,7 +51,9 @@ class FetchHandle:
             import time
             t0 = time.perf_counter()
             self._numpy = [np.asarray(v) for v in self._values]
-            _FETCH_WAIT_MS.observe((time.perf_counter() - t0) * 1e3)
+            dt = (time.perf_counter() - t0) * 1e3
+            _FETCH_WAIT_MS.observe(dt)
+            _LAST_FETCH_WAIT_MS.set(dt)
         return self._numpy
 
     def jax(self) -> list:
@@ -77,7 +81,9 @@ class FetchHandle:
             except RuntimeError as e:  # deleted between check and block
                 if "deleted" not in str(e) and "donated" not in str(e):
                     raise
-        _FETCH_WAIT_MS.observe((time.perf_counter() - t0) * 1e3)
+        dt = (time.perf_counter() - t0) * 1e3
+        _FETCH_WAIT_MS.observe(dt)
+        _LAST_FETCH_WAIT_MS.set(dt)
         return self
 
     def is_ready(self) -> bool:

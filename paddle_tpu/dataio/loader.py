@@ -35,6 +35,9 @@ __all__ = ["DeviceLoader"]
 _OBS = get_registry()
 _QUEUE_DEPTH = _OBS.gauge("dataio/prefetch_queue_depth")
 _H2D_MS = _OBS.histogram("dataio/h2d_ms")
+# last observation as a gauge so the StepProfiler can stamp each step
+# record with the most recent transfer without a histogram read
+_LAST_H2D_MS = _OBS.gauge("dataio/last_h2d_ms")
 _BATCHES = _OBS.counter("dataio/batches")
 
 # every live loader, so Executor.close() / interpreter teardown can sweep
@@ -133,7 +136,9 @@ class DeviceLoader:
                         return
                     t0 = time.perf_counter()
                     dev = convert(batch)
-                    _H2D_MS.observe((time.perf_counter() - t0) * 1e3)
+                    dt = (time.perf_counter() - t0) * 1e3
+                    _H2D_MS.observe(dt)
+                    _LAST_H2D_MS.set(dt)
                     # bounded put that stays responsive to close(): a
                     # plain q.put would deadlock a worker whose consumer
                     # broke out of the epoch without draining

@@ -22,6 +22,20 @@ capability along its natural seam:
   executable-cache miss; past a threshold the watchdog warns once,
   naming exactly which feed's shape/dtype diverged between the cached
   and the new signature (the actionable diagnosis of a recompile storm).
+- **IntrospectionServer** (http.py) — stdlib HTTP server exposing the
+  live process: ``/metrics`` (Prometheus text), ``/metrics.json``,
+  ``/healthz`` (pluggable named checks), ``/debug/steps``,
+  ``/debug/flight``. Start with ``serve_introspection(port)`` or by
+  setting ``PDTPU_INTROSPECT_PORT``.
+- **StepProfiler** (steps.py) — one structured record per executor
+  dispatch (wall time, signature, compile flag, dataio queue/h2d,
+  fetch wait, device memory) in a rolling window, with a median/MAD
+  straggler detector feeding ``steps/anomalies{reason=...}``.
+- **FlightRecorder** (flight.py) — bounded ring of step records +
+  warning events; on ``XlaRuntimeError``/``RESOURCE_EXHAUSTED`` the
+  dispatch sites dump a post-mortem (steps, registry snapshot, device
+  memory, compiled signatures, watchdog state) to ``PDTPU_FLIGHT_DIR``
+  before re-raising.
 
 Quick start::
 
@@ -34,10 +48,18 @@ Quick start::
     obs.get_registry().dump_json("metrics.json") # registry export
     obs.get_tracer().export_chrome_trace("host_trace.json")
 """
+from .flight import (FlightRecorder, get_flight_recorder,  # noqa: F401
+                     is_oom, register_dump_section,
+                     unregister_dump_section)
+from .http import (IntrospectionServer, maybe_serve_from_env,  # noqa: F401
+                   register_health_check, run_health_checks,
+                   serve_introspection, stop_introspection,
+                   unregister_health_check)
 from .memory import (device_memory_stats,  # noqa: F401
                      per_device_state_bytes, record_state_memory)
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
                        get_registry)
+from .steps import StepProfiler, get_step_profiler  # noqa: F401
 from .tracer import Tracer, get_tracer, trace_span  # noqa: F401
 from .watchdog import (RecompileWarning, RecompileWatchdog,  # noqa: F401
                        diff_signatures, get_watchdog)
@@ -48,4 +70,10 @@ __all__ = [
     "Tracer", "get_tracer", "trace_span",
     "RecompileWarning", "RecompileWatchdog", "diff_signatures",
     "get_watchdog",
+    "FlightRecorder", "get_flight_recorder", "is_oom",
+    "register_dump_section", "unregister_dump_section",
+    "StepProfiler", "get_step_profiler",
+    "IntrospectionServer", "serve_introspection", "stop_introspection",
+    "maybe_serve_from_env", "register_health_check",
+    "unregister_health_check", "run_health_checks",
 ]

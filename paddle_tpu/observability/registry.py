@@ -37,6 +37,38 @@ def _label_key(labels: dict) -> tuple:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _prom_metric_name(name: str) -> str:
+    """Map to the exposition-spec metric-name charset
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (we also fold ``:`` to ``_`` — the
+    spec reserves colons for recording rules)."""
+    s = "".join(ch if (ch.isalnum() and ch.isascii()) or ch == "_"
+                else "_" for ch in name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def _prom_label_name(name: str) -> str:
+    """Label-name charset ``[a-zA-Z_][a-zA-Z0-9_]*``."""
+    return _prom_metric_name(name)
+
+
+def _prom_label_value(value) -> str:
+    """Escape per the exposition spec: backslash, double quote, and
+    newline inside quoted label values."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labelstr(items, extra=()) -> str:
+    items = tuple(items) + tuple(extra)
+    if not items:
+        return ""
+    return ("{" + ",".join(
+        f'{_prom_label_name(k)}="{_prom_label_value(v)}"'
+        for k, v in items) + "}")
+
+
 def _fmt_name(name: str, label_items: tuple) -> str:
     if not label_items:
         return name
@@ -270,18 +302,13 @@ class Registry:
 
     def prometheus_text(self, deep: bool = True) -> str:
         """Prometheus text exposition format. Histograms render as
-        summaries (quantile labels + _count/_sum)."""
-
-        def sanitize(name: str) -> str:
-            return "".join(ch if (ch.isalnum() or ch == "_") else "_"
-                           for ch in name)
-
-        def labelstr(items, extra=()):
-            items = tuple(items) + tuple(extra)
-            if not items:
-                return ""
-            return "{" + ",".join(f'{sanitize(k)}="{v}"'
-                                  for k, v in items) + "}"
+        summaries (quantile labels + _count/_sum). Metric/label names
+        are sanitized to the spec charsets and label values escaped
+        (backslash, double quote, newline), so hostile values like a
+        feed signature ``x:f32[8,128]`` cannot produce an unscrapeable
+        page."""
+        sanitize = _prom_metric_name
+        labelstr = _prom_labelstr
 
         cs, gs, hs = self._collect(deep)
         lines: List[str] = []

@@ -125,6 +125,21 @@ class RecompileWatchdog:
             ent = self._entries.get(key)
             return ent.count if ent is not None else 0
 
+    def state(self) -> dict:
+        """JSON-safe view of every tracked program (flight-dump section):
+        compile count, warned flag, and per-feed divergence counts."""
+        with self._lock:
+            return {
+                "threshold": self.threshold,
+                "programs": [
+                    {"key": repr(key)[:200],
+                     "compiles": ent.count,
+                     "warned": ent.warned,
+                     "diverging_feeds": dict(ent.diverging)}
+                    for key, ent in self._entries.items()
+                ],
+            }
+
     def forget(self, key) -> None:
         """Drop a program's entry (hooked to program GC by the executor so
         a recycled id() cannot inherit a dead program's compile count)."""

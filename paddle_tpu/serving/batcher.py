@@ -97,6 +97,15 @@ class DynamicBatcher:
             outs = self._run(reqs)
         except Exception as e:  # deliver, don't crash the worker
             self.metrics.counter("serving/errors").inc()
+            from ..observability.flight import (get_flight_recorder,
+                                                is_oom)
+            if is_oom(e):
+                # a device OOM answered through futures leaves no trace
+                # otherwise — capture the post-mortem before delivering
+                get_flight_recorder().record_failure(e, context={
+                    "where": "DynamicBatcher.dispatch",
+                    "requests": len(reqs),
+                    "rows": sum(r.n for r in reqs)})
             for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(e)

@@ -30,9 +30,17 @@ import numpy as np
 from .batcher import (DEFAULT_BUCKETS, DynamicBatcher, ServingError,
                       item_signature)
 from .metrics import Metrics
+from ..observability.http import (maybe_serve_from_env,
+                                  register_health_check,
+                                  unregister_health_check)
 
 __all__ = ["InferenceServer", "QueueFullError", "Request", "ServerClosedError",
            "ServingError"]
+
+# distinguishes health-check names when several servers live in one
+# process ("serving/queue", then "serving#2/queue", ...)
+_server_seq_lock = threading.Lock()
+_server_seq = [0]
 
 
 class QueueFullError(ServingError):
@@ -107,6 +115,7 @@ class InferenceServer:
         self._started = False
         self._closed = False
         self._draining = False
+        self._health_names: List[str] = []
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "InferenceServer":
@@ -122,6 +131,10 @@ class InferenceServer:
                                  name=f"paddle_tpu-serve-{i}", daemon=True)
             self._workers.append(t)
             t.start()
+        # k8s-probe readiness: queue/deadline/worker checks on /healthz,
+        # and PDTPU_INTROSPECT_PORT alone brings the endpoints up
+        self._register_health_checks()
+        maybe_serve_from_env()
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -142,6 +155,58 @@ class InferenceServer:
         for t in self._workers:
             t.join()
         self._workers = []
+        for name in self._health_names:
+            unregister_health_check(name)
+        self._health_names = []
+
+    # -- health checks (served at /healthz) --------------------------------
+    def _register_health_checks(self) -> None:
+        with _server_seq_lock:
+            _server_seq[0] += 1
+            seq = _server_seq[0]
+        prefix = "serving" if seq == 1 else f"serving#{seq}"
+
+        def check_queue():
+            with self._cond:
+                depth, cap = len(self._queue), self.max_queue_size
+            if depth >= cap:
+                return ("degraded",
+                        f"queue full ({depth}/{cap}) — shedding load")
+            if depth >= 0.8 * cap:
+                return ("degraded", f"queue {depth}/{cap} (>= 80% full)")
+            return ("ok", f"queue {depth}/{cap}")
+
+        def check_deadlines():
+            req = self.metrics.counter("serving/requests").value
+            missed = self.metrics.counter("serving/timeouts").value
+            rate = missed / req if req else 0.0
+            detail = f"{missed}/{req} requests missed their deadline"
+            if rate > 0.5:
+                return ("failing", detail)
+            if rate > 0.05:
+                return ("degraded", detail)
+            return ("ok", detail)
+
+        def check_workers():
+            with self._cond:
+                started, closed = self._started, self._closed
+            workers = list(self._workers)
+            if closed:
+                return ("degraded", "server stopped")
+            if not started:
+                return ("degraded", "server not started")
+            dead = sum(1 for t in workers if not t.is_alive())
+            if dead:
+                return ("failing",
+                        f"{dead}/{len(workers)} serve workers dead — "
+                        f"dispatch is stalled")
+            return ("ok", f"{len(workers)} serve workers alive")
+
+        for name, fn in ((f"{prefix}/queue", check_queue),
+                         (f"{prefix}/deadlines", check_deadlines),
+                         (f"{prefix}/workers", check_workers)):
+            register_health_check(name, fn)
+            self._health_names.append(name)
 
     def __enter__(self) -> "InferenceServer":
         return self.start()

@@ -200,6 +200,12 @@ def main(argv=None) -> int:
                     help="write the host tracer's chrome-trace JSON of "
                          "the run (load in Perfetto, or summarize with "
                          "tools.timeline --summary)")
+    ap.add_argument("--introspect-port", type=int, default=None,
+                    help="serve the live introspection plane on this "
+                         "port for the duration of the run (0 = "
+                         "ephemeral) and scrape /metrics + /healthz once "
+                         "mid-run as a smoke check of the endpoints "
+                         "under load")
     args = ap.parse_args(argv)
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
@@ -216,9 +222,21 @@ def main(argv=None) -> int:
     if not args.skip_sequential:
         seq = bench_sequential(pred, rows)
         print(percentile_row(seq))
+    scrape: dict = {}
+    scraper = None
+    if args.introspect_port is not None:
+        from paddle_tpu.observability import serve_introspection
+
+        srv = serve_introspection(args.introspect_port)
+        scraper = threading.Thread(
+            target=_scrape_introspection, args=(srv.url, scrape),
+            daemon=True)
+        scraper.start()
     served = bench_served(pred, rows, concurrency=args.concurrency,
                           buckets=buckets, batch_delay_ms=args.batch_delay_ms,
                           qps=args.qps, seed=args.seed)
+    if scraper is not None:
+        scraper.join(timeout=10)
     print(percentile_row(served))
     print()
     bs = served["metrics"].get("serving/batch_rows") or {}
@@ -236,6 +254,8 @@ def main(argv=None) -> int:
             snap.setdefault(k, v)
         snap["bench/served"] = {k: v for k, v in served.items()
                                 if k != "metrics"}
+        if scrape:
+            snap["bench/introspection"] = scrape
         if seq is not None:
             snap["bench/sequential"] = seq
         with open(args.metrics_out, "w") as f:
@@ -248,6 +268,12 @@ def main(argv=None) -> int:
         print(f"wrote {args.trace_out} "
               f"({len(trace['traceEvents'])} events) — load in "
               f"chrome://tracing or ui.perfetto.dev")
+    if args.introspect_port is not None:
+        ok = scrape and all("error" not in r for r in scrape.values())
+        print(f"introspection scrape: {json.dumps(scrape)}")
+        if not ok:
+            print("FAIL: live /metrics + /healthz scrape failed under load")
+            return 1
     if seq is not None:
         speedup = served["throughput_rps"] / max(seq["throughput_rps"], 1e-9)
         print(f"batched/sequential throughput: {speedup:.2f}x")
@@ -255,6 +281,21 @@ def main(argv=None) -> int:
             print("FAIL: dynamic batching did not beat sequential")
             return 1
     return 0
+
+
+def _scrape_introspection(url: str, out: dict, delay_s: float = 0.2) -> None:
+    """One mid-run GET of /metrics and /healthz — proves the endpoints
+    answer while the serve loop is under load (results land in `out`)."""
+    import urllib.request
+
+    time.sleep(delay_s)  # let the load generator reach steady state
+    for ep in ("/metrics", "/healthz"):
+        try:
+            with urllib.request.urlopen(url + ep, timeout=5) as r:
+                body = r.read()
+            out[ep] = {"status": r.status, "bytes": len(body)}
+        except Exception as e:
+            out[ep] = {"error": f"{type(e).__name__}: {e}"[:160]}
 
 
 if __name__ == "__main__":

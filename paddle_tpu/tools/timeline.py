@@ -33,7 +33,8 @@ import os
 from typing import Dict, List, Optional
 
 __all__ = ["find_xplanes", "xplane_to_chrome_trace", "load_trace",
-           "merge_traces", "summarize", "format_summary", "main"]
+           "merge_traces", "summarize", "format_summary",
+           "format_flight", "main"]
 
 
 def find_xplanes(logdir: str) -> List[str]:
@@ -170,6 +171,54 @@ def format_summary(stats: Dict[str, dict]) -> str:
     return "\n".join(lines)
 
 
+def format_flight(dump: dict) -> str:
+    """Render a flight-recorder post-mortem (observability.flight) as a
+    step-time table with anomaly annotations, headed by the exception
+    and device-memory state — the operator's first read after an OOM."""
+    exc = dump.get("exception") or {}
+    ctx = dump.get("context") or {}
+    lines = [
+        f"flight dump: {exc.get('type', '?')} during "
+        f"{ctx.get('where', '?')} (pid {dump.get('pid', '?')})",
+        f"  message: {exc.get('message', '')[:200]}",
+    ]
+    for dev, stats in (dump.get("device_memory") or {}).items():
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
+        lines.append(
+            f"  {dev}: in_use="
+            f"{in_use / 1e9:.2f}GB" if in_use is not None else f"  {dev}:")
+        if peak is not None or limit is not None:
+            lines[-1] += (f" peak={peak / 1e9:.2f}GB" if peak else "") + \
+                         (f" limit={limit / 1e9:.2f}GB" if limit else "")
+    steps = dump.get("steps") or []
+    lines.append("")
+    lines.append(f"{'step':>6}{'wall_ms':>10}{'compile':>9}{'sig':>10}"
+                 f"{'queue':>7}{'h2d_ms':>8}{'mem_GB':>8}  anomaly")
+    for r in steps:
+        mem = r.get("mem_bytes_in_use")
+        note = r.get("anomaly", "")
+        if note and r.get("deviation") is not None:
+            note += f" ({r['deviation']}x sigma)"
+        lines.append(
+            f"{r.get('step', '?'):>6}{r.get('wall_ms', 0):>10.2f}"
+            f"{'yes' if r.get('compile') else '-':>9}"
+            f"{r.get('sig', '-'):>10}"
+            f"{str(r.get('queue_depth', '-')):>7}"
+            f"{str(r.get('h2d_ms', '-')):>8}"
+            f"{f'{mem / 1e9:.2f}' if mem is not None else '-':>8}"
+            f"  {note}")
+    events = dump.get("events") or []
+    if events:
+        lines.append("")
+        lines.append("events:")
+        for ev in events:
+            lines.append(f"  [{ev.get('level', '?')}] "
+                         f"{ev.get('message', '')[:160]}")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("traces", nargs="*",
@@ -183,9 +232,19 @@ def main(argv: Optional[List[str]] = None):
                          "(default timeline.json unless --summary only)")
     ap.add_argument("--summary", action="store_true",
                     help="print per-span totals sorted by total time")
+    ap.add_argument("--flight",
+                    help="render a flight-recorder dump JSON "
+                         "(observability.flight / PDTPU_FLIGHT_DIR) as a "
+                         "step-time table with anomaly annotations")
     args = ap.parse_args(argv)
-    if not args.traces and not args.logdir:
-        ap.error("give chrome-trace files and/or --logdir")
+    if not args.traces and not args.logdir and not args.flight:
+        ap.error("give chrome-trace files, --logdir, and/or --flight")
+
+    if args.flight:
+        with open(args.flight) as f:
+            print(format_flight(json.load(f)))
+        if not args.traces and not args.logdir:
+            return
 
     traces, names = [], []
     for path in args.traces:

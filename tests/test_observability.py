@@ -579,3 +579,389 @@ def test_serving_bench_dumps_metrics_and_trace(tmp_path):
         trace = json.load(f)
     assert any(e.get("name", "").startswith("serving/dispatch")
                for e in trace["traceEvents"])
+
+
+# -- prometheus exposition hardening ---------------------------------------
+
+def test_prometheus_text_sanitizes_names_and_escapes_labels():
+    """Hostile metric/label content (feed signatures, shapes) must not
+    break the exposition: names fold to the spec charset, label values
+    escape backslash/quote/newline."""
+    reg = obs.Registry()
+    reg.counter("steps/anomalies", reason="slow_step").inc()
+    reg.counter("9starts.with-digit").inc(2)
+    reg.counter("shape", sig='x:f32[8,128] "q" \\b\nnext').inc(3)
+    text = reg.prometheus_text()
+    assert 'steps_anomalies{reason="slow_step"} 1' in text
+    assert "_9starts_with_digit 2" in text
+    assert ('shape{sig="x:f32[8,128] \\"q\\" \\\\b\\nnext"} 3') in text
+    # every line is a comment or `name{...} value` — nothing unparseable
+    for line in text.splitlines():
+        assert line.startswith("#") or " " in line
+        if not line.startswith("#"):
+            name = line.split("{")[0].split(" ")[0]
+            assert name and (name[0].isalpha() or name[0] == "_")
+            assert all(c.isalnum() or c == "_" for c in name)
+
+
+# -- step profiler / straggler detection -----------------------------------
+
+def test_step_profiler_steady_stream_no_anomalies():
+    from paddle_tpu.observability.steps import StepProfiler
+
+    reg = obs.Registry()
+    prof = StepProfiler(window=64, registry=reg)
+    for _ in range(60):
+        rec = prof.record(10.0, program_id=1, sig="aa", sample_env=False)
+        assert "anomaly" not in rec
+    assert reg.counter("steps/total").value == 60
+    snap = reg.snapshot()
+    assert not any(k.startswith("steps/anomalies") for k in snap)
+
+
+def test_step_profiler_flags_straggler_with_deviation():
+    from paddle_tpu.observability.steps import StepProfiler
+
+    reg = obs.Registry()
+    prof = StepProfiler(window=64, registry=reg)
+    for _ in range(40):
+        prof.record(10.0, program_id=1, sig="aa", sample_env=False)
+    rec = prof.record(200.0, program_id=1, sig="aa", sample_env=False)
+    assert rec["anomaly"] == "slow_step"
+    assert rec["deviation"] > 6
+    assert reg.counter("steps/anomalies", reason="slow_step").value == 1
+    # the straggler also landed in the flight recorder's ring
+    contents = obs.get_flight_recorder().contents()
+    assert any(e.get("reason") == "slow_step" for e in contents["events"])
+    assert any(r.get("anomaly") == "slow_step" for r in contents["steps"])
+
+
+def test_step_profiler_baselines_are_per_stream():
+    """A slow eval program interleaved with a fast train program is NOT
+    a straggler — baselines key on (program, sig)."""
+    from paddle_tpu.observability.steps import StepProfiler
+
+    reg = obs.Registry()
+    prof = StepProfiler(window=128, registry=reg)
+    for _ in range(40):
+        prof.record(5.0, program_id=1, sig="train", sample_env=False)
+        rec = prof.record(50.0, program_id=2, sig="eval", sample_env=False)
+        assert "anomaly" not in rec
+
+
+def test_step_profiler_compile_excluded_then_recompile_flagged():
+    from paddle_tpu.observability.steps import StepProfiler
+
+    reg = obs.Registry()
+    prof = StepProfiler(window=64, registry=reg)
+    # first compile: baseline empty, not an anomaly
+    rec = prof.record(500.0, program_id=1, sig="aa", compiled=True,
+                      sample_env=False)
+    assert "anomaly" not in rec
+    for _ in range(30):
+        rec = prof.record(10.0, program_id=1, sig="aa", sample_env=False)
+        assert "anomaly" not in rec   # the 500ms compile didn't pollute it
+    # a compile AFTER a steady window is the classic mid-run straggler
+    rec = prof.record(500.0, program_id=1, sig="aa", compiled=True,
+                      sample_env=False)
+    assert rec["anomaly"] == "recompile"
+    assert reg.counter("steps/anomalies", reason="recompile").value == 1
+
+
+def test_executor_run_feeds_step_profiler():
+    import paddle_tpu as fluid
+    from paddle_tpu.observability.steps import get_step_profiler
+
+    prof = get_step_profiler()
+    step0 = prof.step
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    feed = {"x": np.zeros((2, 3), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[y])
+    exe.run(main, feed=feed, fetch_list=[y])
+    recs = prof.records()
+    assert prof.step >= step0 + 3   # startup + compile + hit
+    new = [r for r in recs if r["step"] > step0]
+    assert any(r["compile"] for r in new)
+    assert any(not r["compile"] for r in new)
+    assert all("wall_ms" in r and "sig" in r for r in new)
+
+
+# -- flight recorder -------------------------------------------------------
+
+def test_is_oom_markers_and_types():
+    from paddle_tpu.observability import flight
+
+    assert flight.is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of HBM"))
+    assert flight.is_oom(ValueError("Out of memory while allocating"))
+    assert not flight.is_oom(ValueError("shape mismatch"))
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert flight.is_oom(XlaRuntimeError("anything"))
+
+
+def test_flight_guard_dumps_on_injected_oom_and_reraises(
+        tmp_path, monkeypatch):
+    """THE acceptance property: a RESOURCE_EXHAUSTED raised inside
+    Executor.run produces a post-mortem dump (step records, registry
+    snapshot, device memory, forensic sections) and the original
+    exception propagates unchanged."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import executor as executor_mod
+
+    monkeypatch.setenv("PDTPU_FLIGHT_DIR", str(tmp_path))
+    rec = obs.get_flight_recorder()
+    rec.reset()
+
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    feed = {"x": np.zeros((2, 3), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[y])   # steady steps in the ring
+
+    boom = RuntimeError("RESOURCE_EXHAUSTED: fake OOM for test")
+
+    def explode(self, state, fd, key):
+        raise boom
+
+    monkeypatch.setattr(executor_mod._AutoLayoutStep, "__call__", explode)
+    with pytest.raises(RuntimeError) as ei:
+        exe.run(main, feed=feed, fetch_list=[y])
+    assert ei.value is boom   # unchanged, not wrapped
+
+    dumps = sorted(tmp_path.glob("flight_*.json"))
+    assert len(dumps) == 1
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    assert dump["exception"]["type"] == "RuntimeError"
+    assert "RESOURCE_EXHAUSTED" in dump["exception"]["message"]
+    assert dump["context"]["where"] == "Executor.run"
+    assert dump["steps"], "ring of step records missing"
+    assert "registry" in dump and "device_memory" in dump
+    assert "compiled_signatures" in dump["sections"]
+    assert rec.last_dump_path == str(dumps[0])
+
+
+def test_flight_guard_ignores_non_oom_errors(tmp_path, monkeypatch):
+    monkeypatch.setenv("PDTPU_FLIGHT_DIR", str(tmp_path))
+    rec = obs.get_flight_recorder()
+    rec.reset()
+    with pytest.raises(ValueError):
+        with rec.guard("test/site"):
+            raise ValueError("shape mismatch")
+    assert not list(tmp_path.glob("flight_*.json"))
+    assert rec.last_dump is None
+
+
+def test_flight_dump_section_errors_captured_inline(monkeypatch):
+    from paddle_tpu.observability import flight
+
+    flight.register_dump_section("broken", lambda: 1 / 0)
+    try:
+        rec = flight.FlightRecorder(step_cap=4)
+        rec.record_failure(RuntimeError("RESOURCE_EXHAUSTED: x"))
+        assert "ZeroDivisionError" in \
+            rec.last_dump["sections"]["broken"]["error"]
+    finally:
+        flight.unregister_dump_section("broken")
+
+
+# -- HTTP introspection plane ----------------------------------------------
+
+def _http_get(url):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def introspection():
+    from paddle_tpu.observability import http as ihttp
+    srv = ihttp.IntrospectionServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+def test_http_metrics_endpoints(introspection):
+    from paddle_tpu.observability.steps import get_step_profiler
+
+    get_step_profiler().record(1.0, program_id=7, sig="sg",
+                               sample_env=False)
+    code, body = _http_get(introspection.url + "/metrics")
+    assert code == 200
+    assert "# TYPE steps_total counter" in body
+    assert "steps_wall_ms_count" in body
+    code, body = _http_get(introspection.url + "/metrics.json")
+    assert code == 200
+    snap = json.loads(body)
+    assert snap["steps/total"] >= 1
+
+
+def test_http_debug_and_404(introspection):
+    from paddle_tpu.observability.steps import get_step_profiler
+
+    for _ in range(3):
+        get_step_profiler().record(2.0, program_id=9, sig="dd",
+                                   sample_env=False)
+    code, body = _http_get(introspection.url + "/debug/steps?n=2")
+    assert code == 200
+    assert len(json.loads(body)["records"]) == 2
+    code, body = _http_get(introspection.url + "/debug/flight")
+    assert code == 200
+    flight = json.loads(body)
+    assert {"steps", "events", "last_dump_path", "last_dump"} <= set(flight)
+    code, _ = _http_get(introspection.url + "/nope")
+    assert code == 404
+
+
+def test_healthz_aggregation_and_503(introspection):
+    from paddle_tpu.observability import http as ihttp
+
+    code, body = _http_get(introspection.url + "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "ok"
+    ihttp.register_health_check("t/degraded", lambda: ("degraded", "warm"))
+    try:
+        code, body = _http_get(introspection.url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "degraded"
+        ihttp.register_health_check("t/dead", lambda: 1 / 0)
+        code, body = _http_get(introspection.url + "/healthz")
+        assert code == 503
+        parsed = json.loads(body)
+        assert parsed["status"] == "failing"
+        assert "ZeroDivisionError" in parsed["checks"]["t/dead"]["detail"]
+    finally:
+        ihttp.unregister_health_check("t/degraded")
+        ihttp.unregister_health_check("t/dead")
+
+
+def test_serve_introspection_idempotent_and_env(monkeypatch):
+    from paddle_tpu.observability import http as ihttp
+
+    ihttp.stop_introspection()
+    try:
+        srv = ihttp.serve_introspection(0)
+        assert srv.port > 0
+        assert ihttp.serve_introspection(0) is srv
+        # env-driven startup path used by Executor / InferenceServer
+        monkeypatch.setenv("PDTPU_INTROSPECT_PORT", str(srv.port))
+        assert ihttp.maybe_serve_from_env() is srv
+        code, _ = _http_get(srv.url + "/metrics")
+        assert code == 200
+    finally:
+        ihttp.stop_introspection()
+    monkeypatch.delenv("PDTPU_INTROSPECT_PORT")
+    assert ihttp.maybe_serve_from_env() is None
+
+
+# -- serving health checks -------------------------------------------------
+
+def test_serving_registers_and_unregisters_health_checks(predictor):
+    from paddle_tpu import serving
+    from paddle_tpu.observability import http as ihttp
+
+    srv = serving.InferenceServer(predictor, num_workers=1)
+    srv.start()
+    try:
+        names = list(srv._health_names)
+        assert sorted(n.rsplit("/", 1)[1] for n in names) == \
+            ["deadlines", "queue", "workers"]
+        overall, detail = ihttp.run_health_checks()
+        assert overall == "ok"
+        for n in names:
+            assert detail[n]["status"] == "ok"
+        # a genuinely served request keeps deadlines ok
+        out = srv.submit({"x": np.zeros((2, IN_DIM), np.float32)}).result(30)
+        assert out[0].shape == (2, 3)
+    finally:
+        srv.stop()
+    _, detail = ihttp.run_health_checks()
+    assert not any(n in detail for n in names)
+
+
+# -- bench subprocess isolation --------------------------------------------
+
+def test_bench_section_subprocess_forced_oom(tmp_path, monkeypatch):
+    """The isolation contract: a forced RESOURCE_EXHAUSTED inside one
+    bench section exits only that child; the parent records the error
+    AND the path of the flight dump the child wrote."""
+    import bench
+
+    monkeypatch.setenv("PDTPU_BENCH_FORCE_OOM", "ring_attn")
+    monkeypatch.setenv("PDTPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    extras = {}
+    result, errrec = bench._run_section_subprocess(
+        "ring_attn", extras, timeout=600)
+    assert result is None
+    assert "RESOURCE_EXHAUSTED" in errrec["error"]
+    assert errrec["flight_dump"] is not None
+    assert errrec["flight_dump"].startswith(str(tmp_path))
+    with open(errrec["flight_dump"]) as f:
+        dump = json.load(f)
+    assert dump["context"]["where"] == "bench/ring_attn"
+    assert "RESOURCE_EXHAUSTED" in dump["exception"]["message"]
+
+
+# -- timeline --flight renderer --------------------------------------------
+
+def test_timeline_renders_flight_dump(tmp_path, capsys):
+    from paddle_tpu.tools import timeline
+
+    dump = {
+        "pid": 123,
+        "exception": {"type": "XlaRuntimeError",
+                      "message": "RESOURCE_EXHAUSTED: 1.5G over"},
+        "context": {"where": "Executor.run"},
+        "device_memory": {"TPU_0": {"bytes_in_use": 15_000_000_000,
+                                    "peak_bytes_in_use": 15_800_000_000,
+                                    "bytes_limit": 16_000_000_000}},
+        "steps": [
+            {"step": 41, "wall_ms": 12.5, "compile": False, "sig": "ab12",
+             "queue_depth": 3, "h2d_ms": 0.4,
+             "mem_bytes_in_use": 14_000_000_000},
+            {"step": 42, "wall_ms": 480.0, "compile": False, "sig": "ab12",
+             "anomaly": "slow_step", "deviation": 92.1},
+        ],
+        "events": [{"level": "warning", "message": "slow step: step=42"}],
+    }
+    path = tmp_path / "flight.json"
+    path.write_text(json.dumps(dump))
+    timeline.main(["--flight", str(path)])
+    out = capsys.readouterr().out
+    assert "XlaRuntimeError during Executor.run (pid 123)" in out
+    assert "RESOURCE_EXHAUSTED" in out
+    assert "slow_step (92.1x sigma)" in out
+    assert "15.00GB" in out and "limit=16.00GB" in out
+    assert "slow step: step=42" in out
+
+
+def test_serving_bench_with_introspection_scrape(tmp_path):
+    from paddle_tpu.core import program as prog_mod
+    from paddle_tpu.observability import http as ihttp
+    from paddle_tpu.tools import serving_bench as sb
+
+    ihttp.stop_introspection()
+    mpath = str(tmp_path / "m.json")
+    old = prog_mod._main_program, prog_mod._startup_program
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    try:
+        rc = sb.main(["--requests", "8", "--concurrency", "4",
+                      "--buckets", "2,4", "--batch-delay-ms", "1",
+                      "--in-dim", "6", "--hidden", "8", "--layers", "1",
+                      "--skip-sequential", "--introspect-port", "0",
+                      "--metrics-out", mpath])
+    finally:
+        prog_mod._main_program, prog_mod._startup_program = old
+        ihttp.stop_introspection()
+    assert rc == 0
+    with open(mpath) as f:
+        loaded = json.load(f)
+    scrape = loaded["bench/introspection"]
+    assert scrape["/metrics"]["status"] == 200
+    assert scrape["/metrics"]["bytes"] > 0
+    assert scrape["/healthz"]["status"] == 200
