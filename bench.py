@@ -510,6 +510,30 @@ def bench_deepfm(on_tpu, floors=None):
         exe.run(startup)
         dt = _time_steps(exe, main_p, feed, loss, 48 if on_tpu else 2)
 
+    # scan-driver path: the same program driven by Executor.train_scanned
+    # — K-step on-device lax.scan dispatches fed from the DeviceLoader
+    # prefetch queue, fused sparse-Adagrad kernel active on TPU. This is
+    # the configuration the 400k ex/s target is scored on.
+    scan_k = 16
+    n_scan = scan_k * (6 if on_tpu else 2)
+    dt_scan, scan_err = None, None
+    from paddle_tpu.observability.registry import get_registry
+    fused_before = get_registry().counter(
+        "optimizer/fused_sparse_updates").value
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+            # first pass compiles the scan; second is the measurement
+            exe.train_scanned(main_p, reader=lambda: iter([feed] * n_scan),
+                              scan_steps=scan_k, fetch_list=[loss])
+            t0 = time.time()
+            exe.train_scanned(main_p, reader=lambda: iter([feed] * n_scan),
+                              scan_steps=scan_k, fetch_list=[loss])
+            dt_scan = (time.time() - t0) / n_scan
+    except Exception as e:
+        scan_err = str(e)[:160]
+
     # the naive-lowering A/B on the same chip: dense adagrad kernels,
     # f32 tables, XLA scatter applies (what a literal translation pays)
     naive_ms = None
@@ -531,8 +555,11 @@ def bench_deepfm(on_tpu, floors=None):
     # actual traffic of the packed path: one [128]-lane u16 row gather +
     # one row scatter-set per touched row + dense net (noise)
     actual_bytes = 2 * batch * 26 * 128 * 2 + gather_bytes
+    # headline rate is the best path (scan driver when it wins); the
+    # per-step dispatch time stays visible in the roofline dict
+    best = min(dt, dt_scan) if dt_scan else dt
     mm_tflops, stream_gbs = floors or _measure_floors(on_tpu)
-    achieved_gbs = bytes_total / dt / 1e9
+    achieved_gbs = bytes_total / best / 1e9
     roofline = {
         "vocab": vocab,
         "optimizer": "adagrad (exact, packed row-major state-in-row)",
@@ -541,11 +568,90 @@ def bench_deepfm(on_tpu, floors=None):
         "effective_gbs": round(achieved_gbs, 1),
         "stream_gbs_meas": round(stream_gbs, 1),
         "naive_adagrad_step_ms": naive_ms,
-        "speedup_vs_naive": (round(naive_ms / (dt * 1e3), 2)
+        "speedup_vs_naive": (round(naive_ms / (best * 1e3), 2)
                              if naive_ms else None),
         "frac": round(min(1.0, achieved_gbs / stream_gbs), 4),
+        "per_step_dispatch_ms": round(dt * 1e3, 2),
+        "scan_step_ms": round(dt_scan * 1e3, 2) if dt_scan else None,
+        "scan_k": scan_k,
+        # nonzero ⇔ the fused Pallas sparse-Adagrad path actually compiled
+        "fused_sparse_updates": int(get_registry().counter(
+            "optimizer/fused_sparse_updates").value - fused_before),
     }
-    return round(batch / dt, 1), round(dt * 1e3, 2), roofline
+    if scan_err:
+        roofline["scan_error"] = scan_err
+    return round(batch / best, 1), round(best * 1e3, 2), roofline
+
+
+def bench_dispatch_overhead(on_tpu):
+    """Per-step HOST overhead at batch-1 on a trivial train program, for
+    the three dispatch strategies: `run` (one Python dispatch per step),
+    `run_batched` (host-stacked K-step scan), and the `train_scanned`
+    driver (DeviceLoader-fed K-step scan). The program body is one tiny
+    fc+SGD update, so device compute is ~0 and wall/step ≈ what the host
+    charges per step. Target: the scan driver's per-step cost < 5% of the
+    per-step `run` cost (K amortizes dispatch, prefetch hides staging)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    k = 32
+    reps = 4 if on_tpu else 2
+    n = k * reps
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.fc(x, size=4)
+        loss = layers.reduce_mean(y * y)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    feed = {"x": np.ones((1, 4), dtype=np.float32)}
+    exe = fluid.Executor(fluid.TPUPlace())
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        run_s = _time_steps(exe, main_p, feed, loss, n)
+
+        # run_batched: warm the K-step scan executable, then time reps
+        # dispatches (same total step count as the run() loop)
+        exe.run_batched(main_p, [feed] * k, fetch_list=[loss],
+                        return_numpy=False)
+        t0 = time.time()
+        out = None
+        for _ in range(reps):
+            out = exe.run_batched(main_p, [feed] * k, fetch_list=[loss],
+                                  return_numpy=False)
+        np.asarray(out[0])
+        batched_s = (time.time() - t0) / n
+
+        # train_scanned: epoch of n feeds in K-step drains; first call
+        # compiles, second is the measurement
+        exe.train_scanned(main_p, reader=lambda: iter([feed] * n),
+                          scan_steps=k, fetch_list=[loss])
+        t0 = time.time()
+        exe.train_scanned(main_p, reader=lambda: iter([feed] * n),
+                          scan_steps=k, fetch_list=[loss])
+        scan_s = (time.time() - t0) / n
+
+    return {
+        "k": k,
+        "steps_timed": n,
+        "run_us_per_step": round(run_s * 1e6, 1),
+        "run_batched_us_per_step": round(batched_s * 1e6, 1),
+        "scan_driver_us_per_step": round(scan_s * 1e6, 1),
+        # the acceptance metric: scan-driver per-step host cost as a
+        # percentage of the per-step dispatch path it replaces
+        "scan_overhead_pct_of_run": round(100.0 * scan_s / run_s, 2),
+        "run_batched_pct_of_run": round(100.0 * batched_s / run_s, 2),
+        # the loader/staging cost the driver adds over a bare host-stacked
+        # scan (run_batched) — the part peek_many is responsible for
+        "scan_incremental_us_vs_batched": round((scan_s - batched_s) * 1e6,
+                                                1),
+        # On CPU the trivial step still costs ~100+ us of XLA compute per
+        # step in EVERY strategy, so the pct is compute- not
+        # dispatch-dominated; the <5% acceptance reading is the TPU run,
+        # where this program's device time is ~0 and wall ≈ host overhead.
+        "note": None if on_tpu else "cpu: pct dominated by per-step "
+                                    "compute, not host dispatch",
+    }
 
 
 def _nmt_flops_per_batch(cfg, B, Ts, Tt):
@@ -823,6 +929,14 @@ def main():
     extras2["deepfm_vs_baseline"] = (dfm_roofline or {}).get("frac")
     extras2["deepfm_roofline"] = dfm_roofline
     _end_section(extras2, "deepfm")
+
+    # host dispatch-overhead microbenchmark (ROADMAP item 4: <5% at
+    # batch-1): run vs run_batched vs the train_scanned driver
+    try:
+        extras2["dispatch_overhead"] = bench_dispatch_overhead(on_tpu)
+    except Exception as e:  # pragma: no cover
+        extras2["dispatch_overhead"] = {"error": str(e)[:120]}
+    _end_section(extras2, "dispatch_overhead")
     rate = ms = nmt_mfu = nb = err = None
     nmt_shapes = None
     # subprocess isolation: the child's allocator (and any OOM ceiling it

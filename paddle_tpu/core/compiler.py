@@ -269,6 +269,15 @@ class CompiledProgram:
             return NamedSharding(self._mesh, P(self._data_axis, seq))
         return NamedSharding(self._mesh, P(self._data_axis))
 
+    def _stacked_feed_sharding(self, ndim: Optional[int] = None):
+        """Sharding for a K-step scan feed buffer ([K, ...] stacked
+        per-step feeds, as built by `Executor.run_batched` /
+        `DeviceLoader.peek_many`): the leading scan axis stays replicated,
+        the per-step dims shard exactly as `_feed_sharding` would shard a
+        single step's feed."""
+        per_step = self._feed_sharding(None if ndim is None else ndim - 1)
+        return NamedSharding(self._mesh, P(None, *per_step.spec))
+
     def _grad_shard_fn(self):
         """Stage2: trace-time hook constraining each parameter gradient to
         the ZeRO layout of its parameter, so XLA emits a reduce-scatter for

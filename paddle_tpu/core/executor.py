@@ -1149,8 +1149,6 @@ class Executor:
         across all N steps (donated, no per-step relayout) and feeds shard
         over the data axis per step.
         """
-        import jax as _jax
-        from jax import lax as _lax
         from .compiler import CompiledProgram
 
         compiled = program if isinstance(program, CompiledProgram) else None
@@ -1163,24 +1161,6 @@ class Executor:
         if not feed_list:
             raise ValueError("run_batched: empty feed_list")
         n = len(feed_list)
-        epilogues = getattr(program, "_epilogue_programs", None) or []
-        for every, *_rest in epilogues:
-            if n > every:
-                raise ValueError(
-                    f"run_batched: {n} steps per dispatch exceeds the "
-                    f"maintenance-epilogue interval {every} — the "
-                    f"deferred-update log would overflow mid-scan")
-        if epilogues:
-            # a fold is a pure representation change (safe any time):
-            # run it early if this batch would not fit in the log
-            sc = scope or _scope()
-            for i, entry in enumerate(epilogues):
-                every, eprog, meta = (entry if len(entry) == 3
-                                      else (*entry, None))
-                pend, key, _ = self._epilogue_pending(program, sc, i, meta)
-                if pend[key] + n > every:
-                    self._run_epilogue(eprog, sc, compiled)
-                    pend[key] = 0
         fetch_list = list(fetch_list or [])
         scope = scope or _scope()
         fetch_names = [f.name if isinstance(f, Variable) else f for f in fetch_list]
@@ -1200,13 +1180,48 @@ class Executor:
         keys = sorted(feeds_conv[0])
         stacked = {k: jnp.stack([jnp.asarray(fd[k]) for fd in feeds_conv])
                    for k in keys}
+        return self._run_scan(program, compiled, stacked, n, fetch_names,
+                              scope, return_numpy)
+
+    def _run_scan(self, program, compiled, stacked, n, fetch_names, scope,
+                  return_numpy, site="Executor.run_batched"):
+        """Dispatch one ON-DEVICE scan of `n` steps over pre-stacked feeds.
+
+        The shared engine behind `run_batched` (host-stacked feed lists)
+        and `train_scanned` (DeviceLoader-staged K-step buffers): compiles
+        `lax.scan` over the jitted step once per (program, n, signature),
+        donates the carried state, and reports ONE aggregate profiler
+        record per drain — no Python, no h2d sync, and no per-step gauge
+        sampling inside the loop body.
+        """
+        import jax as _jax
+        from jax import lax as _lax
+
+        epilogues = getattr(program, "_epilogue_programs", None) or []
+        for every, *_rest in epilogues:
+            if n > every:
+                raise ValueError(
+                    f"{site}: {n} steps per dispatch exceeds the "
+                    f"maintenance-epilogue interval {every} — the "
+                    f"deferred-update log would overflow mid-scan")
+        if epilogues:
+            # a fold is a pure representation change (safe any time):
+            # run it early if this batch would not fit in the log
+            for i, entry in enumerate(epilogues):
+                every, eprog, meta = (entry if len(entry) == 3
+                                      else (*entry, None))
+                pend, key, _ = self._epilogue_pending(program, scope, i, meta)
+                if pend[key] + n > every:
+                    self._run_epilogue(eprog, scope, compiled)
+                    pend[key] = 0
+        keys = sorted(stacked)
 
         state_names = sorted({v.name for v in program.list_vars()
                               if v.persistable})
         missing = [nm for nm in state_names if scope.find_var(nm) is None]
         if missing:
             raise ValueError(
-                f"run_batched needs every persistable in scope (run the "
+                f"{site} needs every persistable in scope (run the "
                 f"startup program and one plain run first); missing: "
                 f"{missing[:5]}")
         stacked_sig = feed_signature(stacked)
@@ -1256,8 +1271,7 @@ class Executor:
                 state_sh = {nm: compiled._state_sharding(nm)
                             for nm in state_names}
                 feed_sh = {
-                    k: _NS(mesh, _P(None, *compiled._feed_sharding(
-                        stacked[k].ndim - 1).spec))
+                    k: compiled._stacked_feed_sharding(stacked[k].ndim)
                     for k in keys}
                 fn = _jax.jit(
                     scan_fn,
@@ -1304,11 +1318,11 @@ class Executor:
         if key is None:
             key = _make_key(program.random_seed or 0)
         t0 = time.perf_counter()
-        with _FLIGHT.guard("Executor.run_batched",
+        with _FLIGHT.guard(site,
                            program=f"0x{id(program):x}",
                            sig=_sig_digest(stacked_sig), steps=n,
                            compiling=compiling), \
-                trace_span("executor/run_batched", steps=n,
+                trace_span(site.replace("Executor.", "executor/"), steps=n,
                            sig=_sig_digest(stacked_sig)):
             ys, new_state, new_key = fn(state, stacked, key)
         dt_ms = (time.perf_counter() - t0) * 1e3
@@ -1397,6 +1411,88 @@ class Executor:
             if pend[key] >= every:
                 self._run_epilogue(eprog, scope, compiled)
                 pend[key] = 0
+
+    def train_scanned(self, program=None, reader=None, scan_steps: int = 16,
+                      fetch_list=None, scope=None, capacity=None):
+        """On-device training driver: the whole epoch runs as K-step
+        `lax.scan` dispatches with ZERO per-step Python.
+
+        The full TPU analog of the reference's in-C++ trainer loop
+        (Executor::RunFromDataset → hogwild_worker.cc:163): the host's
+        only jobs are feeding batches through `DeviceLoader`'s prefetch
+        queue — pre-staged into a device-resident K-step feed buffer via
+        `peek_many` — and draining scalar fetches once per K steps. Step
+        compute, the optimizer, and the RNG walk all stay inside one
+        compiled scan; the profiler sees one aggregate record per drain
+        (wall/K = per-step time), and the flight recorder one
+        `Executor.train_scanned` dispatch site with `steps=K`.
+
+        reader: callable returning an iterable of feed dicts, or a plain
+          iterable (one epoch). Feeds must share shapes/dtypes.
+        scan_steps: K, the steps fused per dispatch. Metrics/losses are
+          only observable at K-step granularity; with deferred-row
+          epilogues K must not exceed the fold cadence. A short final
+          drain (epoch length not divisible by K) compiles one extra
+          scan length.
+        capacity: DeviceLoader queue depth (default max(2, K)).
+
+        Accepts a CompiledProgram (state stays in the compiled layout
+        across drains, donated between them). Requires every persistable
+        in scope — run the startup program and one plain `run` first.
+
+        Returns a list of per-fetch np arrays of shape [num_steps, ...]
+        (all drains concatenated), or the step count when `fetch_list`
+        is empty.
+        """
+        from .compiler import CompiledProgram
+        from ..dataio.loader import DeviceLoader
+
+        program = program or default_main_program()
+        compiled = program if isinstance(program, CompiledProgram) else None
+        if compiled is not None:
+            if compiled._mesh is None:
+                compiled.with_data_parallel()
+            program = compiled._program
+        if reader is None:
+            raise ValueError("train_scanned: a reader (callable returning "
+                             "an iterable of feed dicts) is required")
+        k = int(scan_steps)
+        if k < 1:
+            raise ValueError(f"train_scanned: scan_steps must be >= 1, "
+                             f"got {scan_steps}")
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+        scope = scope or _scope()
+        loader = DeviceLoader(reader,
+                              capacity=max(2, capacity or k),
+                              program=program, name="train_scanned")
+        self._loaders.add(loader)
+        loader.start()
+        drains = []
+        pending = None  # keep ONE drain's fetches un-synced behind dispatch
+        total = 0
+        try:
+            while True:
+                stacked, m = loader.peek_many(k)
+                if m == 0:
+                    break
+                ys = self._run_scan(program, compiled, stacked, m,
+                                    fetch_names, scope, return_numpy=False,
+                                    site="Executor.train_scanned")
+                total += m
+                if pending is not None:
+                    drains.append([np.asarray(y) for y in pending])
+                pending = ys
+        finally:
+            loader.close()
+            self._loaders.discard(loader)
+        if pending is not None:
+            drains.append([np.asarray(y) for y in pending])
+        if not fetch_names:
+            return total
+        return [np.concatenate([d[i] for d in drains], axis=0)
+                for i in range(len(fetch_names))]
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,

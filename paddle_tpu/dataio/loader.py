@@ -202,6 +202,65 @@ class DeviceLoader:
                 self._thread = None
                 self._closed = True
 
+    # -- stacked K-step feeds (Executor.train_scanned) ---------------------
+    def peek_many(self, k: int):
+        """Pull up to `k` prefetched device batches and return them as ONE
+        stacked feed dict ``{name: [m, ...] device array}`` plus ``m``, the
+        number of batches actually pulled (``m < k`` only at end of epoch;
+        ``({}, 0)`` once exhausted).
+
+        This is the scan driver's fill path: the stack happens on already
+        device-resident arrays (one fused concat on device, no per-batch
+        Python destacking in the consumer), so the result is the K-step
+        feed buffer `lax.scan` consumes directly. Worker errors re-raise
+        here exactly like `__iter__`, and exhaustion tears the worker down
+        with the same stop-event/join lifecycle as `_drain`.
+        """
+        import jax.numpy as jnp
+
+        if k < 1:
+            raise ValueError(f"peek_many: k must be >= 1, got {k}")
+        q, stop, thread = self._queue, self._stop, self._thread
+        if q is None or (self._closed
+                         and (thread is None or not thread.is_alive())):
+            # epoch already exhausted or loader closed: nothing will ever
+            # arrive on the queue again — don't block on it
+            return {}, 0
+        batches = []
+        ended = False
+        try:
+            while len(batches) < k:
+                item = q.get()
+                _QUEUE_DEPTH.set(q.qsize())
+                if item is _EndOfEpoch:
+                    ended = True
+                    break
+                if isinstance(item, _WorkerError):
+                    ended = True
+                    raise item.exc
+                batches.append(item)
+        finally:
+            if ended:
+                # same teardown as _drain's finally: the worker must not
+                # outlive the epoch, and a later peek_many returns (_, 0)
+                stop.set()
+                if thread is not None:
+                    thread.join(timeout=5)
+                if self._thread is thread:
+                    self._thread = None
+                    self._closed = True
+        if not batches:
+            return {}, 0
+        keys0 = set(batches[0])
+        for i, b in enumerate(batches[1:], start=1):
+            if set(b) != keys0:
+                raise ValueError(
+                    f"peek_many: batch {i} key set {sorted(b)} does not "
+                    f"match batch 0's {sorted(keys0)}")
+        stacked = {name: jnp.stack([b[name] for b in batches])
+                   for name in sorted(keys0)}
+        return stacked, len(batches)
+
     # -- shutdown ----------------------------------------------------------
     def close(self) -> None:
         """Tear down the prefetch thread and drop queued device batches.

@@ -26,6 +26,15 @@ increment ``steps/anomalies{reason=...}`` and log one structured
 warning line naming the step and its deviation.
 
 Window size: ``PDTPU_STEP_WINDOW`` (default 512).
+
+Environment sampling is rate-limited: gauge reads are cheap but
+``device_memory_stats`` is a runtime call, and at deepfm's ~1 ms steps
+sampling on every dispatch measurably slowed the hot loop (BENCH_r05's
+0.957x regression vs r04). One dispatch in ``PDTPU_STEP_SAMPLE_EVERY``
+(default 16) takes a fresh sample; the others stamp the cached values,
+so every record still carries the environment fields at the cost of up
+to 15 dispatches of staleness. The first record after construction or
+``reset()`` always samples fresh.
 """
 from __future__ import annotations
 
@@ -67,11 +76,29 @@ class StepProfiler:
         self._baselines: "collections.OrderedDict[tuple, Deque[float]]" = \
             collections.OrderedDict()
         self._step = 0
+        self._sample_every = max(
+            1, int(os.environ.get("PDTPU_STEP_SAMPLE_EVERY", "16")))
+        self._sample_tick = 0
+        self._env_cache: dict = {}
 
     # -- environment sampling ---------------------------------------------
     def _sample_environment(self, rec: dict) -> None:
         """Pull dataio / fetch / memory context other layers already
-        published; cheap gauge reads, all best-effort."""
+        published. A fresh sample runs once per `_sample_every` records
+        (the tick is a plain int — a rare racy double-sample is harmless);
+        in between, records get the cached fields, keeping the hot-loop
+        cost O(1) dict-update."""
+        tick = self._sample_tick
+        self._sample_tick = tick + 1
+        if tick % self._sample_every:
+            rec.update(self._env_cache)
+            return
+        env: dict = {}
+        self._sample_fresh(env)
+        self._env_cache = env
+        rec.update(env)
+
+    def _sample_fresh(self, rec: dict) -> None:
         reg = self._reg
         try:
             if reg.counter("dataio/batches").value > 0:
@@ -186,6 +213,8 @@ class StepProfiler:
             self._records.clear()
             self._baselines.clear()
             self._step = 0
+            self._sample_tick = 0
+            self._env_cache = {}
 
 
 def _median_sigma(samples) -> tuple:

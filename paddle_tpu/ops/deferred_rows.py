@@ -51,8 +51,16 @@ from jax import lax
 
 from ..core.registry import register_op
 from ..core.selected_rows import SelectedRows
+from ..observability.registry import get_registry
+from .pallas_kernels import sparse_adagrad as _fused_adagrad
 
 SENTINEL = 2**31 - 1
+
+# Trace-time counter (one inc per compile of a program that took the fused
+# branch): lets tests and production assert the Pallas path did not silently
+# deactivate — an env flip or a shape outside `supports()` would otherwise
+# degrade deepfm back to the scatter path with no signal.
+_FUSED_SPARSE = get_registry().counter("optimizer/fused_sparse_updates")
 
 
 # ---------------------------------------------------------------------------
@@ -457,10 +465,30 @@ def _adagrad_row_packed(ctx, inputs, attrs):
     """adagrad_op.cc SparseAdagradFunctor on a packed table: G rides in
     the state columns; touched rows advance G += g^2,
     p -= lr*g/(sqrt(G)+eps); one gather (forward, reused) + one
-    scatter-set per step."""
+    scatter-set per step.
+
+    When the fused Pallas kernel is available (TPU, or the interpreter
+    under test) and the op was not built with ``fused=False``, the whole
+    gather→update→scatter round trip collapses into one
+    `sparse_adagrad.fused_adagrad_update` pass: the kernel reads each
+    touched packed row straight from the table (same bytes FwdRows was
+    gathered from — the table is unmodified between forward and
+    optimizer within a step), applies the identical Adagrad math, and
+    writes it back through an input/output alias instead of an XLA
+    scatter. Bitwise-identical to the branch below."""
     (p,) = inputs["Param"]
-    uids, utot, cur_u, valid, vis, dt = _packed_common(inputs, attrs)
     eps = attrs.get("epsilon", 1e-6)
+    vis = int(attrs["vis"])
+    if attrs.get("fused", True) and _fused_adagrad.enabled(vis, p.shape[-1]):
+        (g,) = inputs["Grad"]
+        r = int(attrs["rows_per_step"])
+        ids, grows = _grad_rows(g)
+        uids, utot, _rep = uniq_merge(
+            ids, grows[:, :vis].astype(jnp.float32), r)
+        _FUSED_SPARSE.inc()
+        return {"ParamOut": [_fused_adagrad.fused_adagrad_update(
+            p, uids, utot, _lr(inputs), vis=vis, eps=eps)]}
+    uids, utot, cur_u, valid, vis, dt = _packed_common(inputs, attrs)
     g_new = cur_u[:, vis:2 * vis] + utot * utot
     p_new = cur_u[:, :vis] - _lr(inputs) * utot / (jnp.sqrt(g_new) + eps)
     rows = jnp.where(valid, jnp.concatenate([p_new, g_new], axis=-1),

@@ -1,0 +1,229 @@
+"""Fused gather-Adagrad-scatter Pallas kernel
+(ops/pallas_kernels/sparse_adagrad.py), run through the Pallas
+interpreter so tier-1 (JAX_PLATFORMS=cpu) exercises the real kernel.
+
+Contract: exact vs the unfused `adagrad_row_packed` branch — same
+uniq-merge, same update expression — on random row sets including
+duplicate ids and SENTINEL padding. "Exact" means: untouched rows are
+bitwise-identical, touched-row payloads agree to <= 1 ULP (XLA is free
+to FMA-contract `accum + u*u` — single rounding — in one compilation
+and not the other, and which choice it makes varies with array shape
+and surrounding graph; `optimization_barrier`/bitcast round-trips do
+NOT pin it, verified empirically on XLA:CPU), and the end-to-end packed
+program is bitwise-identical fused vs unfused at the width it uses.
+The `optimizer/fused_sparse_updates` counter proves the fused path
+actually compiled (guards against silent deactivation).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.initializer import RowPackInitializer
+from paddle_tpu.observability.registry import get_registry
+from paddle_tpu.ops import deferred_rows as dr
+from paddle_tpu.ops.pallas_kernels import sparse_adagrad as fsa
+from paddle_tpu.param_attr import ParamAttr
+
+
+@pytest.fixture
+def interpret_kernel():
+    old = fsa.FORCE_PALLAS_INTERPRET
+    fsa.FORCE_PALLAS_INTERPRET = True
+    yield
+    fsa.FORCE_PALLAS_INTERPRET = old
+
+
+def _random_case(seed, v, vis, q, r):
+    """A packed table + a step's worth of SelectedRows-style grad rows
+    (duplicates expected for q > v or by chance)."""
+    rng = np.random.RandomState(seed)
+    dt = 2 * vis
+    dense = rng.randn(v, dt).astype(np.float32)
+    dense[:, vis:] = np.abs(dense[:, vis:])  # accumulator columns >= 0
+    table = dr.pack_rows(jnp.asarray(dense))
+    ids = jnp.asarray(rng.randint(0, v, size=q), jnp.int32)
+    grows = jnp.asarray(rng.randn(q, vis).astype(np.float32))
+    return table, ids, grows
+
+
+def _paths(v, vis, r, lr=0.05, eps=1e-6):
+    """(unfused, fused) jitted update functions with identical merge —
+    the exact pair of branches inside `_adagrad_row_packed`."""
+    dt = 2 * vis
+
+    @jax.jit
+    def unfused(p, ids, grows):
+        uids, utot, _rep = dr.uniq_merge(ids, grows, r)
+        flat = dr.unpack_rows(p, dt)
+        cur_u = flat[jnp.clip(uids, 0, v - 1)]
+        valid = (uids != dr.SENTINEL)[:, None]
+        g_new = cur_u[:, vis:2 * vis] + utot * utot
+        p_new = cur_u[:, :vis] - lr * utot / (jnp.sqrt(g_new) + eps)
+        rows = jnp.where(valid, jnp.concatenate([p_new, g_new], -1),
+                         cur_u[:, :2 * vis])
+        return p.at[uids].set(dr.pack_rows(rows), mode="drop",
+                              unique_indices=True)
+
+    @jax.jit
+    def fused(p, ids, grows):
+        uids, utot, _rep = dr.uniq_merge(ids, grows, r)
+        return fsa.fused_adagrad_update(p, uids, utot, lr, vis=vis, eps=eps)
+
+    return unfused, fused
+
+
+def _assert_tables_exact(a, b, vis, touched, max_ulp=1):
+    """`a`/`b` are packed (V, lanes) uint16 tables. Untouched rows must
+    be bitwise-identical; touched-row payloads within `max_ulp` (the FMA
+    freedom documented in the module docstring); spare lanes bitwise."""
+    a, b = np.asarray(a), np.asarray(b)
+    untouched = np.setdiff1d(np.arange(a.shape[0]), touched)
+    np.testing.assert_array_equal(a[untouched], b[untouched])
+    dt = 2 * vis
+    # payload as f32, compared by ULP distance on the int32 lattice
+    fa = np.asarray(dr.unpack_rows(jnp.asarray(a[touched]), dt))
+    fb = np.asarray(dr.unpack_rows(jnp.asarray(b[touched]), dt))
+    ia, ib = fa.view(np.int32), fb.view(np.int32)
+    assert np.all(np.sign(fa) == np.sign(fb))
+    ulp = np.abs(ia.astype(np.int64) - ib.astype(np.int64))
+    assert ulp.max(initial=0) <= max_ulp, \
+        f"max ULP distance {ulp.max()} > {max_ulp}"
+    np.testing.assert_array_equal(a[touched][:, 4 * vis:],
+                                  b[touched][:, 4 * vis:])
+
+
+@pytest.mark.parametrize("seed,v,vis,q,r", [
+    (0, 37, 5, 24, 32),     # duplicates + sentinel tail
+    (1, 64, 17, 64, 80),    # deepfm-width rows (vis=17)
+    (2, 16, 32, 40, 48),    # widest supported payload (4*32 == 128 lanes)
+    (3, 128, 4, 8, 8),      # r == q, mostly unique
+    (4, 5, 3, 50, 64),      # tiny vocab — heavy duplication
+])
+def test_fused_matches_unfused_exact(interpret_kernel, seed, v, vis, q, r):
+    table, ids, grows = _random_case(seed, v, vis, q, r)
+    unfused, fused = _paths(v, vis, r)
+    _assert_tables_exact(unfused(table, ids, grows),
+                         fused(table, ids, grows), vis,
+                         touched=np.unique(np.asarray(ids)))
+
+
+def test_fused_sequential_steps_stay_exact(interpret_kernel):
+    """Per-step FMA freedom compounds at most linearly: after 3 chained
+    updates on overlapping row sets the tables agree to <= 3 ULP (and
+    rows never touched stay bitwise-equal throughout)."""
+    v, vis, q, r = 29, 6, 18, 24
+    table_a = table_b = _random_case(7, v, vis, q, r)[0]
+    unfused, fused = _paths(v, vis, r)
+    rng = np.random.RandomState(8)
+    touched = []
+    for step in range(3):
+        ids = jnp.asarray(rng.randint(0, v, size=q), jnp.int32)
+        grows = jnp.asarray(rng.randn(q, vis).astype(np.float32))
+        table_a = unfused(table_a, ids, grows)
+        table_b = fused(table_b, ids, grows)
+        touched.append(np.asarray(ids))
+    _assert_tables_exact(table_a, table_b, vis,
+                         touched=np.unique(np.concatenate(touched)),
+                         max_ulp=3)
+
+
+def test_all_sentinel_slots_leave_table_untouched(interpret_kernel):
+    v, vis, r = 11, 4, 16
+    table, _, _ = _random_case(9, v, vis, 4, r)
+    uids = jnp.full((r,), dr.SENTINEL, jnp.int32)
+    out = fsa.fused_adagrad_update(table, uids,
+                                   jnp.zeros((r, vis), jnp.float32),
+                                   0.1, vis=vis, eps=1e-6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(table))
+
+
+def test_supports_and_enabled_gates(interpret_kernel, monkeypatch):
+    assert fsa.supports(32)          # 4*32 == 128 lanes: fits
+    assert not fsa.supports(33)      # payload overflows the packed row
+    assert fsa.enabled(17)           # interpreter forced by fixture
+    monkeypatch.setenv("PDTPU_FUSED_SPARSE", "0")
+    assert not fsa.enabled(17)       # kill switch wins
+    table, _, _ = _random_case(0, 8, 2, 4, 4)
+    with pytest.raises(ValueError, match="packed row"):
+        fsa.fused_adagrad_update(table, jnp.zeros((4,), jnp.int32),
+                                 jnp.zeros((4, 33), jnp.float32),
+                                 0.1, vis=33, eps=1e-6)
+
+
+def _train_packed(feeds, fused):
+    """test_sparse_row_updates._train's packed mode, with the fused knob."""
+    V, D = 40, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [3], dtype="int64")
+        emb = layers.embedding(
+            ids, [V, 2 * D], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb", initializer=RowPackInitializer(
+                D, 2 * D, -1.0, 1.0)))
+        emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+        loss = layers.reduce_sum(layers.square(emb))
+        fluid.optimizer.Adagrad(0.1, packed_rows={
+            "rows_per_step": 4 * 3, "fused": fused}).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        from paddle_tpu.core.scope import global_scope
+        exe.run(startup)
+        sc = global_scope()
+        r2 = np.random.RandomState(7)
+        rows = np.zeros((V, 2 * D), "float32")
+        rows[:, :D] = r2.uniform(-1, 1, (V, D))
+        sc.set_var("tb", dr.pack_rows(jnp.asarray(rows)))
+        for f in feeds:
+            (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        table = np.asarray(sc.find_var("tb"))
+    return np.array(losses), table
+
+
+def test_packed_program_fused_vs_unfused_bitwise(interpret_kernel):
+    """End-to-end through the op registry: the same packed-table program
+    built with fused=True (Pallas) and fused=False (gather+scatter)
+    produces bitwise-identical losses AND final table bytes — duplicates
+    included."""
+    rng = np.random.RandomState(3)
+    feeds = [{"ids": rng.randint(0, 40, (4, 3)).astype("int64")}
+             for _ in range(8)]
+    counter = get_registry().counter("optimizer/fused_sparse_updates")
+    before = counter.value
+    loss_f, table_f = _train_packed(feeds, fused=True)
+    assert counter.value > before, \
+        "fused branch silently deactivated (counter did not advance)"
+    loss_u, table_u = _train_packed(feeds, fused=False)
+    np.testing.assert_array_equal(loss_f, loss_u)
+    np.testing.assert_array_equal(table_f, table_u)
+
+
+def test_deepfm_shaped_fused_counter(interpret_kernel):
+    """deepfm-shaped guard: the bench config's packed-adagrad table must
+    take the fused path (counter advances) and train to finite losses."""
+    from paddle_tpu.models import deepfm
+    Vv, Bv = 500, 4
+    main, startup, _, loss, _ = deepfm.build_train_program(
+        vocab_size=Vv, is_sparse=True, fused_table=True, lr=0.05,
+        embedding_optimizer="adagrad",
+        packed_rows={"rows_per_step": Bv * 26})
+    counter = get_registry().counter("optimizer/fused_sparse_updates")
+    before = counter.value
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(3):
+            f = {"sparse_ids": rng.randint(0, Vv, (Bv, 26)).astype("int64"),
+                 "dense": rng.rand(Bv, 13).astype("float32"),
+                 "label": rng.randint(0, 2, (Bv, 1)).astype("float32")}
+            (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+    assert counter.value > before, \
+        "deepfm packed table did not compile the fused sparse-Adagrad path"
+    assert np.isfinite(losses).all()
