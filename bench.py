@@ -45,7 +45,12 @@ def _end_section(extras, name):
 
     import jax
 
-    extras.setdefault("section_memory", {})[name] = _device_memory_snapshot()
+    snap = _device_memory_snapshot()
+    extras.setdefault("section_memory", {})[name] = snap
+    # the headline per-section number, surfaced flat so the bench JSON
+    # consumer doesn't need to dig through the full snapshot
+    extras.setdefault("section_peak_bytes", {})[name] = (
+        (snap or {}).get("peak_bytes_in_use"))
     gc.collect()
     try:
         jax.clear_caches()
@@ -69,14 +74,20 @@ def _run_section_child(name):
     process and print its result as a single tagged JSON line."""
     import jax
 
+    from paddle_tpu import planner
     from paddle_tpu.observability.flight import get_flight_recorder
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu" or "tpu" in str(dev).lower()
-    with get_flight_recorder().guard(f"bench/{name}"):
+    with get_flight_recorder().guard(f"bench/{name}"), \
+            planner.guard(f"bench/{name}"):
         if os.environ.get("PDTPU_BENCH_FORCE_OOM") == name:
             # test hook for the isolation contract itself: a synthetic
-            # OOM deep in one section must not cascade past it
+            # OOM deep in one section must not cascade past it, and must
+            # surface as HbmBudgetError carrying the plan in effect
+            plan = planner.Plan(0, "none", 1, source="unconstrained",
+                                fits=True)
+            planner._record(plan, [plan], f"bench/{name}")
             raise RuntimeError(
                 f"RESOURCE_EXHAUSTED: forced OOM in section {name!r} "
                 f"(PDTPU_BENCH_FORCE_OOM)")
@@ -129,6 +140,8 @@ def _run_section_subprocess(name, extras, timeout=2400):
                 payload = None
     if payload is not None:
         extras.setdefault("section_memory", {})[name] = payload.get("memory")
+        extras.setdefault("section_peak_bytes", {})[name] = (
+            (payload.get("memory") or {}).get("peak_bytes_in_use"))
     if proc.returncode == 0 and payload is not None:
         return payload.get("result"), None
     new_dumps = sorted(
@@ -745,25 +758,46 @@ def bench_nmt(on_tpu):
             if len(batches) >= n_batches:
                 break
 
+        # pre-compile HBM planning: pick (sharding stage, remat policy,
+        # microbatch K) that fits the device budget BEFORE paying the real
+        # compile. Unconstrained backends (CPU smoke) get the baseline
+        # plan without any candidate compiles.
+        from paddle_tpu import planner
+        plan = planner.plan_for(main_p, feed=batches[0][0],
+                                loss_name=loss.name,
+                                where=f"bench/nmt_big T={T}")
+        prog = planner._compiled_for(main_p, loss.name, plan)
+        K = plan.microbatch
+
+        def micro_feeds(feed):
+            if K <= 1:
+                return [feed]
+            return [{k: v[i * (v.shape[0] // K):(i + 1) * (v.shape[0] // K)]
+                     for k, v in feed.items()} for i in range(K)]
+
         # stage feeds on device and warm up (compile) the packed shape —
         # off the clock (a production pipeline keeps batches prefetched)
-        staged = [({k: jnp.asarray(v) for k, v in feed.items()}, non_pad)
+        staged = [([{k: jnp.asarray(v) for k, v in mf.items()}
+                    for mf in micro_feeds(feed)], non_pad)
                   for feed, non_pad in batches]
-        exe.run(main_p, feed=staged[0][0], fetch_list=[loss])
-        exe.run(main_p, feed=staged[0][0], fetch_list=[loss])
+        with planner.guard(f"bench/nmt_big T={T}", plan=plan):
+            exe.run(prog, feed=staged[0][0][0], fetch_list=[loss])
+            exe.run(prog, feed=staged[0][0][0], fetch_list=[loss])
 
-        t0 = time.time()
-        total_tok = 0
-        out = None
-        for feed, non_pad in staged:
-            out = exe.run(main_p, feed=feed, fetch_list=[loss],
-                          return_numpy=False)
-            total_tok += non_pad
-        np.asarray(out[0])
-        dt = time.time() - t0
+            t0 = time.time()
+            total_tok = 0
+            out = None
+            for mfs, non_pad in staged:
+                for mf in mfs:
+                    out = exe.run(prog, feed=mf, fetch_list=[loss],
+                                  return_numpy=False)
+                total_tok += non_pad
+            np.asarray(out[0])
+            dt = time.time() - t0
         total_flops = len(staged) * _nmt_flops_per_batch(cfg, B, Ts, Tt)
         n = len(staged)
         return {"T": T, "batch": B,
+                "hbm_plan": plan.to_dict(),
                 "tokens_per_sec": round(total_tok / dt, 1),
                 "step_ms": round(dt / n * 1e3, 2),
                 "mfu": round(total_flops / dt / _peak_flops(on_tpu), 4),
@@ -1003,6 +1037,8 @@ def main():
                                       if nmt_mfu is not None else None)
     extras2["nmt_big_buckets"] = nb
     extras2["nmt_big_shapes"] = nmt_shapes   # per-shape fill rate + MFU
+    extras2["nmt_big_hbm_plan"] = (nmt_shapes[0].get("hbm_plan")
+                                   if nmt_shapes else None)
     extras2["nmt_big_error"] = err
 
     print(json.dumps({

@@ -34,6 +34,7 @@ from . import inference  # noqa: F401
 from . import metrics  # noqa: F401
 from . import observability  # noqa: F401
 from . import parallel  # noqa: F401
+from . import planner  # noqa: F401
 from . import profiler  # noqa: F401
 from . import serving  # noqa: F401
 from . import reader as py_reader_module  # noqa: F401
@@ -66,6 +67,7 @@ from .core import (  # noqa: F401
     gradients,
     in_dygraph_mode,
     program_guard,
+    remat_unit,
     scope_guard,
 )
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

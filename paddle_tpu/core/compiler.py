@@ -45,18 +45,26 @@ class ShardingStrategy:
       trace time, so persistent gradient buffers (GradientMergeOptimizer's
       ``@GradientMerge`` accumulators) shard too and XLA reduce-scatters
       instead of all-reducing into a replicated buffer.
-
-    Parameters themselves stay replicated (this is not ZeRO-3); losses are
-    unchanged — sharding only relays where each state element lives.
+    - ``stage3`` — stage2 plus the PARAMETERS themselves (full-parameter
+      FSDP / ZeRO-3): each float parameter leaf shards over dp along its
+      largest dp-divisible dim (same padded-boundary fallback as the
+      optimizer state), lives sharded between steps, and is re-asserted
+      sharded inside the step via `with_sharding_constraint` so XLA emits
+      an all-gather at each USE site and overlaps the gathers with
+      compute. Per-device state bytes for params+grads+accumulators all
+      drop ~1/dp; losses stay identical — sharding only relays where each
+      element lives. TP parameters (`shard_spec`) keep their own layout.
     """
 
     off = 0
     stage1 = 1
     stage2 = 2
+    stage3 = 3
     # CamelCase aliases matching ReduceStrategy naming
     Off = off
     Stage1 = stage1
     Stage2 = stage2
+    Stage3 = stage3
 
 
 def _zero_axis(shape, dp: int) -> Optional[int]:
@@ -71,6 +79,86 @@ def _zero_axis(shape, dp: int) -> Optional[int]:
     if dims and dims[0] >= dp:
         return 0
     return None
+
+
+# Cheap-to-recompute op types: big activation residuals, trivial FLOPs to
+# rebuild. The "minimal" remat policy checkpoints exactly these (outside
+# annotated units), matching the reference RecomputeOptimizer's default of
+# recomputing activations but never matmuls.
+_MINIMAL_REMAT_OPS = frozenset({
+    "relu", "gelu", "tanh", "sigmoid", "softmax", "dropout", "layer_norm",
+    "batch_norm", "elementwise_add", "elementwise_mul", "scale",
+})
+
+
+class RematSpec:
+    """Resolved remat policy — what the trace actually does.
+
+    - ``op_set``: per-op jax.checkpoint outside remat units — False (off),
+      True (every differentiable op), or a frozenset of op types.
+    - ``unit_policy``: None (no unit grouping) or a callable
+      ``unit_name -> False | True | "minimal" | "full"`` deciding whether a
+      `fluid.remat_unit(...)` block is wrapped in one jax.checkpoint —
+      "minimal" keeps matmul outputs (`jax.checkpoint_policies.
+      dots_saveable`), "full"/True saves nothing (max HBM savings).
+    - ``saveable_names``: optional tuple of var names mapped onto
+      `save_only_these_names` — those intermediates are kept as residuals,
+      everything else in the unit recomputes.
+    - ``token``: hashable identity for executable cache keys.
+    """
+
+    __slots__ = ("op_set", "unit_policy", "saveable_names", "token")
+
+    def __init__(self, op_set, unit_policy, saveable_names, token):
+        self.op_set = op_set
+        self.unit_policy = unit_policy
+        self.saveable_names = saveable_names
+        self.token = token
+
+    def jax_policy(self, unit_decision):
+        """jax.checkpoint `policy=` for one unit's decision."""
+        if self.saveable_names:
+            return jax.checkpoint_policies.save_only_these_names(
+                *self.saveable_names)
+        if unit_decision == "minimal":
+            return jax.checkpoint_policies.dots_saveable
+        return None  # "full"/True: save nothing, recompute the whole unit
+
+
+REMAT_POLICIES = ("none", "minimal", "full")
+
+
+def resolve_remat(policy=None, legacy_remat=False, saveable_names=None):
+    """Map the remat policy surface (BuildStrategy.remat_policy /
+    DistributedStrategy.remat_policy / legacy boolean-or-set
+    BuildStrategy.remat) onto a RematSpec."""
+    names = tuple(saveable_names) if saveable_names else None
+    if policy is None:
+        # legacy knob: True = per-op checkpoint everywhere, a set = only
+        # those op types; no unit grouping (exact pre-policy behavior)
+        if legacy_remat is True:
+            return RematSpec(True, None, names, ("legacy", True, names))
+        if isinstance(legacy_remat, (set, frozenset)) and legacy_remat:
+            fs = frozenset(legacy_remat)
+            return RematSpec(fs, None, names,
+                             ("legacy", tuple(sorted(fs)), names))
+        return RematSpec(False, None, None, ("none",))
+    if callable(policy):
+        # per-layer predicate: unit_name -> False | True | "minimal" | "full"
+        return RematSpec(False, policy, names,
+                         ("predicate", id(policy), names))
+    p = str(policy)
+    if p == "none":
+        return RematSpec(False, None, None, ("none",))
+    if p == "minimal":
+        return RematSpec(frozenset(_MINIMAL_REMAT_OPS),
+                         lambda unit: "minimal", names, ("minimal", names))
+    if p == "full":
+        return RematSpec(True, lambda unit: "full", names, ("full", names))
+    raise ValueError(
+        f"remat_policy must be one of {REMAT_POLICIES}, a per-layer "
+        f"predicate (unit_name -> bool|'minimal'|'full'), or None for the "
+        f"legacy BuildStrategy.remat knob — got {policy!r}")
 
 
 class BuildStrategy:
@@ -95,7 +183,14 @@ class BuildStrategy:
         self.fuse_all_optimizer_ops = True
         self.memory_optimize = True
         self.enable_inplace = True
-        self.remat = False                     # TPU-native: jax.checkpoint policy
+        self.remat = False                     # legacy: True | {op types}
+        # remat policy surface: "none" | "minimal" | "full" | callable
+        # (unit_name -> bool|"minimal"|"full"); None defers to the legacy
+        # `remat` knob. See resolve_remat().
+        self.remat_policy = None
+        # optional var names kept as residuals inside remat units
+        # (jax.checkpoint_policies.save_only_these_names)
+        self.remat_saveable_names = None
         self.sharding_strategy = ShardingStrategy.off
         self.sync_batch_norm = False
         self.num_trainers = 1
@@ -161,13 +256,16 @@ class CompiledProgram:
                 f"mesh axis twice; use distinct axes")
         self._strategy_stage = 0       # re-derived per call, never sticky
         self._strategy_remat = False   # ditto; build_strategy.remat is the
-        if strategy is not None:       # user's own knob and is left alone
+        self._strategy_remat_policy = None  # user's own knob, left alone
+        if strategy is not None:
             if getattr(strategy, "sharding_degree", 1) > 1:
-                # sharding on; sharding_stage picks ZeRO-1 vs ZeRO-2
+                # sharding on; sharding_stage picks ZeRO-1/2/3
                 self._strategy_stage = max(
                     1, int(getattr(strategy, "sharding_stage", 1) or 1))
             if getattr(strategy, "recompute", False):
                 self._strategy_remat = True
+            self._strategy_remat_policy = getattr(
+                strategy, "remat_policy", None)
             if getattr(strategy, "gradient_merge_steps", 1) > 1:
                 raise NotImplementedError(
                     "gradient_merge_steps on DistributedStrategy is not "
@@ -192,6 +290,21 @@ class CompiledProgram:
             stage = max(stage, int(getattr(bs, "sharding_strategy", 0) or 0))
         return stage
 
+    def _remat_spec(self) -> RematSpec:
+        """Effective remat policy, resolved lazily (same contract as
+        _zero_stage): build_strategy.remat_policy wins, then the fleet
+        DistributedStrategy's remat_policy, then the legacy boolean/set
+        knobs (build_strategy.remat, DistributedStrategy.recompute)."""
+        bs = self.build_strategy
+        policy = getattr(bs, "remat_policy", None) if bs is not None else None
+        if policy is None:
+            policy = getattr(self, "_strategy_remat_policy", None)
+        legacy = ((bs.remat if bs is not None else False)
+                  or getattr(self, "_strategy_remat", False))
+        names = (getattr(bs, "remat_saveable_names", None)
+                 if bs is not None else None)
+        return resolve_remat(policy, legacy, names)
+
     def _zero_plan(self, var):
         """(axis, pad_to) sharding plan for `var` over the data axis under
         the effective ZeRO stage, or None to leave it replicated. Eligible
@@ -208,7 +321,9 @@ class CompiledProgram:
         shardable = (getattr(var, "is_optimizer_state", False)
                      or getattr(var, "is_master_weight", False)
                      or (stage >= ShardingStrategy.stage2
-                         and getattr(var, "is_grad_buffer", False)))
+                         and getattr(var, "is_grad_buffer", False))
+                     or (stage >= ShardingStrategy.stage3
+                         and self._fsdp_param(var)))
         if not shardable or not getattr(var, "zero_shardable", True):
             return None
         dp = self._mesh.shape[self._data_axis]
@@ -218,6 +333,24 @@ class CompiledProgram:
         d = var.shape[axis]
         pad_to = None if d % dp == 0 else -(-d // dp) * dp
         return axis, pad_to
+
+    @staticmethod
+    def _fsdp_param(var) -> bool:
+        """Stage3 eligibility: trainable float parameters without a TP
+        `shard_spec` (TP owns those layouts). Non-float leaves (e.g.
+        row-packed uint16 embedding tables, driven by custom scatter
+        kernels) stay replicated — FSDP'ing them buys little and their
+        update paths assume a whole table."""
+        if not (getattr(var, "trainable", False) and var.persistable):
+            return False
+        if getattr(var, "shard_spec", None) is not None:
+            return False
+        from .dtypes import dtype_str
+        try:
+            return dtype_str(var.dtype) in ("float32", "float64", "float16",
+                                            "bfloat16")
+        except Exception:
+            return False
 
     def _zero_pspec(self, var) -> Optional[P]:
         plan = self._zero_plan(var)
@@ -310,10 +443,21 @@ class CompiledProgram:
         block = self._program.global_block()
         mesh = self._mesh
         amp = getattr(self._program, "_amp", None)
-        remat = bool((self.build_strategy and self.build_strategy.remat)
-                     or getattr(self, "_strategy_remat", False))
+        remat_spec = self._remat_spec()
         shard_grad = self._grad_shard_fn()
         pads = self._zero_pad_map()
+        # stage3 (FSDP): re-assert each sharded parameter's dp layout INSIDE
+        # the step. in_shardings only pins the boundary; the constraint keeps
+        # the resident value sharded so every USE becomes an all-gather that
+        # XLA's scheduler overlaps with compute, and the weight update runs
+        # on the shard.
+        fsdp_sh = {}
+        if self._zero_stage() >= ShardingStrategy.stage3:
+            for v in self._program.list_vars():
+                if v.persistable and self._fsdp_param(v):
+                    pspec = self._zero_pspec(v)
+                    if pspec is not None:
+                        fsdp_sh[v.name] = NamedSharding(mesh, pspec)
 
         def step(state, feed, key):
             env = dict(state)
@@ -323,8 +467,13 @@ class CompiledProgram:
             for n, (d, _dpad) in pads.items():
                 if n in env and env[n].shape[0] != d:
                     env[n] = jax.lax.slice_in_dim(env[n], 0, d, axis=0)
+            for n, sh in fsdp_sh.items():
+                if n in env:
+                    env[n] = jax.lax.with_sharding_constraint(env[n], sh)
             env.update(feed)
-            ctx = ExecContext(key, mesh=mesh, amp=amp, remat=remat,
+            ctx = ExecContext(key, mesh=mesh, amp=amp,
+                              remat=remat_spec.op_set,
+                              remat_units=remat_spec,
                               shard_grad=shard_grad)
             _run_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
@@ -410,8 +559,7 @@ class CompiledProgram:
         feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype)) for n, v in feed_vals.items()))
         key_sig = (program._version, feed_sig, tuple(fetch_names),
                    tuple(state_names),
-                   bool((self.build_strategy and self.build_strategy.remat)
-                        or getattr(self, "_strategy_remat", False)),
+                   self._remat_spec().token,
                    self._zero_stage(),
                    id(self._mesh), self._data_axis,
                    getattr(self, "_seq_axis", None))

@@ -241,7 +241,7 @@ class ExecContext:
     """Per-trace context handed to op implementations."""
 
     def __init__(self, key, is_test: bool = False, mesh=None, amp=None,
-                 remat: bool = False, shard_grad=None):
+                 remat: bool = False, shard_grad=None, remat_units=None):
         self._key = key
         self.is_test = is_test
         self.mesh = mesh
@@ -250,10 +250,18 @@ class ExecContext:
         # (target_name, grad) -> grad with a dp sharding constraint, making
         # XLA reduce-scatter the cross-replica gradient sum
         self.shard_grad = shard_grad
-        # BuildStrategy.remat: op-level jax.checkpoint — recompute op
-        # internals in the backward instead of saving residuals (trades
-        # FLOPs for HBM; the win is on elementwise-heavy ops)
+        # op-level jax.checkpoint (RematSpec.op_set / legacy
+        # BuildStrategy.remat): recompute op internals in the backward
+        # instead of saving residuals (trades FLOPs for HBM; the win is on
+        # elementwise-heavy ops). True = all ops, or a set of op types.
         self.remat = remat
+        # RematSpec (compiler.resolve_remat) — when its unit_policy is set,
+        # consecutive ops tagged with the same `__remat_unit__` attr run as
+        # ONE jax.checkpoint region (_run_remat_group)
+        self.remat_units = remat_units
+        # True while tracing the forward of a remat group: ops run their
+        # plain forward (the group's single jax.vjp owns differentiation)
+        self.group_forward = False
         self.tape: List[TapeEntry] = []
         # declared output arity of the op currently being run ({slot: n}) —
         # lets arity-driven kernels (reference: split_ids_op.cc sizes N from
@@ -354,10 +362,18 @@ def convert_feed_value(block, name: str, val):
                       and dtype_str(dtype) in ("int64", "uint64"))
         raw64 = (dtype is None and isinstance(val, np.ndarray)
                  and val.dtype in (np.int64, np.uint64))
-        if ((declared64 or raw64) and not jax.config.jax_enable_x64
-                and not isinstance(val, jax.Array)):
-            val = _apply_int64_policy(name, val, dtype)
-            dtype = val.dtype
+        if (declared64 or raw64) and not jax.config.jax_enable_x64:
+            if isinstance(val, jax.Array):
+                # already a device array — in x32 mode it physically holds
+                # 32-bit values, so re-requesting the declared int64 dtype
+                # would trip jax's per-call narrowing UserWarning on EVERY
+                # step (the bench-tail spam); narrow the REQUEST instead.
+                # The once-only policy message covers this path too.
+                dtype = (np.uint32 if dtype_str(dtype) == "uint64"
+                         else np.int32)
+            else:
+                val = _apply_int64_policy(name, val, dtype)
+                dtype = val.dtype
         arr = jnp.asarray(val, dtype=dtype)
     except (TypeError, ValueError) as e:
         raise type(e)(
@@ -388,7 +404,7 @@ def _run_op(op, env: Dict[str, object], ctx: ExecContext):
     diff = opdef.differentiable
     if callable(diff):  # attr-dependent (e.g. `while` with a trip bound)
         diff = diff(op.attrs)
-    differentiable = diff and not ctx.is_test
+    differentiable = diff and not ctx.is_test and not ctx.group_forward
 
     custom_grad = None
     if differentiable and flat_in_names and opdef.grad_fn is not None:
@@ -677,14 +693,147 @@ def _fuse_updates_mode() -> str:
     return {"0": "off", "1": "all"}.get(v, v)
 
 
+def _remat_group_eligible(op) -> bool:
+    """Can `op` join a remat-unit group? Groups differentiate through ONE
+    jax.vjp over the whole unit, so members must be plainly differentiable:
+    custom-grad ops (sparse cotangents), non-differentiable ops (grads must
+    stay cut), control flow (nested blocks) and the update/autodiff ops all
+    keep their per-op path."""
+    if op.type == "autodiff" or op.type in _FUSABLE_UPDATES:
+        return False
+    try:
+        opdef = registry.get_op(op.type)
+    except Exception:
+        return False
+    if opdef.grad_fn is not None:
+        return False
+    diff = opdef.differentiable
+    if callable(diff):
+        try:
+            diff = diff(op.attrs)
+        except Exception:
+            return False
+    if not diff:
+        return False
+    for v in op.attrs.values():
+        if isinstance(v, Block):
+            return False
+    return True
+
+
+def _plan_remat_items(block: Block, ctx: ExecContext):
+    """Partition block.ops into ("op", None, op) singles and
+    ("group", decision, [ops]) maximal runs of consecutive ops sharing a
+    `__remat_unit__` tag whose unit decision (RematSpec.unit_policy) is
+    truthy. Cheap when no policy is active (the common path)."""
+    from .program import REMAT_UNIT_ATTR
+
+    spec = ctx.remat_units
+    pred = getattr(spec, "unit_policy", None) if spec is not None else None
+    if pred is None or ctx.is_test:
+        return [("op", None, op) for op in block.ops]
+    items = []
+    decisions: Dict[str, object] = {}
+    cur_unit, cur_dec, cur_ops = None, None, []
+
+    def flush():
+        nonlocal cur_unit, cur_dec, cur_ops
+        if cur_ops:
+            items.append(("group", cur_dec, cur_ops))
+        cur_unit, cur_dec, cur_ops = None, None, []
+
+    for op in block.ops:
+        unit = op.attrs.get(REMAT_UNIT_ATTR)
+        dec = None
+        if unit is not None and _remat_group_eligible(op):
+            if unit not in decisions:
+                try:
+                    decisions[unit] = pred(unit)
+                except Exception:
+                    decisions[unit] = False
+            dec = decisions[unit]
+            if not dec or dec == "none":
+                dec = None
+        if dec is None:
+            flush()
+            items.append(("op", None, op))
+        elif unit == cur_unit:
+            cur_ops.append(op)
+        else:
+            flush()
+            cur_unit, cur_dec, cur_ops = unit, dec, [op]
+    flush()
+    return items
+
+
+def _run_remat_group(ops, decision, env: Dict[str, object],
+                     ctx: ExecContext):
+    """Run a remat unit as ONE checkpointed function: forward now, and a
+    single tape entry whose vjp recomputes the whole unit from its entry
+    values under the policy's `policy=` (dots_saveable etc.). This is the
+    per-model-block form of remat — per-op jax.checkpoint still saves every
+    op-boundary activation; wrapping the unit drops those too."""
+    spec = ctx.remat_units
+    reads, read_set, writes, write_set = [], set(), [], set()
+    for op in ops:
+        for slot in sorted(op.inputs):
+            for n in op.inputs[slot]:
+                if n not in write_set and n not in read_set:
+                    read_set.add(n)
+                    reads.append(n)
+        for slot in sorted(op.outputs):
+            for n in op.outputs[slot]:
+                if n not in write_set:
+                    write_set.add(n)
+                    writes.append(n)
+    in_names, out_names = reads, writes
+    # one split per group, closed over (not a traced argument): the
+    # checkpointed backward replays the SAME key, so recomputed dropout
+    # masks match the forward exactly
+    gkey = ctx.rng()
+    name_tags = bool(getattr(spec, "saveable_names", None))
+
+    def fwd(*vals):
+        sub = ExecContext(gkey, is_test=ctx.is_test, mesh=ctx.mesh,
+                          amp=ctx.amp, remat=False,
+                          shard_grad=ctx.shard_grad)
+        sub.group_forward = True
+        local = dict(zip(in_names, vals))
+        for op in ops:
+            _run_op(op, local, sub)
+            if name_tags:
+                from jax.ad_checkpoint import checkpoint_name
+                for n in op.output_names():
+                    local[n] = checkpoint_name(local[n], n)
+        return tuple(local[n] for n in out_names)
+
+    wrapped = jax.checkpoint(fwd, policy=spec.jax_policy(decision))
+    out_vals, vjp_fn = jax.vjp(wrapped, *[env[n] for n in in_names])
+    for n, v in zip(out_names, out_vals):
+        env[n] = v
+    # an input is non-differentiable for the GROUP only if every use of it
+    # inside is through a nondiff slot
+    used_diff, used_nondiff = set(), set()
+    for op in ops:
+        nd_slots = registry.get_op(op.type).nondiff_inputs
+        for slot, names in op.inputs.items():
+            (used_nondiff if slot in nd_slots else used_diff).update(names)
+    nondiff_in = (used_nondiff - used_diff) & set(in_names)
+    ctx.tape.append(TapeEntry(list(in_names), list(out_names), vjp_fn,
+                              list(out_vals), nondiff_in))
+
+
 def _run_block(block: Block, env: Dict[str, object], ctx: ExecContext):
     mode = _fuse_updates_mode()
+    items = _plan_remat_items(block, ctx)
     if mode == "off":
-        for op in block.ops:
-            if op.type == "autodiff":
-                _run_autodiff(op, env, ctx)
+        for kind, dec, entry in items:
+            if kind == "group":
+                _run_remat_group(entry, dec, env, ctx)
+            elif entry.type == "autodiff":
+                _run_autodiff(entry, env, ctx)
             else:
-                _run_op(op, env, ctx)
+                _run_op(entry, env, ctx)
         return
     pending: List = []          # fusable update ops awaiting flush
     pending_in: set = set()
@@ -719,7 +868,14 @@ def _run_block(block: Block, env: Dict[str, object], ctx: ExecContext):
         pending_in.clear()
         pending_out.clear()
 
-    for op in block.ops:
+    for kind, dec, entry in items:
+        if kind == "group":
+            # remat units are model-forward regions; any pending updates
+            # must complete first (conservative, and trivially correct)
+            flush()
+            _run_remat_group(entry, dec, env, ctx)
+            continue
+        op = entry
         if op.type in _FUSABLE_UPDATES:
             names_in = {n for ns in op.inputs.values() for n in ns}
             names_out = {n for ns in op.outputs.values() for n in ns}
@@ -966,17 +1122,23 @@ class Executor:
         amp = getattr(program, "_amp", None)
         # PDTPU_REMAT_OPS="batch_norm,relu" — selective op-level
         # jax.checkpoint on the plain-Executor path (the CompiledProgram
-        # path takes the same knob through BuildStrategy.remat)
+        # path takes the same knob through BuildStrategy.remat);
+        # PDTPU_REMAT_POLICY="minimal"|"full" maps onto the policy surface
+        # (remat units included) for scripts without a CompiledProgram
         import os as _os
+        from .compiler import resolve_remat
         remat_env = _os.environ.get("PDTPU_REMAT_OPS", "")
-        remat = (True if remat_env == "1"
-                 else frozenset(t for t in remat_env.split(",") if t)
-                 if remat_env else False)
+        legacy = (True if remat_env == "1"
+                  else frozenset(t for t in remat_env.split(",") if t)
+                  if remat_env else False)
+        spec = resolve_remat(_os.environ.get("PDTPU_REMAT_POLICY") or None,
+                             legacy)
 
         def step(state, feed, key):
             env = dict(state)
             env.update(feed)
-            ctx = ExecContext(key, amp=amp, remat=remat)
+            ctx = ExecContext(key, amp=amp, remat=spec.op_set,
+                              remat_units=spec)
             _run_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in out_state_names if n in env}
@@ -1229,6 +1391,7 @@ class Executor:
                    stacked_sig, tuple(fetch_names),
                    (id(compiled._mesh), compiled._data_axis,
                     compiled._zero_stage(),
+                    compiled._remat_spec().token,
                     getattr(compiled, "_seq_axis", None))
                    if compiled is not None else None)
         fn = self._cache.get(key_sig)

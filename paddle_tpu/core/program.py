@@ -226,6 +226,8 @@ class Block:
             return out
 
         op = Operator(self, type, _norm(inputs), _norm(outputs), attrs)
+        if _REMAT_UNIT_STACK and REMAT_UNIT_ATTR not in op.attrs:
+            op.attrs[REMAT_UNIT_ATTR] = _REMAT_UNIT_STACK[-1]
         self.ops.append(op)
         for name in op.output_names():
             if name in self.vars:
@@ -395,6 +397,34 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
 def grad_var_name(name: str) -> str:
     """Reference framework: grad var suffix '@GRAD'."""
     return name + "@GRAD"
+
+
+# ---------------------------------------------------------------------------
+# Remat units: model-block boundaries for the remat policy surface
+# (BuildStrategy.remat_policy). Ops appended inside `remat_unit(name)` are
+# tagged with `__remat_unit__ = name`; the executor groups consecutive
+# same-unit ops into ONE jax.checkpoint region so a whole transformer layer
+# recomputes from its entry activations instead of saving per-op residuals.
+# The reference expressed the same boundary through RecomputeOptimizer's
+# checkpoints=[...] var list (fleet meta optimizer); here it is a trace-time
+# scope, nested scopes keep the innermost name.
+_REMAT_UNIT_STACK: List[str] = []
+
+REMAT_UNIT_ATTR = "__remat_unit__"
+
+
+@contextlib.contextmanager
+def remat_unit(name: str):
+    """Tag every op appended in this scope as part of remat block `name`."""
+    _REMAT_UNIT_STACK.append(str(name))
+    try:
+        yield
+    finally:
+        _REMAT_UNIT_STACK.pop()
+
+
+def current_remat_unit() -> Optional[str]:
+    return _REMAT_UNIT_STACK[-1] if _REMAT_UNIT_STACK else None
 
 
 _dygraph_tracer = None

@@ -157,8 +157,13 @@ def bert_encoder(cfg: BertConfig, src_ids, pos_ids, sent_ids, input_mask,
                        scale=10000.0)
     mask4 = layers.unsqueeze(neg, [1])
     x = emb
+    # each transformer block is one remat unit: under remat_policy
+    # "minimal"/"full" the whole block's forward is recomputed in the
+    # backward pass instead of keeping its activations resident
+    from ..core.program import remat_unit
     for i in range(cfg.num_layers):
-        x = encoder_layer(cfg, x, mask4, i, is_test)
+        with remat_unit(f"bert_layer_{i}"):
+            x = encoder_layer(cfg, x, mask4, i, is_test)
     return x
 
 

@@ -62,10 +62,14 @@ def resnet(img, depth: int = 50, num_classes: int = 1000, is_test: bool = False,
                     is_test=is_test)
     x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1)
     filters = [64, 128, 256, 512]
+    from ..core.program import remat_unit
     for stage, (n, f) in enumerate(zip(blocks, filters)):
         for i in range(n):
             stride = 2 if i == 0 and stage > 0 else 1
-            x = bottleneck(x, f, stride, name=f"res{stage}.{i}", is_test=is_test)
+            # one remat unit per bottleneck (remat_policy "minimal"/"full")
+            with remat_unit(f"res{stage}.{i}"):
+                x = bottleneck(x, f, stride, name=f"res{stage}.{i}",
+                               is_test=is_test)
     x = layers.pool2d(x, global_pooling=True, pool_type="avg")
     return layers.fc(x, num_classes, param_attr=ParamAttr(name="fc.w"),
                      bias_attr=ParamAttr(name="fc.b"))

@@ -128,25 +128,30 @@ def _embed(cfg, ids, vocab, name, is_test=False, pos=None):
 
 
 def encoder(cfg, src_ids, src_mask, is_test=False, pos=None):
+    from ..core.program import remat_unit
     x = _embed(cfg, src_ids, cfg.src_vocab, "src_embedding", is_test, pos=pos)
     for i in range(cfg.n_enc):
         name = f"enc_{i}"
-        x = _ln(_residual(cfg, x, _mha(cfg, x, x, src_mask, f"{name}.self", is_test),
-                          is_test), f"{name}.ln1")
-        x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln2")
+        # one remat unit per encoder layer (remat_policy "minimal"/"full")
+        with remat_unit(name):
+            x = _ln(_residual(cfg, x, _mha(cfg, x, x, src_mask, f"{name}.self", is_test),
+                              is_test), f"{name}.ln1")
+            x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln2")
     return x
 
 
 def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False,
             pos=None):
+    from ..core.program import remat_unit
     x = _embed(cfg, tgt_ids, cfg.tgt_vocab, "tgt_embedding", is_test, pos=pos)
     for i in range(cfg.n_dec):
         name = f"dec_{i}"
-        x = _ln(_residual(cfg, x, _mha(cfg, x, x, self_mask, f"{name}.self", is_test),
-                          is_test), f"{name}.ln1")
-        x = _ln(_residual(cfg, x, _mha(cfg, x, enc_out, cross_mask, f"{name}.cross", is_test),
-                          is_test), f"{name}.ln2")
-        x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln3")
+        with remat_unit(name):
+            x = _ln(_residual(cfg, x, _mha(cfg, x, x, self_mask, f"{name}.self", is_test),
+                              is_test), f"{name}.ln1")
+            x = _ln(_residual(cfg, x, _mha(cfg, x, enc_out, cross_mask, f"{name}.cross", is_test),
+                              is_test), f"{name}.ln2")
+            x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln3")
     return layers.fc(x, cfg.tgt_vocab, num_flatten_dims=2,
                      param_attr=_attr("out_proj.w"), bias_attr=False)
 

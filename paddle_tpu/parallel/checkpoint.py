@@ -46,11 +46,20 @@ def _is_replicated(v) -> bool:
 
 def _zero_state_var(var) -> bool:
     """ZeRO-shardable state (ShardingStrategy): optimizer accumulators,
-    master weights, persistent gradient buffers — tagged at creation."""
-    return bool(var is not None
-                and (getattr(var, "is_optimizer_state", False)
-                     or getattr(var, "is_master_weight", False)
-                     or getattr(var, "is_grad_buffer", False)))
+    master weights, persistent gradient buffers — tagged at creation — and,
+    under stage3 (full-parameter FSDP), the trainable parameters themselves.
+    TP parameters (explicit `shard_spec`) are excluded: their layout is a
+    deliberate model-parallel split, not a ZeRO annotation, so they keep the
+    per-shard save path."""
+    if var is None:
+        return False
+    if (getattr(var, "is_optimizer_state", False)
+            or getattr(var, "is_master_weight", False)
+            or getattr(var, "is_grad_buffer", False)):
+        return True
+    return bool(getattr(var, "trainable", False)
+                and getattr(var, "persistable", False)
+                and getattr(var, "shard_spec", None) is None)
 
 
 def _snapshot(program: Program, scope: Scope):

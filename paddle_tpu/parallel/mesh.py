@@ -53,10 +53,16 @@ class DistributedStrategy:
         self.sequence_parallel_degree = 1
         self.sharding_degree = 1          # ZeRO-style optimizer sharding
         # ShardingStrategy stage once sharding is on: 1 = state sharding,
-        # 2 = state + gradient reduce-scatter (compiler.ShardingStrategy)
+        # 2 = state + gradient reduce-scatter, 3 = full-parameter FSDP —
+        # parameters live dp-sharded and are all-gathered on use
+        # (compiler.ShardingStrategy)
         self.sharding_stage = 1
         self.amp = False
-        self.recompute = False            # jax.checkpoint on blocks
+        self.recompute = False            # legacy: jax.checkpoint on blocks
+        # remat policy surface (compiler.resolve_remat): None defers to the
+        # legacy `recompute` bool; else "none" | "minimal" | "full" | a
+        # per-unit predicate `unit_name -> False|True|"minimal"|"full"`
+        self.remat_policy = None
         self.gradient_merge_steps = 1     # microbatch accumulation
         # reference-compat knobs (no-ops on TPU; XLA owns these)
         self.nccl_comm_num = 1

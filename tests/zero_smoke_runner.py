@@ -52,7 +52,7 @@ def build(seed=11):
     return main, startup, feed, loss
 
 
-def run(stage, steps=3):
+def run(stage, steps=3, check_params=False):
     import paddle_tpu as fluid
     from paddle_tpu.observability import get_registry
 
@@ -70,7 +70,12 @@ def run(stage, steps=3):
     state_bytes = get_registry().gauge("memory/state_bytes_per_device").value
     frac = 0.0
     for v in main.global_block().vars.values():
-        if not getattr(v, "is_optimizer_state", False):
+        # stage1/2 smoke watches optimizer state; stage3 (full-parameter
+        # FSDP) watches the trainable parameters themselves
+        if check_params:
+            if not getattr(v, "trainable", False):
+                continue
+        elif not getattr(v, "is_optimizer_state", False):
             continue
         arr = scope.find_var(v.name)
         n = int(np.prod(tuple(v.shape) or (1,)))
@@ -86,7 +91,20 @@ def main():
     import paddle_tpu as fluid
 
     assert len(jax.devices()) == 8, len(jax.devices())
+    stage3 = "--stage3" in sys.argv
     losses_off, bytes_off, _ = run(fluid.ShardingStrategy.off)
+    if stage3:
+        losses_s, bytes_s, frac = run(fluid.ShardingStrategy.stage3,
+                                      check_params=True)
+        print(json.dumps({
+            "device_count": len(jax.devices()),
+            "losses_off": losses_off,
+            "losses_stage3": losses_s,
+            "max_param_shard_frac": frac,
+            "state_bytes_off": bytes_off,
+            "state_bytes_stage3": bytes_s,
+        }), flush=True)
+        return
     losses_s1, bytes_s1, frac = run(fluid.ShardingStrategy.stage1)
     print(json.dumps({
         "device_count": len(jax.devices()),
