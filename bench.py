@@ -883,6 +883,62 @@ def _bench_ring_attn(extras2):
     return ring_speedup
 
 
+def bench_ckpt_integrity():
+    """Crash-consistency tax: blocking save (fsync + sha256 manifest),
+    manifest verify, and fallback restore wall time for a ~34 MB bundle,
+    plus the per-call cost of an idle fault_point (the chaos probes ride
+    in every hot loop — dispatch, reader pulls — so the idle cost must
+    stay negligible: one env lookup + a lock, ~1 us)."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import faults
+    from paddle_tpu.parallel.checkpoint import Checkpointer
+
+    out = {}
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.fault_point("bench.idle")
+    out["idle_probe_ns"] = round((time.perf_counter() - t0) / n * 1e9, 1)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [1024])
+        h = fluid.layers.fc(x, 4096)
+        h = fluid.layers.fc(h, 1024)
+        fluid.layers.mean(h)
+    d = tempfile.mkdtemp(prefix="pdtpu_ckpt_bench_")
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            ck = Checkpointer(d)
+            t0 = time.perf_counter()
+            ck.save(1, program=main_p, blocking=True)
+            out["save_blocking_ms"] = round((time.perf_counter() - t0) * 1e3,
+                                            2)
+            t0 = time.perf_counter()
+            ck.save(2, program=main_p)  # async: time to regain control
+            out["save_dispatch_ms"] = round((time.perf_counter() - t0) * 1e3,
+                                            2)
+            ck.wait()
+            t0 = time.perf_counter()
+            bad = ck.verify(2)
+            out["verify_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            out["verify_clean"] = not bad
+            t0 = time.perf_counter()
+            ck.restore(program=main_p)
+            out["restore_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            out["bundle_mb"] = round(sum(
+                os.path.getsize(os.path.join(d, f))
+                for f in os.listdir(d)) / 1e6, 1)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def main():
     import jax
 
@@ -1029,6 +1085,14 @@ def main():
     except Exception as e:  # pragma: no cover
         extras2["input_pipeline"] = {"error": str(e)[:120]}
     _end_section(extras2, "input_pipeline")
+
+    # crash-consistency tax: manifest'd blocking save / verify / restore
+    # latency + idle chaos-probe cost (PR 8 integrity machinery)
+    try:
+        extras2["ckpt_integrity"] = bench_ckpt_integrity()
+    except Exception as e:  # pragma: no cover
+        extras2["ckpt_integrity"] = {"error": str(e)[:120]}
+    _end_section(extras2, "ckpt_integrity")
 
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms

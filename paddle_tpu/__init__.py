@@ -32,6 +32,7 @@ from . import io  # noqa: F401
 from . import ir  # noqa: F401
 from . import inference  # noqa: F401
 from . import metrics  # noqa: F401
+from . import faults  # noqa: F401
 from . import observability  # noqa: F401
 from . import parallel  # noqa: F401
 from . import planner  # noqa: F401

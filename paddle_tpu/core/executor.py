@@ -30,6 +30,7 @@ from .program import Block, Program, Variable, default_main_program, grad_var_na
 from .scope import Scope, _scope, global_scope
 
 from ..dataio.handle import FetchHandle
+from ..faults import fault_point
 from ..observability.flight import (get_flight_recorder,
                                     register_dump_section)
 from ..observability.http import maybe_serve_from_env
@@ -1163,6 +1164,10 @@ class Executor:
         prepares the next step; `.numpy()` on the handle is the sync
         point. Results are bitwise-identical to return_numpy=True."""
         from .compiler import CompiledProgram
+
+        # chaos probe: one hit per training-step dispatch, so a spec like
+        # exec.dispatch:crash@7 kills the process at exactly step 7
+        fault_point("exec.dispatch")
 
         if isinstance(program, CompiledProgram):
             out = program._run(self, feed, fetch_list, scope,

@@ -16,18 +16,31 @@ Threading contract:
   through ``close()``/``PyReader.reset()`` leaves no live thread holding
   device buffers); iterating to exhaustion closes automatically.
 
+Deterministic resume (ROADMAP item 5): the loader tracks an (epoch,
+cursor) position — `state()` returns it, `restore_state()` replays it on
+a FRESH reader by skipping `cursor` raw batches at the next epoch start
+(skipped batches are never converted or device_put, so the fast-forward
+is reader-speed, not H2D-speed). Callable readers that accept an
+argument are invoked as ``reader(epoch)`` so a stateful reader can
+regenerate epoch N's exact stream after a crash; `run_elastic` snapshots
+this state into every checkpoint as ``@dataio@*`` keys, which is what
+makes a SIGTERM-mid-epoch resume land bitwise-identical batches.
+
 Telemetry (process registry): ``dataio/prefetch_queue_depth`` gauge,
 ``dataio/h2d_ms`` per-batch conversion+transfer histogram,
-``dataio/batches`` counter.
+``dataio/batches`` counter. Chaos probe: ``loader.next`` fires in the
+worker at every reader pull (paddle_tpu.faults).
 """
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 import weakref
 from queue import Empty, Full, Queue
 from typing import Callable, Dict, Iterable, Optional, Union
 
+from ..faults import fault_point
 from ..observability.registry import get_registry
 
 __all__ = ["DeviceLoader"]
@@ -112,12 +125,32 @@ class DeviceLoader:
         self._thread: Optional[threading.Thread] = None
         self._stop: Optional[threading.Event] = None
         self._closed = False
+        # deterministic-resume position: completed epochs / batches
+        # DELIVERED to the consumer this epoch / pending skip-ahead
+        self._epoch = 0
+        self._consumed = 0
+        self._skip = 0
+        self._takes_epoch: Optional[bool] = None
         _LIVE_LOADERS.add(self)
 
     # -- epoch lifecycle ---------------------------------------------------
     def _epoch_iterable(self):
         r = self._reader
-        return r() if callable(r) else r
+        if not callable(r):
+            return r
+        if self._takes_epoch is None:
+            # epoch-aware readers (`def reader(epoch):`) get the epoch
+            # index: the contract that lets a stateful reader regenerate
+            # epoch N's exact stream after a crash-resume
+            try:
+                sig = inspect.signature(r)
+                self._takes_epoch = any(
+                    p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                               p.VAR_POSITIONAL)
+                    for p in sig.parameters.values())
+            except (TypeError, ValueError):
+                self._takes_epoch = False
+        return r(self._epoch) if self._takes_epoch else r()
 
     def start(self) -> "DeviceLoader":
         """Spin up the prefetch worker for a fresh epoch (idempotent when
@@ -128,10 +161,22 @@ class DeviceLoader:
         q: Queue = Queue(maxsize=self._capacity)
         stop = threading.Event()
         convert = self._convert or _default_convert(self._block)
+        # restore_state() parks a skip count; this epoch's worker fast-
+        # forwards past it (raw next() only — no convert, no device_put)
+        skip, self._skip = self._skip, 0
+        self._consumed = skip
 
         def worker():
             try:
-                for batch in self._epoch_iterable():
+                it = iter(self._epoch_iterable())
+                for _ in range(skip):
+                    fault_point("loader.next")
+                    try:
+                        next(it)
+                    except StopIteration:
+                        break
+                for batch in it:
+                    fault_point("loader.next")
                     if stop.is_set():
                         return
                     t0 = time.perf_counter()
@@ -188,9 +233,17 @@ class DeviceLoader:
                 item = q.get()
                 _QUEUE_DEPTH.set(q.qsize())
                 if item is _EndOfEpoch:
+                    # the epoch delivered everything: advance the resume
+                    # cursor to the next epoch's start. (A stop-set
+                    # sentinel is close()'s cross-thread wake-up, not a
+                    # real epoch end — position must survive teardown.)
+                    if not stop.is_set():
+                        self._epoch += 1
+                        self._consumed = 0
                     return
                 if isinstance(item, _WorkerError):
                     raise item.exc
+                self._consumed += 1  # counted when DELIVERED, not queued
                 yield item
         finally:
             # normal exhaustion, consumer break, or consumer exception:
@@ -227,19 +280,24 @@ class DeviceLoader:
             # arrive on the queue again — don't block on it
             return {}, 0
         batches = []
-        ended = False
+        ended = epoch_done = False
         try:
             while len(batches) < k:
                 item = q.get()
                 _QUEUE_DEPTH.set(q.qsize())
                 if item is _EndOfEpoch:
                     ended = True
+                    epoch_done = not stop.is_set()
                     break
                 if isinstance(item, _WorkerError):
                     ended = True
                     raise item.exc
                 batches.append(item)
         finally:
+            self._consumed += len(batches)
+            if epoch_done:
+                self._epoch += 1
+                self._consumed = 0
             if ended:
                 # same teardown as _drain's finally: the worker must not
                 # outlive the epoch, and a later peek_many returns (_, 0)
@@ -260,6 +318,39 @@ class DeviceLoader:
         stacked = {name: jnp.stack([b[name] for b in batches])
                    for name in sorted(keys0)}
         return stacked, len(batches)
+
+    # -- deterministic resume ---------------------------------------------
+    def state(self) -> Dict[str, int]:
+        """Resume position: ``{"version", "epoch", "cursor"}`` — epochs
+        completed and batches delivered to the consumer this epoch. Safe
+        to call between steps (e.g. at checkpoint time): prefetched-but-
+        undelivered batches are NOT counted, so a restore replays exactly
+        the batches the training loop never saw."""
+        return {"version": 1, "epoch": int(self._epoch),
+                "cursor": int(self._consumed)}
+
+    def restore_state(self, state: Dict[str, int]) -> None:
+        """Rewind a fresh (non-running) loader to a `state()` snapshot:
+        the next epoch starts at ``state["epoch"]`` and fast-forwards past
+        ``state["cursor"]`` raw batches of a fresh reader — mid-epoch
+        crash-resume lands on exactly the next undelivered batch."""
+        if self.running:
+            raise RuntimeError(
+                "DeviceLoader.restore_state: loader is running; close() "
+                "it first (restore rewinds the NEXT epoch)")
+        version = int(state.get("version", 1))
+        if version != 1:
+            raise ValueError(
+                f"DeviceLoader.restore_state: unknown state version "
+                f"{version}")
+        epoch = int(state["epoch"])
+        cursor = int(state["cursor"])
+        if epoch < 0 or cursor < 0:
+            raise ValueError(
+                f"DeviceLoader.restore_state: bad state {state!r}")
+        self._epoch = epoch
+        self._consumed = cursor   # state() stays truthful pre-start
+        self._skip = cursor
 
     # -- shutdown ----------------------------------------------------------
     def close(self) -> None:

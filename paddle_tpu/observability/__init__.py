@@ -26,7 +26,13 @@ capability along its natural seam:
   live process: ``/metrics`` (Prometheus text), ``/metrics.json``,
   ``/healthz`` (pluggable named checks), ``/debug/steps``,
   ``/debug/flight``. Start with ``serve_introspection(port)`` or by
-  setting ``PDTPU_INTROSPECT_PORT``.
+  setting ``PDTPU_INTROSPECT_PORT``. While ``run_elastic`` runs it
+  carries the ``elastic/progress`` (wedge detection,
+  ``PDTPU_WEDGE_TIMEOUT``) and ``elastic/checkpoint`` (save in flight /
+  writer died) checks; the crash-consistency stack also feeds the
+  registry — ``checkpoint/fallback_steps``, ``checkpoint/write_retries``,
+  ``elastic/guard_degraded``, and ``faults/injected{site,action}`` from
+  the ``paddle_tpu.faults`` chaos harness.
 - **StepProfiler** (steps.py) — one structured record per executor
   dispatch (wall time, signature, compile flag, dataio queue/h2d,
   fetch wait, device memory) in a rolling window, with a median/MAD

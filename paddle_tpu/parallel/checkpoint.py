@@ -1,36 +1,65 @@
-"""Async, reshardable training checkpoints.
+"""Async, reshardable, crash-consistent training checkpoints.
 
 Reference analog: save/load ops streamed per var (save_op.cc, load_op.cc;
 io.py:487 save_persistables) plus the pserver checkpoint-notify hook
 (distributed_ops/checkpoint_notify_op.cc). The reference cannot restore
 under a different device topology (SURVEY §5 "no optimizer-state resharding
-on topology change"); this module can — the TPU-native bar.
+on topology change"), and a torn or bit-rotted checkpoint file kills the
+restore outright; this module fixes both — the TPU-native bar.
 
 Design (orbax-style, self-contained):
 - `save` snapshots every persistable var to host (device→host copies are
   started async, then a background thread finishes materialization and
   writes the bundle) — the training loop resumes while the write is in
   flight;
-- files are written to a temp name and renamed, and the `latest` marker is
-  updated only after the bundle is durable — a preemption mid-write never
-  corrupts the previous checkpoint;
+- files are written to a temp name, fsynced, and renamed; a per-file
+  SHA-256 **manifest** (``ckpt-<step>.manifest-<rank>.json``) is written
+  last as the commit record — a preemption mid-write never corrupts the
+  previous checkpoint, and a file torn *after* its rename (power loss,
+  bitrot) is caught at restore;
+- `restore` verifies the manifest before loading anything; on any
+  corruption or partial write it walks back newest→older to the most
+  recent checkpoint that verifies (``checkpoint/fallback_steps`` counter,
+  warning naming the bad files) instead of raising and dying — a run
+  resumes from the last GOOD checkpoint, never from a torn one;
+- the background writer retries transient I/O errors with capped
+  exponential backoff (``PDTPU_CKPT_RETRIES`` attempts,
+  ``PDTPU_CKPT_RETRY_BACKOFF_MS`` base delay) before `wait()` surfaces
+  the failure with the step and path;
 - bundles store plain host arrays, so `restore` works under ANY mesh: the
   compiler lifts host values into whatever sharding the new topology
   declares (CompiledProgram._run), which is what makes checkpoints
   reshardable across dp/tp splits.
+
+Crash-consistency is testable, not aspirational: ``paddle_tpu.faults``
+probes (`ckpt.bundle_write`, `ckpt.rename`, `ckpt.shard_write`,
+`ckpt.marker`) sit at every commit edge, and tests/test_elastic.py's
+chaos matrix kills the writer at each of them.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
 import threading
-from typing import Dict, Optional
+import time
+import warnings
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..core.executor import _RNG_STATE
 from ..core.program import Program, default_main_program
 from ..core.scope import Scope, _scope
+from ..faults import fault_point
+from ..observability.registry import get_registry
+
+_OBS = get_registry()
+# restore skipped a bad checkpoint and fell back to an older one
+_FALLBACK = _OBS.counter("checkpoint/fallback_steps")
+# background writer retried a transient I/O failure
+_RETRIES = _OBS.counter("checkpoint/write_retries")
 
 
 def _is_replicated(v) -> bool:
@@ -139,15 +168,44 @@ def _norm_index(index, shape):
     return out
 
 
+def _write_bytes(path: str, blob: bytes) -> Tuple[str, int]:
+    """Write + fsync `blob` to `path`; returns (sha256 hex, size). The
+    fsync keeps the manifest honest: once the hash is recorded the bytes
+    it covers are durable, so a post-rename power loss can't produce a
+    file that passes size checks but reads back zeros."""
+    with open(path, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    return hashlib.sha256(blob).hexdigest(), len(blob)
+
+
+def _hash_file(path: str) -> Tuple[str, int]:
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+            size += len(chunk)
+    return h.hexdigest(), size
+
+
 class Checkpointer:
     """`Checkpointer(dirname).save(step)` / `.restore()` over a Program's
-    persistables. One background writer thread; `wait()` joins it."""
+    persistables. One background writer thread; `wait()` joins it.
+
+    After a successful `restore()`, ``last_extra`` holds any ``@dataio@*``
+    keys the checkpoint carried (the input-pipeline cursor `run_elastic`
+    snapshots via ``save(extra=...)``)."""
 
     def __init__(self, dirname: str, keep: int = 3):
         self.dirname = dirname
         self.keep = keep
         self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
+        # (exception, step, path, attempts) of a failed background write
+        self._error: Optional[tuple] = None
+        self._current_path: Optional[str] = None
+        self.last_extra: Dict[str, object] = {}
         os.makedirs(dirname, exist_ok=True)
 
     def _path(self, step: int) -> str:
@@ -163,20 +221,45 @@ class Checkpointer:
                 return p
         return None
 
+    def _manifest_path(self, step: int, rank) -> str:
+        return os.path.join(self.dirname, f"ckpt-{step}.manifest-{rank}.json")
+
+    # -- background write --------------------------------------------------
     def _write(self, step: int, vals: Dict[str, object], shards=(),
                rank: int = 0):
-        try:
-            self._write_impl(step, vals, shards, rank)
-        except BaseException as e:  # surfaced by the next wait()/save()
-            self._error = e
+        """Writer-thread entry: retry transient I/O with capped exponential
+        backoff; any residual failure is surfaced by the next wait()/save()
+        (a silently lost checkpoint must not look durable)."""
+        retries = int(os.environ.get("PDTPU_CKPT_RETRIES", "3"))
+        backoff_ms = float(os.environ.get("PDTPU_CKPT_RETRY_BACKOFF_MS",
+                                          "100"))
+        attempt = 0
+        while True:
+            try:
+                self._write_impl(step, vals, shards, rank)
+                return
+            except OSError as e:
+                # transient filesystem error (NFS blip, EIO, injected
+                # fault): every tmp-write/rename in _write_impl is
+                # idempotent, so the whole write can simply run again
+                path = getattr(e, "filename", None) or self._current_path
+                if attempt >= retries:
+                    self._error = (e, step, path, attempt)
+                    return
+                _RETRIES.inc()
+                time.sleep(min(backoff_ms * (2 ** attempt), 5000.0) / 1e3)
+                attempt += 1
+            except BaseException as e:
+                self._error = (e, step, self._current_path, attempt)
+                return
 
-    def _write_shards(self, step: int, shards, rank: int):
-        """Per-process shard file + JSON index, both rename-durable. Each
-        process writes ONLY its addressable replica-0 shards; restore
-        merges every rank's index (shared-filesystem contract, same as the
-        reference's save_combine to a common dirname)."""
-        import json
-
+    def _write_shards(self, step: int, shards, rank: int,
+                      manifest: Dict[str, dict]):
+        """Per-process shard file + JSON index, both fsync+rename-durable
+        and recorded in `manifest`. Each process writes ONLY its
+        addressable replica-0 shards; restore merges every rank's index
+        (shared-filesystem contract, same as the reference's save_combine
+        to a common dirname)."""
         data = {}
         index: Dict[str, dict] = {}
         for name, bounds, shape, dtype, buf in shards:
@@ -187,29 +270,52 @@ class Checkpointer:
             ent["shards"].append({"key": key,
                                   "bounds": [list(b) for b in bounds]})
         spath = os.path.join(self.dirname, f"ckpt-{step}.shards-{rank}.pkl")
-        with open(spath + ".tmp", "wb") as f:
-            pickle.dump(data, f, protocol=4)
+        self._current_path = spath
+        digest, size = _write_bytes(spath + ".tmp",
+                                    pickle.dumps(data, protocol=4))
+        manifest[os.path.basename(spath)] = {"sha256": digest, "bytes": size}
+        fault_point("ckpt.shard_write", path=spath + ".tmp")
         os.replace(spath + ".tmp", spath)
         ipath = os.path.join(self.dirname, f"ckpt-{step}.index-{rank}.json")
-        with open(ipath + ".tmp", "w") as f:
-            json.dump(index, f)
+        self._current_path = ipath
+        digest, size = _write_bytes(ipath + ".tmp",
+                                    json.dumps(index).encode("utf-8"))
+        manifest[os.path.basename(ipath)] = {"sha256": digest, "bytes": size}
         os.replace(ipath + ".tmp", ipath)
+
+    def _write_manifest(self, step: int, rank, manifest: Dict[str, dict]):
+        """The commit record: written LAST, after every file it hashes is
+        durable under its final name. A step without its manifests is an
+        uncommitted (or pre-manifest legacy) checkpoint."""
+        mpath = self._manifest_path(step, rank)
+        self._current_path = mpath
+        blob = json.dumps({"step": step, "rank": rank, "files": manifest},
+                          sort_keys=True).encode("utf-8")
+        _write_bytes(mpath + ".tmp", blob)
+        os.replace(mpath + ".tmp", mpath)
 
     def _write_impl(self, step: int, vals: Dict[str, object], shards=(),
                     rank: int = 0):
+        manifest: Dict[str, dict] = {}
         if shards:
-            self._write_shards(step, shards, rank)
+            self._write_shards(step, shards, rank, manifest)
         if rank != 0:
+            if manifest:  # this rank's commit record for its shard files
+                self._write_manifest(step, rank, manifest)
             return  # replicated vars + marker are rank 0's job
         bundle = {n: np.asarray(v) for n, v in vals.items()}
         path = self._path(step)
         tmp = path + ".tmp"
+        self._current_path = path
         if path.endswith(".ptck"):
             # native framed writer (src/ckptio.cc — save_combine_op.cc
             # analog): buffered stdio + fsync off the Python thread
             from ..native import write_bundle
-            bundle["@step@"] = np.asarray(step, np.int64)
-            if not write_bundle(tmp, bundle):
+            nb = dict(bundle)
+            nb["@step@"] = np.asarray(step, np.int64)
+            if write_bundle(tmp, nb):
+                digest, size = _hash_file(tmp)
+            else:
                 # honor write_bundle's documented contract: fall back to
                 # pickle rather than losing the checkpoint
                 try:
@@ -218,17 +324,23 @@ class Checkpointer:
                     pass
                 path = os.path.join(self.dirname, f"ckpt-{step}.pkl")
                 tmp = path + ".tmp"
-                bundle.pop("@step@", None)
-                with open(tmp, "wb") as f:
-                    pickle.dump({"step": step, "vars": bundle}, f,
-                                protocol=4)
+                self._current_path = path
+                digest, size = _write_bytes(
+                    tmp, pickle.dumps({"step": step, "vars": bundle},
+                                      protocol=4))
         else:
-            with open(tmp, "wb") as f:
-                pickle.dump({"step": step, "vars": bundle}, f, protocol=4)
+            digest, size = _write_bytes(
+                tmp, pickle.dumps({"step": step, "vars": bundle},
+                                  protocol=4))
+        manifest[os.path.basename(path)] = {"sha256": digest, "bytes": size}
+        fault_point("ckpt.bundle_write", path=tmp)
         os.replace(tmp, path)  # atomic: never a half-written ckpt-N
+        fault_point("ckpt.rename", path=path)
+        self._write_manifest(step, 0, manifest)
         marker = os.path.join(self.dirname, "latest")
-        with open(marker + ".tmp", "w") as f:
-            f.write(str(step))
+        self._current_path = marker
+        _write_bytes(marker + ".tmp", str(step).encode("ascii"))
+        fault_point("ckpt.marker", path=marker + ".tmp")
         os.replace(marker + ".tmp", marker)
         self._gc(step)
 
@@ -244,7 +356,8 @@ class Checkpointer:
                         pass
                 for f in os.listdir(self.dirname):
                     if (f.startswith(f"ckpt-{s}.shards-")
-                            or f.startswith(f"ckpt-{s}.index-")):
+                            or f.startswith(f"ckpt-{s}.index-")
+                            or f.startswith(f"ckpt-{s}.manifest-")):
                         try:
                             os.remove(os.path.join(self.dirname, f))
                         except OSError:
@@ -264,16 +377,67 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         marker = os.path.join(self.dirname, "latest")
         if os.path.exists(marker):
-            with open(marker) as f:
-                s = int(f.read().strip())
-            if self._existing_path(s):
+            s = None
+            try:
+                with open(marker) as f:
+                    s = int(f.read().strip())
+            except (ValueError, OSError):
+                # empty or torn marker (crash between open and the rename,
+                # or a pre-fsync power loss): fall back to the dir scan
+                pass
+            if s is not None and self._existing_path(s):
                 return s
         steps = self.all_steps()
         return max(steps) if steps else None
 
+    # -- integrity ---------------------------------------------------------
+    def verify(self, step: int) -> List[str]:
+        """Check every file the step's manifests list (existence, size,
+        SHA-256). Returns [] when the step verifies. A step with no
+        manifest at all (pre-manifest legacy writer, or a crash after the
+        bundle rename but before the commit record) has nothing to check
+        against and is trusted as-is — its bundle rename was atomic."""
+        problems: List[str] = []
+        prefix = f"ckpt-{step}.manifest-"
+        for fname in sorted(os.listdir(self.dirname)):
+            if not (fname.startswith(prefix) and fname.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.dirname, fname)) as f:
+                    listed = json.load(f)["files"]
+            except (OSError, ValueError, KeyError) as e:
+                problems.append(f"{fname}: unreadable manifest "
+                                f"({type(e).__name__}: {e})")
+                continue
+            for base, ent in sorted(listed.items()):
+                p = os.path.join(self.dirname, base)
+                try:
+                    size = os.path.getsize(p)
+                except OSError:
+                    problems.append(
+                        f"{base}: listed in manifest {fname} but missing")
+                    continue
+                if int(ent.get("bytes", -1)) != size:
+                    problems.append(
+                        f"{base}: size {size} != manifest's "
+                        f"{ent.get('bytes')} (torn write)")
+                    continue
+                digest, _ = _hash_file(p)
+                if digest != ent.get("sha256"):
+                    problems.append(
+                        f"{base}: sha256 mismatch vs manifest {fname} "
+                        "(corrupt)")
+        return problems
+
+    # -- save --------------------------------------------------------------
     def save(self, step: int, program: Optional[Program] = None,
-             scope: Optional[Scope] = None, blocking: bool = False):
-        """Snapshot now, write in the background (orbax async-save shape)."""
+             scope: Optional[Scope] = None, blocking: bool = False,
+             extra: Optional[Dict[str, object]] = None):
+        """Snapshot now, write in the background (orbax async-save shape).
+
+        `extra` rides in the bundle verbatim (numpy-converted) — e.g.
+        ``@dataio@*`` input-pipeline cursors. Keys should start with ``@``
+        so they can never collide with a program variable."""
         import jax
 
         program = program or default_main_program()
@@ -308,6 +472,8 @@ class Checkpointer:
                     str(jax.random.key_impl(rng)))
             else:
                 vals["@rng@"] = np.asarray(rng)
+        for k, v in (extra or {}).items():
+            vals[k] = np.asarray(v)
         self._thread = threading.Thread(
             target=self._write, args=(step, vals, shards, rank), daemon=True)
         self._thread.start()
@@ -315,24 +481,28 @@ class Checkpointer:
             self.wait()
 
     def wait(self):
-        """Join the in-flight write; re-raises a writer failure (a silently
-        lost checkpoint must not look durable)."""
+        """Join the in-flight write; re-raises a writer failure naming the
+        step and the failing path (a silently lost checkpoint must not
+        look durable)."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
         if self._error is not None:
-            err, self._error = self._error, None
-            raise RuntimeError("checkpoint write failed") from err
+            (err, step, path, attempts), self._error = self._error, None
+            where = f" (path {path!r})" if path else ""
+            tried = (f" after {attempts + 1} attempts"
+                     if isinstance(err, OSError) and attempts else "")
+            raise RuntimeError(
+                f"checkpoint write failed at step {step}{where}{tried}"
+            ) from err
 
+    # -- restore -----------------------------------------------------------
     def _assemble_shards(self, step: int) -> Dict[str, np.ndarray]:
         """Merge every rank's shard files into full host arrays: works
         under ANY process count / mesh on restore — the reshardable part of
         the contract. Missing coverage raises instead of returning
         silently-partial parameters."""
-        import json
-
         out: Dict[str, np.ndarray] = {}
-        meta: Dict[str, dict] = {}
         placed: Dict[str, int] = {}
         for fname in sorted(os.listdir(self.dirname)):
             if not (fname.startswith(f"ckpt-{step}.index-")
@@ -349,7 +519,6 @@ class Checkpointer:
                 if name not in out:
                     out[name] = np.empty(tuple(ent["shape"],),
                                          dtype=ent["dtype"])
-                    meta[name] = ent
                     placed[name] = 0
                 for sh in ent["shards"]:
                     sl = tuple(slice(a, b) for a, b in sh["bounds"])
@@ -364,37 +533,23 @@ class Checkpointer:
                     f"index files — a rank's shard file is missing")
         return out
 
-    def restore(self, step: Optional[int] = None,
-                program: Optional[Program] = None,
-                scope: Optional[Scope] = None) -> Optional[int]:
-        """Load step (default: latest durable) into the scope as host arrays;
-        the next compiled step lifts them into the current mesh's shardings —
-        save under dp=8, restore under dp=4×tp=2 just works."""
-        program = program or default_main_program()
-        scope = scope or _scope()
-        self.wait()
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            return None
-        path = self._existing_path(step)
-        if path is None:
-            return None
+    def _load_step(self, step: int, path: str, program: Program):
+        """Read + assemble one checkpoint WITHOUT touching the scope: any
+        read error or shard-coverage gap surfaces here, before a single
+        var is mutated, so the fallback walk never leaves the scope
+        half-restored."""
         if path.endswith(".ptck"):
             from ..native import read_bundle
             bundle = read_bundle(path)
             if bundle is None:
                 raise RuntimeError(f"cannot read native checkpoint {path}")
             bundle.pop("@step@", None)
-            payload = {"step": step, "vars": bundle}
+            vars_ = bundle
         else:
             with open(path, "rb") as f:
-                payload = pickle.load(f)
+                vars_ = pickle.load(f)["vars"]
         names = {v.name for v in program.list_vars() if v.persistable}
-        manifest_raw = payload["vars"].pop("@shard_manifest@", None)
-        for n, arr in payload["vars"].items():
-            if n in names:
-                scope.set_var(n, arr)
+        manifest_raw = vars_.pop("@shard_manifest@", None)
         assembled = self._assemble_shards(step)
         if manifest_raw is not None:
             # backends may round-trip the string as a 0-d or 1-element array
@@ -408,21 +563,79 @@ class Checkpointer:
                     "index file — a rank's shard/index files are missing "
                     "(e.g. crash between rank-0's marker write and that "
                     "rank's background shard write)")
-        for n, arr in assembled.items():
-            if n in names:
-                scope.set_var(n, arr)
-        if "@rng@" in payload["vars"]:  # resume the random stream too
+        to_set = {n: arr for n, arr in vars_.items() if n in names}
+        to_set.update({n: a for n, a in assembled.items() if n in names})
+        rng_key = None
+        if "@rng@" in vars_:  # resume the random stream too
             import jax
             import jax.numpy as jnp
-            raw = payload["vars"]["@rng@"]
-            impl = payload["vars"].get("@rng_impl@")
+            raw = vars_["@rng@"]
+            impl = vars_.get("@rng_impl@")
             if impl is not None:
-                key = jax.random.wrap_key_data(jnp.asarray(raw),
-                                               impl=str(impl))
+                rng_key = jax.random.wrap_key_data(jnp.asarray(raw),
+                                                   impl=str(impl))
             else:
-                key = jnp.asarray(raw)
-            scope.set_var(_RNG_STATE, key)
-        return payload["step"]
+                rng_key = jnp.asarray(raw)
+        extra = {k: v for k, v in vars_.items() if k.startswith("@dataio@")}
+        return to_set, rng_key, extra
+
+    def restore(self, step: Optional[int] = None,
+                program: Optional[Program] = None,
+                scope: Optional[Scope] = None) -> Optional[int]:
+        """Load a checkpoint into the scope as host arrays; the next
+        compiled step lifts them into the current mesh's shardings — save
+        under dp=8, restore under dp=4×tp=2 just works.
+
+        With ``step=None`` the newest checkpoint that passes integrity
+        verification wins: a corrupt/torn candidate is skipped with a
+        warning naming the bad files (``checkpoint/fallback_steps``
+        counter), and the walk continues to older steps. Only when EVERY
+        candidate fails does restore raise. An explicit ``step`` is loaded
+        or fails — no silent substitution."""
+        program = program or default_main_program()
+        scope = scope or _scope()
+        self.wait()
+        self.last_extra = {}
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = sorted(set(self.all_steps()), reverse=True)
+        failures: List[str] = []
+        for st in candidates:
+            path = self._existing_path(st)
+            if path is None:
+                continue
+            bad = self.verify(st)
+            loaded = None
+            if not bad:
+                try:
+                    loaded = self._load_step(st, path, program)
+                except (RuntimeError, OSError, EOFError, ValueError,
+                        pickle.UnpicklingError) as e:
+                    bad = [f"{os.path.basename(path)}: "
+                           f"{type(e).__name__}: {e}"]
+            if bad:
+                desc = "; ".join(bad)
+                failures.append(f"step {st}: {desc}")
+                _FALLBACK.inc()
+                warnings.warn(
+                    f"checkpoint step {st} in {self.dirname!r} failed "
+                    f"integrity verification ({desc}); falling back to the "
+                    "next older checkpoint", RuntimeWarning)
+                continue
+            to_set, rng_key, extra = loaded
+            for n, arr in to_set.items():
+                scope.set_var(n, arr)
+            if rng_key is not None:
+                scope.set_var(_RNG_STATE, rng_key)
+            self.last_extra = extra
+            return st
+        if failures:
+            raise RuntimeError(
+                f"no verifiable checkpoint in {self.dirname!r}; every "
+                "candidate failed integrity verification: "
+                + " | ".join(failures))
+        return None
 
 
 def save_checkpoint(dirname: str, step: int, program=None, scope=None,

@@ -1,8 +1,22 @@
 """Preemptible trainer subprocess for tests/test_elastic.py.
 
 Trains a deterministic MLP via run_elastic; prints one line per completed
-step: `step <i> <loss>` (flushed, so the parent can SIGTERM mid-run), then
-`done <next_step>` on exit. Re-launching with the same --ckpt resumes.
+step: `step <i> <loss>` (full float repr, flushed, so the parent can
+SIGTERM mid-run), then `done <next_step>` on exit. Re-launching with the
+same --ckpt resumes.
+
+Modes:
+- default: one fixed feed dict, plain Executor — the minimal loop;
+- ``--reader``: a STATEFUL epoch-aware reader (each epoch's batches are a
+  function of the epoch index and batch position) pulled through a
+  DeviceLoader that run_elastic checkpoints/restores — resume must
+  skip-ahead to the exact next undelivered batch or losses diverge;
+- ``--tp N``: the weight carries a tensor-parallel shard_spec over a
+  dp×tp mesh, so every checkpoint writes per-rank shard files (the
+  ``ckpt.shard_write`` chaos target).
+
+Fault injection: the parent sets ``PDTPU_FAULT_SPEC`` in the environment;
+an injected ``crash`` exits with ``faults.CRASH_EXIT_CODE``.
 """
 import argparse
 import os
@@ -18,6 +32,8 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
+BATCHES_PER_EPOCH = 4
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -25,6 +41,10 @@ def main():
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--save-interval", type=int, default=2)
     ap.add_argument("--step-delay", type=float, default=0.0)
+    ap.add_argument("--reader", action="store_true",
+                    help="stateful epoch-aware reader via DeviceLoader")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel degree (shard files on save)")
     args = ap.parse_args()
 
     import paddle_tpu as fluid
@@ -40,29 +60,66 @@ def main():
         logits = fluid.layers.fc(
             x, 4, bias_attr=False,
             param_attr=ParamAttr(name="w",
-                                 initializer=NumpyArrayInitializer(w)))
+                                 initializer=NumpyArrayInitializer(w),
+                                 shard_spec=((None, "tp") if args.tp
+                                             else None)))
         loss = fluid.layers.mean(
             fluid.layers.softmax_with_cross_entropy(logits, y))
         fluid.optimizer.Adam(0.05).minimize(loss)
 
     rng = np.random.RandomState(0)
-    feed = {"x": rng.rand(32, 16).astype("float32"),
-            "y": rng.randint(0, 4, (32, 1)).astype("int64")}
+    fixed_feed = {"x": rng.rand(32, 16).astype("float32"),
+                  "y": rng.randint(0, 4, (32, 1)).astype("int64")}
+
+    def reader(epoch):
+        # epoch-aware and position-dependent: batch b of epoch e is always
+        # the same data, so a correct mid-epoch resume is bitwise-exact
+        # and a wrong cursor is immediately visible in the losses
+        r = np.random.RandomState(1000 + epoch)
+        for _ in range(BATCHES_PER_EPOCH):
+            yield {"x": r.rand(32, 16).astype("float32"),
+                   "y": r.randint(0, 4, (32, 1)).astype("int64")}
 
     with fluid.scope_guard(fluid.Scope()):
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(startup)
+        prog = main_p
+        if args.tp:
+            from paddle_tpu.parallel import make_mesh
+            dp = max(1, len(jax.devices()) // args.tp)
+            prog = fluid.CompiledProgram(main_p).with_mesh(
+                make_mesh({"dp": dp, "tp": args.tp}))
+
+        loader = None
+        if args.reader:
+            loader = fluid.DeviceLoader(reader, capacity=2, program=main_p)
+            it = None
+
+            def get_feed():
+                nonlocal it
+                if it is None:
+                    it = iter(loader)
+                try:
+                    return next(it)
+                except StopIteration:
+                    it = iter(loader)
+                    return next(it)
+        else:
+            def get_feed():
+                return fixed_feed
 
         def step_fn(i):
-            (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
-            print(f"step {i} {float(lv):.8f}", flush=True)
+            (lv,) = exe.run(prog, feed=get_feed(), fetch_list=[loss])
+            print(f"step {i} {float(lv)!r}", flush=True)
             if args.step_delay:
                 time.sleep(args.step_delay)
 
         nxt = run_elastic(step_fn, args.ckpt, args.steps,
                           save_interval=args.save_interval,
-                          program=main_p,
+                          program=main_p, loader=loader,
                           heartbeat=os.path.join(args.ckpt, "heartbeat"))
+        if loader is not None:
+            loader.close()
     print(f"done {nxt}", flush=True)
 
 

@@ -303,3 +303,134 @@ def test_restore_raises_on_missing_rank_shard_files(tmp_path):
         exe.run(startup)
         with pytest.raises(RuntimeError, match="manifest"):
             ck.restore(program=main)
+
+
+def test_latest_step_tolerates_torn_marker(tmp_path):
+    """A crash between the marker tmp-write and its rename (or a pre-fsync
+    power loss) can leave `latest` empty or garbled; latest_step must fall
+    back to the directory scan instead of raising."""
+    main, startup, feed, loss = _build()
+    ck = Checkpointer(str(tmp_path / "tm"))
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        ck.save(3, program=main, blocking=True)
+
+    marker = tmp_path / "tm" / "latest"
+    marker.write_text("")  # torn: zero bytes made it durable
+    assert ck.latest_step() == 3
+    marker.write_text("4x7\x00")  # garbled
+    assert ck.latest_step() == 3
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        assert ck.restore(program=main) == 3
+
+
+def test_corrupt_bundle_falls_back_with_warning_and_counter(tmp_path):
+    """Bitrot in the newest committed bundle: the manifest's sha256 catches
+    it, restore warns naming the file, increments
+    checkpoint/fallback_steps, and loads the older verified step."""
+    import pytest
+    from paddle_tpu.observability import get_registry
+
+    main, startup, feed, loss = _build()
+    ck = Checkpointer(str(tmp_path / "cb"))
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        ck.save(2, program=main, blocking=True)
+        w_at_2 = np.asarray(fluid.global_scope().find_var("w0"))
+        exe.run(main, feed=feed, fetch_list=[loss])
+        ck.save(4, program=main, blocking=True)
+
+    # flip bytes mid-file in the committed step-4 bundle
+    bundle = ck._existing_path(4)
+    with open(bundle, "r+b") as f:
+        f.seek(os.path.getsize(bundle) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    fallback = get_registry().counter("checkpoint/fallback_steps")
+    before = fallback.value
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        with pytest.warns(RuntimeWarning, match="ckpt-4"):
+            assert ck.restore(program=main) == 2
+        np.testing.assert_array_equal(
+            np.asarray(fluid.global_scope().find_var("w0")), w_at_2)
+    assert fallback.value == before + 1
+
+    # an explicitly requested corrupt step is NEVER silently substituted
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="sha256 mismatch"), \
+                pytest.warns(RuntimeWarning):
+            ck.restore(step=4, program=main)
+
+
+def test_writer_retries_transient_io_failure(tmp_path, monkeypatch):
+    """One injected bundle-write failure (InjectedFault is an OSError, like
+    an NFS blip): the background writer retries and the save lands;
+    checkpoint/write_retries counts the retry."""
+    from paddle_tpu import faults
+    from paddle_tpu.observability import get_registry
+
+    monkeypatch.setenv("PDTPU_CKPT_RETRY_BACKOFF_MS", "1")
+    retries = get_registry().counter("checkpoint/write_retries")
+    before = retries.value
+
+    main, startup, feed, loss = _build()
+    ck = Checkpointer(str(tmp_path / "rt"))
+    faults.clear()
+    faults.install("ckpt.bundle_write", "raise", count=1)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[loss])
+            ck.save(3, program=main, blocking=True)  # wait() must NOT raise
+    finally:
+        faults.clear()
+
+    assert retries.value == before + 1
+    assert ck.latest_step() == 3
+    assert ck.verify(3) == []
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        assert ck.restore(program=main) == 3
+
+
+def test_wait_error_names_step_and_path(tmp_path, monkeypatch):
+    """When retries are exhausted, wait() must say WHICH step and WHICH
+    file failed, and how many attempts were made — 'checkpoint write
+    failed' alone is undebuggable at 3am."""
+    import pytest
+    from paddle_tpu import faults
+
+    monkeypatch.setenv("PDTPU_CKPT_RETRIES", "1")
+    monkeypatch.setenv("PDTPU_CKPT_RETRY_BACKOFF_MS", "1")
+
+    main, startup, feed, loss = _build()
+    ck = Checkpointer(str(tmp_path / "we"))
+    faults.clear()
+    faults.install("ckpt.bundle_write", "raise")  # persistent: every attempt
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[loss])
+            with pytest.raises(
+                    RuntimeError,
+                    match=r"step 7 .*ckpt-7.* after 2 attempts") as ei:
+                ck.save(7, program=main, blocking=True)
+            assert isinstance(ei.value.__cause__, faults.InjectedFault)
+    finally:
+        faults.clear()

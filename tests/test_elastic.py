@@ -2,12 +2,24 @@
 notes the reference has no automatic restart or elastic recovery — only a
 pserver checkpoint-notify RPC). A trainer subprocess is SIGTERMed mid-run,
 relaunched, and must resume from its last durable checkpoint with loss
-continuity vs an uninterrupted run."""
+continuity vs an uninterrupted run.
+
+The chaos matrix goes further: ``PDTPU_FAULT_SPEC`` kills the trainer at
+every commit edge of the checkpoint writer (bundle write, bundle rename,
+shard write) and corrupts committed bundles; every cell must resume from
+a *verified* checkpoint — never from a torn one — and the stitched loss
+trajectory must match an uninterrupted reference. Over a stateful reader
+(``--reader``) the match must be bitwise: the input-pipeline cursor rides
+in the checkpoint."""
+import json
 import os
 import signal
 import subprocess
 import sys
+import threading
 import time
+import urllib.error
+import urllib.request
 
 import numpy as np
 import pytest
@@ -15,30 +27,69 @@ import pytest
 HERE = os.path.dirname(os.path.abspath(__file__))
 RUNNER = os.path.join(HERE, "elastic_runner.py")
 
+STEPS = 12
+BATCHES_PER_EPOCH = 4  # must match elastic_runner.BATCHES_PER_EPOCH
 
-def _launch(ckpt, steps=12, delay=0.0):
+
+def _launch(ckpt, steps=STEPS, delay=0.0, extra_args=(), env_extra=None,
+            capture_stderr=False):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
+    env.pop("PDTPU_FAULT_SPEC", None)
+    if env_extra:
+        env.update(env_extra)
     return subprocess.Popen(
         [sys.executable, RUNNER, "--ckpt", ckpt, "--steps", str(steps),
-         "--save-interval", "2", "--step-delay", str(delay)],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env)
+         "--save-interval", "2", "--step-delay", str(delay),
+         *extra_args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE if capture_stderr else subprocess.DEVNULL,
+        text=True, env=env)
 
 
 def _parse(out):
     losses = {}
     nxt = None
     for line in out.splitlines():
-        if line.startswith("step "):
-            _, i, lv = line.split()
-            losses[int(i)] = float(lv)
-        elif line.startswith("done "):
-            nxt = int(line.split()[1])
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "step":
+            try:
+                losses[int(parts[1])] = float(parts[2])
+            except ValueError:
+                pass  # line torn by an injected mid-print crash
+        elif len(parts) == 2 and parts[0] == "done":
+            nxt = int(parts[1])
     return losses, nxt
 
 
+@pytest.fixture(scope="module")
+def ref_reader(tmp_path_factory):
+    """Uninterrupted 12-step reference over the stateful epoch-aware
+    reader — the bitwise ground truth for every --reader resume test."""
+    p = _launch(str(tmp_path_factory.mktemp("ref_reader")),
+                extra_args=("--reader",))
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    losses, nxt = _parse(out)
+    assert nxt == STEPS and len(losses) == STEPS
+    return losses
+
+
+@pytest.fixture(scope="module")
+def ref_tp(tmp_path_factory):
+    """Uninterrupted 12-step reference with a tensor-parallel weight (the
+    mode whose checkpoints carry per-rank shard files)."""
+    p = _launch(str(tmp_path_factory.mktemp("ref_tp")),
+                extra_args=("--tp", "2"))
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    losses, nxt = _parse(out)
+    assert nxt == STEPS and len(losses) == STEPS
+    return losses
+
+
 def test_preempt_resume_loss_continuity(tmp_path):
-    steps = 12
+    steps = STEPS
 
     # uninterrupted reference run
     p = _launch(str(tmp_path / "ref"), steps=steps)
@@ -85,3 +136,208 @@ def test_preempt_resume_loss_continuity(tmp_path):
     for i in range(steps):
         np.testing.assert_allclose(stitched[i], ref_losses[i], rtol=1e-5,
                                    err_msg=f"step {i}")
+
+
+def test_sigterm_mid_epoch_resume_is_bitwise_identical(tmp_path, ref_reader):
+    """ROADMAP item 5 acceptance: SIGTERM mid-epoch over a STATEFUL reader,
+    relaunch, and the stitched loss trajectory is bitwise-identical to an
+    uninterrupted run — possible only because run_elastic checkpoints the
+    DeviceLoader's (epoch, cursor) and the resumed loader replays exactly
+    the batches the killed run never consumed."""
+    ck = str(tmp_path / "el")
+    p = _launch(ck, delay=0.25, extra_args=("--reader",))
+    seen = 0
+    t0 = time.time()
+    lines = []
+    while seen < 5 and time.time() - t0 < 240:
+        line = p.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith("step "):
+            seen += 1
+    assert seen >= 5, "".join(lines)
+    p.send_signal(signal.SIGTERM)
+    out, _ = p.communicate(timeout=120)
+    assert p.returncode == 0
+    losses_a, resume_at = _parse("".join(lines) + out)
+    # the signal lands within a step or two of the 5th line: squarely
+    # inside epoch 1 (epochs are BATCHES_PER_EPOCH=4 steps)
+    assert resume_at is not None and 5 <= resume_at <= 7, resume_at
+    assert resume_at % BATCHES_PER_EPOCH != 0  # genuinely mid-epoch
+
+    hb = open(os.path.join(ck, "heartbeat")).read().split()
+    assert int(hb[0]) == resume_at
+
+    p = _launch(ck, extra_args=("--reader",))
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    losses_b, nxt = _parse(out)
+    assert nxt == STEPS
+    assert min(losses_b) == resume_at
+
+    stitched = dict(losses_a)
+    stitched.update(losses_b)
+    for i in range(STEPS):
+        assert stitched[i] == ref_reader[i], (
+            f"step {i}: {stitched[i]!r} != {ref_reader[i]!r} — resume is "
+            "not bitwise-deterministic over the stateful reader")
+
+
+# chaos matrix: (fault spec, runner mode, expected resume step, bitwise?)
+# - bundle_write crash@2: dies during the SECOND save (step 4) after the
+#   bundle tmp is written but before its rename — step 4 never commits,
+#   resume must come from step 2;
+# - rename crash@2: dies after the bundle rename but before the manifest
+#   commit record — the step-4 bundle is complete (atomic rename), so the
+#   fallback walk may trust it and resume at 4;
+# - shard_write crash@2 (tensor-parallel mode): dies after a per-rank
+#   shard tmp write, before any of step 4's files commit — resume from 2.
+CHAOS_CELLS = [
+    ("bundle", "ckpt.bundle_write:crash@2", ("--reader",), 2, True),
+    ("rename", "ckpt.rename:crash@2", ("--reader",), 4, True),
+    ("shard", "ckpt.shard_write:crash@2", ("--tp", "2"), 2, False),
+]
+
+
+@pytest.mark.parametrize("spec,mode,resume_expected,exact",
+                         [c[1:] for c in CHAOS_CELLS],
+                         ids=[c[0] for c in CHAOS_CELLS])
+def test_chaos_matrix_crash_resumes_from_verified_checkpoint(
+        spec, mode, resume_expected, exact, tmp_path, ref_reader, ref_tp):
+    from paddle_tpu import faults
+
+    ref = ref_reader if "--reader" in mode else ref_tp
+    ck = str(tmp_path / "ck")
+    p = _launch(ck, extra_args=mode, env_extra={"PDTPU_FAULT_SPEC": spec})
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == faults.CRASH_EXIT_CODE, out
+    losses_a, nxt = _parse(out)
+    assert nxt is None  # killed mid-run, not completed
+
+    # relaunch with no faults: must resume from the newest checkpoint that
+    # VERIFIES, never from the torn step the crash left behind
+    p = _launch(ck, extra_args=mode)
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    losses_b, nxt = _parse(out)
+    assert nxt == STEPS
+    assert min(losses_b) == resume_expected
+
+    stitched = dict(losses_a)
+    stitched.update(losses_b)
+    for i in range(STEPS):
+        if exact:
+            assert stitched[i] == ref[i], f"step {i}"
+        else:
+            np.testing.assert_allclose(stitched[i], ref[i], rtol=1e-6,
+                                       err_msg=f"step {i}")
+
+
+def test_corrupt_latest_bundle_falls_back_to_older_verified(tmp_path,
+                                                            ref_reader):
+    """The 4th bundle write (the final step-8 save) is corrupted AFTER its
+    hash was recorded — the write 'succeeds', the file is committed, and
+    only the manifest knows. The relaunch must detect the mismatch, warn
+    naming the bad file, and fall back to the step-6 checkpoint."""
+    ck = str(tmp_path / "ck")
+    p = _launch(ck, steps=8, extra_args=("--reader",),
+                env_extra={"PDTPU_FAULT_SPEC": "ckpt.bundle_write:corrupt@4"})
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out  # corruption is silent at write time
+    losses_a, nxt = _parse(out)
+    assert nxt == 8
+    for i in range(8):
+        assert losses_a[i] == ref_reader[i], f"step {i}"
+
+    p = _launch(ck, steps=STEPS, extra_args=("--reader",),
+                capture_stderr=True)
+    out, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err
+    losses_b, nxt = _parse(out)
+    assert nxt == STEPS
+    assert min(losses_b) == 6, (out, err)  # fell back past corrupt step 8
+    assert "ckpt-8" in err and "sha256 mismatch" in err, err
+    for i in range(6, STEPS):
+        assert losses_b[i] == ref_reader[i], f"step {i}"
+
+
+def test_healthz_reports_elastic_checks_and_wedge(tmp_path, monkeypatch):
+    """While run_elastic runs, /healthz must expose elastic/checkpoint
+    (degraded while an async save is in flight) and elastic/progress
+    (failing — HTTP 503 — once no step completes for PDTPU_WEDGE_TIMEOUT);
+    off the main thread the PreemptionGuard degradation is visible on the
+    elastic/guard_degraded gauge; on exit both checks unregister."""
+    import paddle_tpu as fluid
+    from paddle_tpu import faults
+    from paddle_tpu.distributed import run_elastic
+    from paddle_tpu.observability.http import (IntrospectionServer,
+                                               run_health_checks)
+    from paddle_tpu.observability.registry import get_registry
+
+    monkeypatch.setenv("PDTPU_WEDGE_TIMEOUT", "0.25")
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    feed = {"x": np.ones((2, 4), np.float32)}
+
+    srv = IntrospectionServer(port=0).start()
+    faults.clear()
+    # every save's bundle write stalls 250 ms: a wide, deterministic
+    # "save in flight" window for the degraded assertion
+    faults.install("ckpt.bundle_write", "delay_ms", value=250.0)
+    release = threading.Event()
+    result = []
+
+    def healthz():
+        try:
+            r = urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+            return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+
+        def step_fn(i):
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+            if i == 5:
+                release.wait(timeout=30)  # wedge: no step completes
+
+        th = threading.Thread(target=lambda: result.append(
+            run_elastic(step_fn, str(tmp_path / "hc"), 8, save_interval=1,
+                        program=main_p)))
+        th.start()
+        try:
+            saw_degraded = saw_failing = False
+            deadline = time.time() + 30
+            while (time.time() < deadline
+                   and not (saw_degraded and saw_failing)):
+                code, body = healthz()
+                checks = body.get("checks", {})
+                ck = checks.get("elastic/checkpoint", {})
+                if ck.get("status") == "degraded":
+                    saw_degraded = True
+                pg = checks.get("elastic/progress", {})
+                if pg.get("status") == "failing":
+                    saw_failing = True
+                    assert code == 503 and body["status"] == "failing"
+                time.sleep(0.02)
+            assert saw_degraded, "never saw an in-flight save as degraded"
+            assert saw_failing, "wedged step never turned /healthz failing"
+            # run_elastic is on a worker thread here, so its guard cannot
+            # install signal handlers — the degradation must be LOUD
+            assert get_registry().gauge("elastic/guard_degraded").value == 1
+        finally:
+            release.set()
+            th.join(timeout=120)
+            faults.clear()
+            srv.stop()
+
+    assert result == [8]
+    _, checks = run_health_checks()
+    assert not any(k.startswith("elastic/") for k in checks), checks

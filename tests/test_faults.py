@@ -1,0 +1,99 @@
+"""paddle_tpu.faults: the chaos harness itself — spec grammar, count
+triggering, the corrupt action, env-var arming, and the injected-fault
+metrics counter. (The end-to-end kills live in tests/test_elastic.py's
+chaos matrix; these pin the harness semantics those tests lean on.)"""
+import time
+
+import pytest
+
+from paddle_tpu import faults
+from paddle_tpu.observability import get_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestSpecGrammar:
+    def test_full_grammar_roundtrip(self):
+        rules = faults.parse_spec(
+            "ckpt.shard_write:crash@2, loader.next:delay_ms=50,"
+            "ckpt.bundle_write:corrupt")
+        assert [repr(r) for r in rules] == [
+            "ckpt.shard_write:crash@2", "loader.next:delay_ms=50",
+            "ckpt.bundle_write:corrupt"]
+
+    @pytest.mark.parametrize("bad,msg", [
+        ("ckpt.rename", "site:action"),
+        ("ckpt.rename:explode", "unknown"),
+        ("ckpt.rename:crash@x", "not an integer"),
+        ("ckpt.rename:crash@0", ">= 1"),
+        ("ckpt.rename:delay_ms", "needs a value"),
+        ("ckpt.rename:delay_ms=fast", "not a number"),
+    ])
+    def test_malformed_entries_raise(self, bad, msg):
+        with pytest.raises(ValueError, match=msg):
+            faults.parse_spec(bad)
+
+    def test_install_rejects_unknown_action(self):
+        with pytest.raises(ValueError, match="unknown fault action"):
+            faults.install("x", "explode")
+
+
+class TestTriggering:
+    def test_counted_rule_fires_on_nth_hit_only(self):
+        faults.install("t.site", "raise", count=2)
+        faults.fault_point("t.site")  # hit 1: armed, silent
+        with pytest.raises(faults.InjectedFault, match="t.site"):
+            faults.fault_point("t.site")  # hit 2: fires
+        faults.fault_point("t.site")  # hit 3: one-shot, spent
+        assert faults.hits("t.site") == 3
+
+    def test_uncounted_rule_fires_every_hit(self):
+        faults.install("t.every", "raise")
+        for _ in range(3):
+            with pytest.raises(faults.InjectedFault):
+                faults.fault_point("t.every")
+
+    def test_idle_harness_is_a_noop_and_counts_nothing(self):
+        faults.fault_point("t.idle")
+        assert faults.hits("t.idle") == 0  # counting starts when armed
+        assert faults.active_rules() == []
+
+    def test_injected_fault_is_an_oserror(self):
+        # the checkpoint writer's transient-I/O retry loop must treat an
+        # injected failure exactly like a real one
+        assert issubclass(faults.InjectedFault, OSError)
+
+    def test_env_spec_arms_and_rearms(self, monkeypatch):
+        monkeypatch.setenv("PDTPU_FAULT_SPEC", "t.env:raise@1")
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("t.env")
+        # changing the variable re-parses on the next probe
+        monkeypatch.delenv("PDTPU_FAULT_SPEC")
+        faults.fault_point("t.env")  # no rules: no-op
+
+    def test_delay_action_sleeps_and_counts_metric(self):
+        c = get_registry().counter("faults/injected", site="t.slow",
+                                   action="delay_ms")
+        before = c.value
+        faults.install("t.slow", "delay_ms", value=40)
+        t0 = time.perf_counter()
+        faults.fault_point("t.slow")
+        assert time.perf_counter() - t0 >= 0.03
+        assert c.value == before + 1
+
+    def test_corrupt_action_flips_bytes_in_place(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"\x00" * 4096)
+        faults.install("t.rot", "corrupt")
+        faults.fault_point("t.rot", path=str(p))
+        after = p.read_bytes()
+        assert len(after) == 4096  # same size: corruption, not truncation
+        assert after != b"\x00" * 4096
+        # pathless probes and missing files are tolerated (no crash)
+        faults.fault_point("t.rot")
+        faults.fault_point("t.rot", path=str(tmp_path / "missing"))
