@@ -36,6 +36,7 @@ from . import faults  # noqa: F401
 from . import observability  # noqa: F401
 from . import parallel  # noqa: F401
 from . import planner  # noqa: F401
+from . import ps  # noqa: F401
 from . import profiler  # noqa: F401
 from . import serving  # noqa: F401
 from . import reader as py_reader_module  # noqa: F401

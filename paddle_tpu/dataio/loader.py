@@ -399,6 +399,14 @@ class DeviceLoader:
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    @property
+    def queue_depth(self) -> int:
+        """Prefetched batches currently queued (0 when not running).
+        Consumers that care about overlap (the PS tier's prefetch-hit
+        accounting) read this just before blocking on the next batch."""
+        q = self._queue
+        return q.qsize() if q is not None else 0
+
 
 def close_all_loaders() -> int:
     """Close every live DeviceLoader (Executor.close / test teardown

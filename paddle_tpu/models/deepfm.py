@@ -113,7 +113,7 @@ def build_train_program(vocab_size=100000, num_fields=26, num_dense=13,
                         embed_dim=16, lr=1e-3, shard_axis=None,
                         is_sparse=False, embedding_optimizer=None,
                         deferred_rows=None, fused_table=False,
-                        packed_rows=None):
+                        packed_rows=None, hidden_sizes=(400, 400, 400)):
     """embedding_optimizer="sgd"/"adagrad"/"adam" puts the Criteo-scale
     table(s) on their own rule while the dense net keeps Adam — the
     reference's CTR practice (Downpour sparse tables run their own rule
@@ -141,6 +141,7 @@ def build_train_program(vocab_size=100000, num_fields=26, num_dense=13,
         dense = layers.data("dense", [num_dense])
         label = layers.data("label", [1])
         logit = deepfm(ids, dense, vocab_size, num_fields, embed_dim,
+                       hidden_sizes=hidden_sizes,
                        shard_axis=shard_axis, is_sparse=is_sparse,
                        fused_table=fused_table, state_mult=state_mult,
                        row_packed=packed_rows is not None)

@@ -114,6 +114,12 @@ def uniq_merge(ids, rows, r):
         raise ValueError(
             f"deferred rows_per_step={r} is smaller than this step's "
             f"{qn} lookup rows — raise rows_per_step (static capacity)")
+    if qn == 0:
+        # the segment machinery below needs >= 1 element (`first` would be
+        # [1] against 0 rows); an empty batch is all pads by definition
+        return (jnp.full((r,), SENTINEL, jnp.int32),
+                jnp.zeros((r, d), rows.dtype),
+                jnp.zeros((r,), jnp.int32))
     order = jnp.argsort(ids)
     sids = ids[order]
     srows = rows[order]

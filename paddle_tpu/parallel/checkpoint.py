@@ -432,19 +432,42 @@ class Checkpointer:
     # -- save --------------------------------------------------------------
     def save(self, step: int, program: Optional[Program] = None,
              scope: Optional[Scope] = None, blocking: bool = False,
-             extra: Optional[Dict[str, object]] = None):
+             extra: Optional[Dict[str, object]] = None,
+             ps_tables: Optional[Dict[str, object]] = None):
         """Snapshot now, write in the background (orbax async-save shape).
 
         `extra` rides in the bundle verbatim (numpy-converted) — e.g.
         ``@dataio@*`` input-pipeline cursors. Keys should start with ``@``
-        so they can never collide with a program variable."""
+        so they can never collide with a program variable.
+
+        `ps_tables` ({table_name: ps.ShardedTable}) adds the PS embedding
+        tier's shards to the same per-rank shard files + manifest path:
+        each shard's slice is dumped NOW (snapshot semantics — flush the
+        tier's pushers first) under the ``<name>@ps`` key, one record per
+        shard, so a shard's bytes ride the identical tmp→fsync→rename +
+        SHA-256 commit protocol as a ZeRO-sharded var."""
         import jax
 
         program = program or default_main_program()
         scope = scope or _scope()
         self.wait()  # one write in flight at a time
         vals, shards = _snapshot(program, scope)
+        shards = list(shards)
+        ps_names = []
+        for tname, table in (ps_tables or {}).items():
+            psn = f"{tname}@ps"
+            ps_names.append(psn)
+            spec, lanes = table.spec, table.lanes
+            for i in range(spec.num_shards):
+                lo, hi = spec.bounds(i)
+                shards.append((psn, ((lo, hi), (0, lanes)),
+                               (spec.vocab, lanes), "uint16",
+                               table.dump_shard(i)))
         rank = jax.process_index()
+        if ps_names:
+            # restore-side coverage check: which PS tables this
+            # checkpoint is supposed to contain
+            vals["@ps_manifest@"] = np.asarray("\n".join(sorted(ps_names)))
         if rank == 0:
             # manifest of every sharded var name (ADVICE r3): rank 0 sees
             # the GLOBAL sharding of each array even though it holds only
@@ -550,6 +573,7 @@ class Checkpointer:
                 vars_ = pickle.load(f)["vars"]
         names = {v.name for v in program.list_vars() if v.persistable}
         manifest_raw = vars_.pop("@shard_manifest@", None)
+        ps_manifest_raw = vars_.pop("@ps_manifest@", None)
         assembled = self._assemble_shards(step)
         if manifest_raw is not None:
             # backends may round-trip the string as a 0-d or 1-element array
@@ -563,6 +587,18 @@ class Checkpointer:
                     "index file — a rank's shard/index files are missing "
                     "(e.g. crash between rank-0's marker write and that "
                     "rank's background shard write)")
+        if ps_manifest_raw is not None:
+            raw = np.asarray(ps_manifest_raw).ravel()
+            expected_ps = set("\n".join(str(x) for x in raw).split("\n"))
+            missing_ps = sorted(expected_ps - set(assembled))
+            if missing_ps:
+                raise RuntimeError(
+                    f"checkpoint step {step}: PS tables {missing_ps} are "
+                    "in the save-time manifest but absent from every "
+                    "rank's index file — the shard files are missing")
+        # `@ps`-suffixed names are never program vars, so the `n in names`
+        # filter below keeps them out of the scope; they flow back through
+        # the fourth return for ShardedTable.load_full
         to_set = {n: arr for n, arr in vars_.items() if n in names}
         to_set.update({n: a for n, a in assembled.items() if n in names})
         rng_key = None
@@ -577,11 +613,13 @@ class Checkpointer:
             else:
                 rng_key = jnp.asarray(raw)
         extra = {k: v for k, v in vars_.items() if k.startswith("@dataio@")}
-        return to_set, rng_key, extra
+        return to_set, rng_key, extra, assembled
 
     def restore(self, step: Optional[int] = None,
                 program: Optional[Program] = None,
-                scope: Optional[Scope] = None) -> Optional[int]:
+                scope: Optional[Scope] = None,
+                ps_tables: Optional[Dict[str, object]] = None
+                ) -> Optional[int]:
         """Load a checkpoint into the scope as host arrays; the next
         compiled step lifts them into the current mesh's shardings — save
         under dp=8, restore under dp=4×tp=2 just works.
@@ -591,7 +629,15 @@ class Checkpointer:
         warning naming the bad files (``checkpoint/fallback_steps``
         counter), and the walk continues to older steps. Only when EVERY
         candidate fails does restore raise. An explicit ``step`` is loaded
-        or fails — no silent substitution."""
+        or fails — no silent substitution.
+
+        `ps_tables` ({table_name: ps.ShardedTable}) restores PS embedding
+        shards too: the checkpoint's ``<name>@ps`` slices are assembled
+        into the full table and re-partitioned onto each table's LIVE
+        range spec — restoring onto a different shard count than the save
+        just works. Coverage is validated BEFORE the scope or any shard is
+        mutated; a candidate missing a requested table falls back like any
+        other integrity failure."""
         program = program or default_main_program()
         scope = scope or _scope()
         self.wait()
@@ -614,6 +660,20 @@ class Checkpointer:
                         pickle.UnpicklingError) as e:
                     bad = [f"{os.path.basename(path)}: "
                            f"{type(e).__name__}: {e}"]
+            if not bad and loaded is not None and ps_tables:
+                # every requested table must be fully present with the
+                # right geometry before ANY state mutates
+                assembled = loaded[3]
+                for tname, table in ps_tables.items():
+                    psn = f"{tname}@ps"
+                    want = (table.spec.vocab, table.lanes)
+                    if psn not in assembled:
+                        bad.append(f"PS table {tname!r}: no {psn!r} "
+                                   "shards in this checkpoint")
+                    elif assembled[psn].shape != want:
+                        bad.append(
+                            f"PS table {tname!r}: checkpoint shape "
+                            f"{assembled[psn].shape} != live {want}")
             if bad:
                 desc = "; ".join(bad)
                 failures.append(f"step {st}: {desc}")
@@ -623,11 +683,13 @@ class Checkpointer:
                     f"integrity verification ({desc}); falling back to the "
                     "next older checkpoint", RuntimeWarning)
                 continue
-            to_set, rng_key, extra = loaded
+            to_set, rng_key, extra, assembled = loaded
             for n, arr in to_set.items():
                 scope.set_var(n, arr)
             if rng_key is not None:
                 scope.set_var(_RNG_STATE, rng_key)
+            for tname, table in (ps_tables or {}).items():
+                table.load_full(assembled[f"{tname}@ps"])
             self.last_extra = extra
             return st
         if failures:

@@ -5,9 +5,13 @@ Reference analog: ``python/paddle/fluid/incubate/fleet/base/fleet_base.py:37``
 PaddleCloudRoleMaker env-based, UserDefinedRoleMaker), and the collective
 implementation (incubate/fleet/collective/__init__.py:41 CollectiveOptimizer).
 
-TPU-native: only the collective mode exists (pserver mode is a documented
-non-goal — SURVEY §2.2 Pslib row); workers are jax processes, the optimizer
-wraps the program in a data-parallel CompiledProgram over the fleet mesh.
+TPU-native collective mode: workers are jax processes, the optimizer wraps
+the program in a data-parallel CompiledProgram over the fleet mesh. Since
+the PS embedding tier landed (paddle_tpu.ps), role makers can also produce
+SERVER roles — ``TRAINING_ROLE=PSERVER`` + ``PADDLE_PSERVER_ENDPOINTS``
+turn a process into an embedding shard server (``fleet.init_server()`` /
+``run_server()``), mirroring the reference's transpiler/pslib launch
+environment. Servers never touch jax or the TPU.
 """
 from __future__ import annotations
 
@@ -27,6 +31,15 @@ class Role:
     SERVER = 2
 
 
+def _pserver_endpoints_env() -> List[str]:
+    """The pserver endpoint list from either env spelling the reference
+    launchers used (fleet launch_ps: PADDLE_PSERVERS_IP_PORT_LIST;
+    transpiler docs: PADDLE_PSERVER_ENDPOINTS)."""
+    raw = (os.environ.get("PADDLE_PSERVER_ENDPOINTS")
+           or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST") or "")
+    return [e.strip() for e in raw.split(",") if e.strip()]
+
+
 class RoleMakerBase:
     def __init__(self):
         self._role = Role.WORKER
@@ -38,10 +51,10 @@ class RoleMakerBase:
         return self._role == Role.WORKER
 
     def is_server(self) -> bool:
-        return False  # no pservers on TPU
+        return self._role == Role.SERVER
 
     def is_first_worker(self) -> bool:
-        return self.worker_index() == 0
+        return self.is_worker() and self.worker_index() == 0
 
     def worker_num(self) -> int:
         return 1
@@ -49,16 +62,57 @@ class RoleMakerBase:
     def worker_index(self) -> int:
         return 0
 
+    def server_num(self) -> int:
+        return len(self.server_endpoints())
+
+    def server_index(self) -> int:
+        return 0
+
+    def server_endpoints(self) -> List[str]:
+        return []
+
 
 class PaddleCloudRoleMaker(RoleMakerBase):
     """Env-var role maker (role_maker.py PaddleCloudRoleMaker parity):
-    reads PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS."""
+    TRAINING_ROLE selects TRAINER vs PSERVER; trainers read
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS,
+    servers read PADDLE_PSERVER_ENDPOINTS (or the launcher's
+    PADDLE_PSERVERS_IP_PORT_LIST) with the current server resolved from
+    PADDLE_PSERVER_ID, or POD_IP:PADDLE_PORT matched against the list."""
 
     def __init__(self, is_collective: bool = True):
         super().__init__()
         self._is_collective = is_collective
+        self._server_eps: List[str] = []
+        self._server_idx = 0
 
     def generate_role(self):
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._server_eps = _pserver_endpoints_env()
+        if role == "PSERVER":
+            self._role = Role.SERVER
+            if not self._server_eps:
+                raise ValueError(
+                    "TRAINING_ROLE=PSERVER but no PADDLE_PSERVER_ENDPOINTS/"
+                    "PADDLE_PSERVERS_IP_PORT_LIST in the environment")
+            sid = os.environ.get("PADDLE_PSERVER_ID")
+            if sid is not None:
+                self._server_idx = int(sid)
+            else:
+                cur = (f"{os.environ.get('POD_IP', '127.0.0.1')}:"
+                       f"{os.environ.get('PADDLE_PORT', '')}")
+                if cur not in self._server_eps:
+                    raise ValueError(
+                        f"cannot locate this pserver: {cur!r} is not in "
+                        f"the endpoint list {self._server_eps} (set "
+                        f"PADDLE_PSERVER_ID, or POD_IP + PADDLE_PORT)")
+                self._server_idx = self._server_eps.index(cur)
+            if not (0 <= self._server_idx < len(self._server_eps)):
+                raise ValueError(
+                    f"PADDLE_PSERVER_ID={self._server_idx} out of range "
+                    f"for {len(self._server_eps)} endpoints")
+            return  # a server must not grab the TPU / jax distributed
+        self._role = Role.WORKER
         init_parallel_env()
 
     def worker_num(self) -> int:
@@ -73,6 +127,12 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         except Exception:
             return int(os.environ.get("PADDLE_TRAINER_ID", 0))
 
+    def server_index(self) -> int:
+        return self._server_idx
+
+    def server_endpoints(self) -> List[str]:
+        return list(self._server_eps)
+
 
 class UserDefinedRoleMaker(RoleMakerBase):
     def __init__(self, current_id: int = 0, role=Role.WORKER,
@@ -81,12 +141,19 @@ class UserDefinedRoleMaker(RoleMakerBase):
         self._cur = current_id
         self._num = worker_num
         self._role = role
+        self._server_eps = list(server_endpoints or [])
 
     def worker_num(self) -> int:
         return self._num
 
     def worker_index(self) -> int:
-        return self._cur
+        return self._cur if self._role == Role.WORKER else 0
+
+    def server_index(self) -> int:
+        return self._cur if self._role == Role.SERVER else 0
+
+    def server_endpoints(self) -> List[str]:
+        return list(self._server_eps)
 
 
 class Fleet:
@@ -96,6 +163,7 @@ class Fleet:
         self._role_maker: Optional[RoleMakerBase] = None
         self._strategy: Optional[DistributedStrategy] = None
         self.main_program = None
+        self._ps_server = None
 
     def init(self, role_maker: Optional[RoleMakerBase] = None,
              is_collective: bool = True):
@@ -107,7 +175,7 @@ class Fleet:
         return self._role_maker is None or self._role_maker.is_worker()
 
     def is_server(self) -> bool:
-        return False
+        return self._role_maker is not None and self._role_maker.is_server()
 
     def is_first_worker(self) -> bool:
         return self._role_maker is None or self._role_maker.is_first_worker()
@@ -121,16 +189,57 @@ class Fleet:
     def worker_endpoints(self) -> List[str]:
         return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
 
-    # collective mode has no servers; these are no-ops for API compat
+    def server_num(self) -> int:
+        return self._role_maker.server_num() if self._role_maker else 0
+
+    def server_index(self) -> int:
+        return self._role_maker.server_index() if self._role_maker else 0
+
+    def server_endpoints(self) -> List[str]:
+        return (self._role_maker.server_endpoints() if self._role_maker
+                else [])
+
     def init_worker(self):
         pass
 
-    def init_server(self, *a, **kw):
-        pass
+    def init_server(self, shards=None, endpoint: Optional[str] = None):
+        """Stand up this process's embedding shard server (reference
+        ``fleet.init_server()``; ``run_server()`` then blocks serving).
+
+        shards: the ``ps.EmbeddingShard`` slices this server hosts — e.g.
+        ``ps.make_shards(...)[fleet.server_index()]`` per table. Without
+        shards this stays the collective-mode no-op.
+        endpoint: bind address; defaults to this server's entry in the
+        role maker's endpoint list.
+        """
+        if shards is None:
+            return None
+        from ..ps.transport import ShardServer
+        if endpoint is None:
+            eps = self.server_endpoints()
+            if not eps:
+                raise RuntimeError(
+                    "fleet.init_server: no endpoint given and the role "
+                    "maker has no server endpoints (set "
+                    "PADDLE_PSERVER_ENDPOINTS / TRAINING_ROLE=PSERVER)")
+            endpoint = eps[self.server_index()]
+        host, port = endpoint.rsplit(":", 1)
+        self._ps_server = ShardServer(shards, host=host, port=int(port))
+        return self._ps_server
 
     def run_server(self):
-        raise RuntimeError("parameter servers are a non-goal on TPU "
-                           "(use sharded embeddings — SURVEY §2.2)")
+        """Serve embedding shards until shutdown (blocks)."""
+        if self._ps_server is None:
+            raise RuntimeError(
+                "fleet.run_server: call init_server(shards=...) first "
+                "(dense pserver mode remains a non-goal on TPU; only the "
+                "paddle_tpu.ps embedding tier has servers)")
+        self._ps_server.serve_forever()
+
+    def stop_server(self):
+        if self._ps_server is not None:
+            self._ps_server.stop()
+            self._ps_server = None
 
     def stop_worker(self):
         pass

@@ -64,6 +64,15 @@ class DistributedStrategy:
         # per-unit predicate `unit_name -> False|True|"minimal"|"full"`
         self.remat_policy = None
         self.gradient_merge_steps = 1     # microbatch accumulation
+        # sharded parameter-server embedding tier (paddle_tpu.ps):
+        # 0 = tables stay as ordinary in-program params; N >= 1 = range-
+        # partition each PS-bound table over N shards
+        self.embedding_shards = 0
+        # pull prefetch depth (batches converted+pulled ahead of compute;
+        # 0 = inline pulls) and push staleness (0 = synchronous exact,
+        # k >= 1 = at most k push batches in flight behind compute)
+        self.pull_ahead = 1
+        self.push_depth = 0
         # reference-compat knobs (no-ops on TPU; XLA owns these)
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False

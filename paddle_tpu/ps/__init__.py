@@ -1,0 +1,33 @@
+"""paddle_tpu.ps — sharded parameter-server embedding tier.
+
+The sparse half of the reference's large-scale stack (Downpour pservers +
+device workers behind ``FleetWrapper``/``Communicator``), rebuilt on this
+repo's packed row-major tables:
+
+* :mod:`.shard` — ``RangeSpec`` (contiguous row-range partition) and
+  ``EmbeddingShard`` (one table slice as packed ``[n, 128] uint16`` rows;
+  numpy-only so pserver processes never import JAX);
+* :mod:`.transport` — ``ShardClient`` (in-process direct dispatch or a
+  length-prefixed socket protocol) and ``ShardServer`` (what
+  ``fleet.run_server()`` runs);
+* :mod:`.table` — ``ShardedTable``: sorted-id fan-out pull/push with
+  per-shard byte accounting;
+* :mod:`.tier` — ``PsEmbeddingTier``: the worker-side training driver
+  with async pull prefetch (rides ``dataio.DeviceLoader``) and bounded-
+  depth async push, bitwise-exact vs the single-table packed baseline.
+
+Configured through ``DistributedStrategy`` (``embedding_shards``,
+``pull_ahead``, ``push_depth``) and the fleet role makers
+(``TRAINING_ROLE=PSERVER`` + ``PADDLE_PSERVER_ENDPOINTS``).
+"""
+from .shard import EmbeddingShard, RangeSpec, make_shards  # noqa: F401
+from .table import ShardedTable  # noqa: F401
+from .tier import PsEmbeddingTier, PsTableBinding  # noqa: F401
+from .transport import (InProcessClient, ShardClient,  # noqa: F401
+                        ShardServer, SocketClient, connect)
+
+__all__ = [
+    "RangeSpec", "EmbeddingShard", "make_shards",
+    "ShardClient", "InProcessClient", "SocketClient", "ShardServer",
+    "connect", "ShardedTable", "PsTableBinding", "PsEmbeddingTier",
+]

@@ -1,0 +1,204 @@
+"""ShardedTable — one logical embedding table fanned out over N shards.
+
+The worker-side aggregation point: takes SORTED unique global row ids
+(what ``uniq_merge`` / ``np.unique`` produce), slices them into per-shard
+contiguous chunks via the range spec, fans pull/push out across the shard
+clients, and re-assembles pulls by concatenation (sorted ids + ordered
+ranges ⇒ shard chunks are adjacent slices — no scatter on the hot path).
+
+Fan-out uses one long-lived thread per shard only when there is more than
+one shard: for the in-process single-shard case direct dispatch is
+cheaper, and for socket shards the threads are what actually buys
+parallelism (each client connection is its own TCP stream).
+
+Metrics: ``ps/pull_ms`` / ``ps/push_ms`` histograms and
+``ps/bytes_pulled`` / ``ps/bytes_pushed`` counters land in the process
+`observability` Registry; per-shard byte counters are kept here as plain
+ints (read by the bench's ``ps_embedding`` record and ``stats()``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..observability import get_registry
+from .shard import EmbeddingShard, RangeSpec, make_shards
+from .transport import InProcessClient, ShardClient
+
+__all__ = ["ShardedTable"]
+
+
+class ShardedTable:
+    """Client-side view of one range-partitioned table.
+
+    ``clients[i]`` serves rows ``spec.bounds(i)``; several tables may
+    share the same client objects (one worker process per shard hosting
+    every table's slice), so the executor pool is per-table but sized by
+    shard count.
+    """
+
+    def __init__(self, name: str, spec: RangeSpec,
+                 clients: Sequence[ShardClient], lanes: int = 128,
+                 push_clients: Optional[Sequence[ShardClient]] = None):
+        """push_clients: optional dedicated channel for pushes. A socket
+        client serializes requests on its one connection, so when an
+        async pusher (push_depth >= 1) shares clients with the pull
+        prefetcher, every push queues behind — and delays — the next
+        prefetch pull to the same shard. A second connection per shard
+        lets them truly overlap; read-your-writes patching in the tier
+        already covers the pull/push race. Defaults to `clients`
+        (in-process dispatch has no per-connection serialization)."""
+        if len(clients) != spec.num_shards:
+            raise ValueError(
+                f"ShardedTable {name!r}: {len(clients)} clients for "
+                f"{spec.num_shards} shards")
+        if (push_clients is not None
+                and len(push_clients) != spec.num_shards):
+            raise ValueError(
+                f"ShardedTable {name!r}: {len(push_clients)} push clients "
+                f"for {spec.num_shards} shards")
+        self.name = str(name)
+        self.spec = spec
+        self.clients = list(clients)
+        self.push_clients = (list(push_clients) if push_clients is not None
+                             else self.clients)
+        self.lanes = int(lanes)
+        self.bytes_pulled_per_shard = [0] * spec.num_shards
+        self.bytes_pushed_per_shard = [0] * spec.num_shards
+        self._acct = threading.Lock()
+        # with a dual channel, pulls and pushes run concurrently — size
+        # the pool so one side never starves the other of workers
+        self._pool = (ThreadPoolExecutor(
+            max_workers=spec.num_shards * (
+                2 if push_clients is not None else 1),
+            thread_name_prefix=f"ps-{name}")
+            if spec.num_shards > 1 else None)
+        reg = get_registry()
+        self._h_pull = reg.histogram("ps/pull_ms")
+        self._h_push = reg.histogram("ps/push_ms")
+        self._c_pulled = reg.counter("ps/bytes_pulled")
+        self._c_pushed = reg.counter("ps/bytes_pushed")
+
+    @classmethod
+    def build_in_process(cls, name: str, spec: RangeSpec,
+                         full_rows: Optional[np.ndarray] = None,
+                         lanes: int = 128) -> "ShardedTable":
+        """Single-host convenience: materialize the shards in this
+        process (optionally pre-loaded from a full packed table) behind
+        in-process clients."""
+        shards = make_shards(name, spec, full_rows, lanes=lanes)
+        return cls(name, spec, [InProcessClient([s]) for s in shards],
+                   lanes=lanes)
+
+    # ------------------------------------------------------------- fan-out
+    def _chunks(self, sorted_ids: np.ndarray):
+        """(shard_index, id-slice) for each shard that owns any of the
+        ids. ``sorted_ids`` must be ascending (asserted cheaply at the
+        ends — full monotonicity is the caller's contract)."""
+        sorted_ids = np.asarray(sorted_ids, dtype=np.int64)
+        cuts = self.spec.cuts_into(sorted_ids)
+        out = []
+        for i in range(self.spec.num_shards):
+            a, b = int(cuts[i]), int(cuts[i + 1])
+            if b > a:
+                out.append((i, slice(a, b)))
+        return sorted_ids, out
+
+    def _run(self, jobs):
+        """Execute (shard_index, thunk) jobs, parallel across shards."""
+        if self._pool is None or len(jobs) <= 1:
+            return [(i, fn()) for i, fn in jobs]
+        futs = [(i, self._pool.submit(fn)) for i, fn in jobs]
+        return [(i, f.result()) for i, f in futs]
+
+    def pull(self, sorted_uids: np.ndarray) -> np.ndarray:
+        """Packed rows ``[k, lanes] uint16`` for ascending unique ids."""
+        t0 = time.perf_counter()
+        ids, chunks = self._chunks(sorted_uids)
+        if not chunks:
+            out = np.zeros((0, self.lanes), dtype=np.uint16)
+        else:
+            jobs = [(i, (lambda i=i, sl=sl: self.clients[i].pull(
+                self.name, ids[sl]))) for i, sl in chunks]
+            parts = self._run(jobs)
+            out = (parts[0][1] if len(parts) == 1
+                   else np.concatenate([r for _, r in parts], axis=0))
+        nb = out.nbytes
+        with self._acct:
+            for (i, sl) in chunks:
+                self.bytes_pulled_per_shard[i] += (
+                    (sl.stop - sl.start) * self.lanes * 2)
+        self._c_pulled.inc(nb)
+        self._h_pull.observe((time.perf_counter() - t0) * 1e3)
+        return out
+
+    def push(self, sorted_uids: np.ndarray, rows: np.ndarray) -> None:
+        """Scatter-set whole rows at ascending unique ids."""
+        t0 = time.perf_counter()
+        ids, chunks = self._chunks(sorted_uids)
+        rows = np.asarray(rows, dtype=np.uint16)
+        if rows.shape != (ids.shape[0], self.lanes):
+            raise ValueError(
+                f"ShardedTable {self.name!r}: push rows {rows.shape} != "
+                f"({ids.shape[0]}, {self.lanes})")
+        jobs = [(i, (lambda i=i, sl=sl: self.push_clients[i].push(
+            self.name, ids[sl], rows[sl]))) for i, sl in chunks]
+        self._run(jobs)
+        nb = rows.nbytes
+        with self._acct:
+            for (i, sl) in chunks:
+                self.bytes_pushed_per_shard[i] += (
+                    (sl.stop - sl.start) * self.lanes * 2)
+        self._c_pushed.inc(nb)
+        self._h_push.observe((time.perf_counter() - t0) * 1e3)
+
+    # -------------------------------------------------------- full-table io
+    def dump_shard(self, i: int) -> np.ndarray:
+        return self.clients[i].dump(self.name)
+
+    def dump_full(self) -> np.ndarray:
+        """Assemble the whole ``[vocab, lanes]`` table (checkpoint save;
+        ranges are ordered and exhaustive so this is a concat)."""
+        parts = self._run([(i, (lambda i=i: self.clients[i].dump(self.name)))
+                           for i in range(self.spec.num_shards)])
+        return np.concatenate([p for _, p in parts], axis=0)
+
+    def load_full(self, full_rows: np.ndarray) -> None:
+        """Re-partition a full table onto the LIVE spec — this is what
+        makes restore-onto-a-different-shard-count work: the checkpoint
+        stores per-shard slices, `_assemble_shards` merges them into the
+        full array, and this scatter follows the current boundaries."""
+        full_rows = np.asarray(full_rows, dtype=np.uint16)
+        if full_rows.shape != (self.spec.vocab, self.lanes):
+            raise ValueError(
+                f"ShardedTable {self.name!r}: load_full shape "
+                f"{full_rows.shape} != ({self.spec.vocab}, {self.lanes})")
+        jobs = []
+        for i in range(self.spec.num_shards):
+            lo, hi = self.spec.bounds(i)
+            jobs.append((i, (lambda i=i, lo=lo, hi=hi:
+                             self.clients[i].load(
+                                 self.name, full_rows[lo:hi]))))
+        self._run(jobs)
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        per_shard = []
+        for i in range(self.spec.num_shards):
+            lo, hi = self.spec.bounds(i)
+            per_shard.append({
+                "shard": i, "lo": lo, "hi": hi, "rows": hi - lo,
+                "bytes_pulled": self.bytes_pulled_per_shard[i],
+                "bytes_pushed": self.bytes_pushed_per_shard[i],
+            })
+        return {"name": self.name, "vocab": self.spec.vocab,
+                "num_shards": self.spec.num_shards,
+                "lanes": self.lanes, "shards": per_shard}
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)

@@ -1,0 +1,421 @@
+"""PsEmbeddingTier — overlapped pull/push training over sharded tables.
+
+Reference analog: the worker half of the Downpour loop — ``FleetWrapper::
+PullSparseVarsSync`` before the forward, ``PushSparseVarsWithLabelAsync``
+after the backward, with the Communicator batching the pushes. Here the
+same overlap rides the repo's own machinery:
+
+* the **pull prefetcher** is a ``dataio.DeviceLoader`` with a custom
+  ``convert``: while step N computes, the loader worker peeks batch N+1,
+  extracts the global row ids of every bound table, dedups them host-side
+  (``np.unique`` — the host mirror of ``uniq_merge``'s device contract:
+  ascending uniques + inverse positions), rewrites the id feeds to LOCAL
+  cache rows, fans per-shard pulls out through ``ShardedTable``, and
+  lands the gathered rows on device — all before dispatch;
+* the step itself runs unchanged: the program's table param is a CACHE of
+  ``cache_rows`` packed rows; ``scope.set_var`` swaps the pulled cache in,
+  the packed optimizer (``adagrad_row_packed`` et al.) updates it
+  in-scope, and rows ``[0, U)`` of the result are exactly the new values
+  of the step's U unique ids;
+* **push** slices those U rows and hands them to a per-table pusher —
+  ``push_depth`` 0 applies synchronously (staleness-0 exact), k ≥ 1
+  queues them on a flusher thread with at most k batches in flight, so
+  the host→shard write happens under the next step's compute.
+
+Exactness. The global→local id remap is strictly monotone (uids are
+ascending), ``jnp.argsort`` is stable, so the in-step ``uniq_merge``
+permutation — and therefore the duplicate-gradient merge order and every
+downstream float op — is bit-identical to the single-table run. With
+``push_depth ≥ 1`` a prefetched pull can race an in-flight push; the tier
+repairs that at dispatch with read-your-writes patching: every pull
+records the pusher's ``applied_seq`` snapshot, and any push issued after
+it is scatter-patched into the cache device-side before the step (pushes
+carry absolute rows, so patching is idempotent under the race). A pull so
+stale that its missing pushes have left the patch window falls back to
+flush + re-pull (``ps/repulls``). Net: single-worker training is bitwise
+exact at ANY depth; ``push_depth`` only relaxes cross-worker visibility.
+
+Metrics: ``ps/prefetch_hit``/``ps/prefetch_miss`` (was batch N+1 already
+converted+pulled when the loop asked?), ``ps/patched_rows``,
+``ps/repulls`` — plus ``ps/pull_ms``/``ps/push_ms``/``ps/bytes_*`` from
+the table layer.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..faults import fault_point
+from ..observability import get_registry
+from .table import ShardedTable
+
+__all__ = ["PsTableBinding", "PsEmbeddingTier"]
+
+
+class PsTableBinding:
+    """One PS-backed table: which program param is its cache and which
+    feed names carry its global row ids."""
+
+    def __init__(self, param: str, table: ShardedTable,
+                 id_feeds: Sequence[str]):
+        if not id_feeds:
+            raise ValueError(f"PsTableBinding {param!r}: need at least "
+                             f"one id feed")
+        self.param = str(param)
+        self.table = table
+        self.id_feeds = list(id_feeds)
+
+
+class _Entry:
+    """One table's pulled state for one batch."""
+    __slots__ = ("uids", "n", "cache", "version")
+
+    def __init__(self, uids, n, cache, version):
+        self.uids = uids      # ascending unique global ids, [n] int64
+        self.n = n
+        self.cache = cache    # [cache_rows, lanes] u16 device array
+        self.version = version  # pusher.applied_seq snapshot before pull
+
+
+class _Prepared:
+    """One converted batch: device feeds (ids already local) + per-table
+    pull entries."""
+    __slots__ = ("feed", "entries")
+
+    def __init__(self, feed, entries):
+        self.feed = feed
+        self.entries = entries  # param -> _Entry
+
+
+class _Pusher:
+    """Per-table push applier with bounded in-flight depth.
+
+    depth 0: ``submit`` applies inline (synchronous exact). depth k: a
+    flusher thread drains a queue of maxsize k — ``submit`` blocks only
+    when k batches are already in flight. ``recent`` keeps the last few
+    submitted batches for read-your-writes patching; it is touched ONLY
+    by the submitting thread.
+    """
+
+    def __init__(self, table: ShardedTable, depth: int, window: int):
+        self.table = table
+        self.depth = int(depth)
+        self.issued_seq = 0
+        self.applied_seq = 0
+        self.recent = deque(maxlen=window)  # (seq, uids_np, rows_dev)
+        self._cv = threading.Condition()
+        self._err: Optional[BaseException] = None
+        self._q = None
+        self._thread = None
+        if self.depth > 0:
+            import queue as _qm
+            self._q = _qm.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._drain, daemon=True,
+                name=f"ps-push-{table.name}")
+            self._thread.start()
+
+    def _apply(self, seq, uids, rows):
+        fault_point("ps.push")
+        # rows is the FULL cache (fixed shape — keeps the patcher's
+        # device ops at a handful of compiled shapes); np.asarray is the
+        # device sync — on depth>0 it happens HERE, on the flusher
+        # thread, off the step path — and the host-side slice keeps the
+        # shard write at the batch's n rows
+        if uids.size:
+            self.table.push(uids, np.asarray(rows)[:uids.size])
+        with self._cv:
+            self.applied_seq = seq
+            self._cv.notify_all()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            seq, uids, rows = item
+            try:
+                self._apply(seq, uids, rows)
+            except BaseException as e:
+                with self._cv:
+                    self._err = e
+                    self.applied_seq = seq  # unblock flush(); err re-raised
+                    self._cv.notify_all()
+
+    def submit(self, uids: np.ndarray, rows) -> int:
+        """Queue (or apply) one push batch; returns its seq."""
+        self._check()
+        self.issued_seq += 1
+        seq = self.issued_seq
+        self.recent.append((seq, uids, rows))
+        if self.depth == 0:
+            self._apply(seq, uids, rows)
+        else:
+            self._q.put((seq, uids, rows))  # blocks at depth in flight
+        return seq
+
+    def flush(self):
+        """Block until every submitted push is applied on the shards."""
+        with self._cv:
+            while self.applied_seq < self.issued_seq and self._err is None:
+                self._cv.wait(timeout=0.5)
+        self._check()
+
+    def _check(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(
+                f"ps push to table {self.table.name!r} failed") from err
+
+    def close(self):
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class PsEmbeddingTier:
+    """Drives a program whose sparse tables live on PS shards.
+
+    Usage (what ``fleet.init_worker`` + the bench do)::
+
+        tier = PsEmbeddingTier(program, bindings,
+                               pull_ahead=strategy.pull_ahead,
+                               push_depth=strategy.push_depth)
+        for prepared in tier.steps(reader, scope=sc):
+            loss, = tier.run_step(exe, prepared, fetch_list=[loss_var],
+                                  scope=sc)
+        tier.flush()
+
+    ``pull_ahead`` ≥ 1 prefetches (a DeviceLoader of that capacity runs
+    convert+pull on a worker thread); 0 converts inline on the calling
+    thread — the honest A/B for the overlap benchmark.
+    """
+
+    def __init__(self, program, bindings: Sequence[PsTableBinding],
+                 pull_ahead: int = 1, push_depth: int = 0):
+        if pull_ahead < 0 or push_depth < 0:
+            raise ValueError(f"pull_ahead/push_depth must be >= 0, got "
+                             f"{pull_ahead}/{push_depth}")
+        self.program = program
+        self.bindings = list(bindings)
+        if not self.bindings:
+            raise ValueError("PsEmbeddingTier: no table bindings")
+        self.pull_ahead = int(pull_ahead)
+        self.push_depth = int(push_depth)
+        block = program.global_block()
+        self._cache_shape: Dict[str, tuple] = {}
+        self._id_dtype: Dict[str, object] = {}
+        for b in self.bindings:
+            v = block.var(b.param)
+            rows, lanes = int(v.shape[0]), int(v.shape[1])
+            if lanes != b.table.lanes:
+                raise ValueError(
+                    f"cache param {b.param!r} has {lanes} lanes but table "
+                    f"{b.table.name!r} has {b.table.lanes}")
+            self._cache_shape[b.param] = (rows, lanes)
+        # patch window: every pull can be behind by at most the prefetch
+        # depth plus the in-flight pushes (+ slack for the re-pull path)
+        window = self.pull_ahead + self.push_depth + 2
+        self._pushers = {b.param: _Pusher(b.table, push_depth, window)
+                         for b in self.bindings}
+        reg = get_registry()
+        self._c_hit = reg.counter("ps/prefetch_hit")
+        self._c_miss = reg.counter("ps/prefetch_miss")
+        self._c_patched = reg.counter("ps/patched_rows")
+        self._c_repulls = reg.counter("ps/repulls")
+        self._loader = None
+        self._patch_fn = None  # lazily-jitted gather+scatter (no jax here)
+
+    # ----------------------------------------------------------- pull path
+    def _pull_cache(self, binding: PsTableBinding, uids: np.ndarray,
+                    version: int):
+        """Pull rows for `uids`, pad to the cache shape, land on device."""
+        import jax
+        import jax.numpy as jnp
+
+        fault_point("ps.pull")
+        rows_cap, lanes = self._cache_shape[binding.param]
+        if uids.shape[0] > rows_cap:
+            raise ValueError(
+                f"batch touches {uids.shape[0]} unique rows of table "
+                f"{binding.table.name!r} but cache param {binding.param!r} "
+                f"holds only {rows_cap}; rebuild the program with a larger "
+                f"cache (>= max unique ids per batch)")
+        pulled = binding.table.pull(uids)
+        cache = np.zeros((rows_cap, lanes), dtype=np.uint16)
+        cache[:uids.shape[0]] = pulled
+        return _Entry(uids, int(uids.shape[0]),
+                      jax.device_put(jnp.asarray(cache)), version)
+
+    def _convert(self, batch: Dict[str, object]) -> _Prepared:
+        """Loader-worker work: dedup ids, localize feeds, pull caches,
+        then the standard feed validation + device_put."""
+        from ..dataio.loader import _default_convert
+
+        out = dict(batch)
+        entries: Dict[str, _Entry] = {}
+        for b in self.bindings:
+            arrs = [np.asarray(out[f]) for f in b.id_feeds]
+            flat = (np.concatenate([a.ravel() for a in arrs])
+                    if arrs else np.zeros((0,), np.int64))
+            uids, inv = np.unique(flat.astype(np.int64),
+                                  return_inverse=True)
+            off = 0
+            for f, a in zip(b.id_feeds, arrs):
+                loc = inv[off:off + a.size].reshape(a.shape)
+                out[f] = loc.astype(a.dtype if a.dtype.kind in "iu"
+                                    else np.int64)
+                off += a.size
+            version = self._pushers[b.param].applied_seq
+            entries[b.param] = self._pull_cache(b, uids, version)
+        feed = _default_convert(self.program.global_block())(out)
+        return _Prepared(feed, entries)
+
+    def steps(self, reader, scope=None) -> Iterable[_Prepared]:
+        """Iterate prepared batches. With ``pull_ahead >= 1`` the convert
+        + pull runs on a DeviceLoader worker `pull_ahead` batches ahead;
+        with 0 it runs inline."""
+        if self.pull_ahead == 0:
+            it = reader() if callable(reader) else reader
+            for batch in it:
+                self._c_miss.inc()  # inline = never overlapped
+                yield self._convert(batch)
+            return
+        from ..dataio.loader import DeviceLoader
+        loader = DeviceLoader(reader, capacity=self.pull_ahead,
+                              convert=self._convert,
+                              name="ps_prefetch")
+        self._loader = loader
+        try:
+            it = iter(loader)
+            while True:
+                ready = loader.queue_depth > 0
+                try:
+                    prepared = next(it)
+                except StopIteration:
+                    return
+                (self._c_hit if ready else self._c_miss).inc()
+                yield prepared
+        finally:
+            loader.close()
+            self._loader = None
+
+    # ------------------------------------------------------ dispatch + push
+    def _patched_cache(self, binding: PsTableBinding, entry: _Entry):
+        """Read-your-writes repair: overlay every push the pull could not
+        have seen. Ascending-seq order so later pushes win."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._patch_fn is None:
+            # one fused gather+scatter per pending push; jitted so the
+            # step path pays one dispatch, not a chain of eager ops
+            self._patch_fn = jax.jit(
+                lambda cache, prows, tgt, src: cache.at[tgt].set(prows[src]))
+        pusher = self._pushers[binding.param]
+        pusher._check()
+        if pusher.issued_seq == entry.version:
+            return entry.cache  # pull already reflects everything issued
+        oldest_kept = (pusher.recent[0][0] if pusher.recent
+                       else pusher.issued_seq + 1)
+        if entry.version + 1 < oldest_kept:
+            # pushes this pull missed have left the window: flush and
+            # re-pull (rare — only when a consumer stalls far behind)
+            self._c_repulls.inc()
+            pusher.flush()
+            fresh = self._pull_cache(binding, entry.uids,
+                                     pusher.applied_seq)
+            return fresh.cache
+        cache = entry.cache
+        n = entry.n
+        for seq, puids, prows in list(pusher.recent):
+            if seq <= entry.version or puids.size == 0 or n == 0:
+                continue
+            pos = np.searchsorted(entry.uids, puids)
+            posc = np.minimum(pos, n - 1)
+            mask = entry.uids[posc] == puids
+            if not mask.any():
+                continue
+            tgt = posc[mask].astype(np.int32)
+            src = np.nonzero(mask)[0].astype(np.int32)
+            k = int(tgt.size)
+            # pad to a power-of-two bucket by repeating the last pair —
+            # the duplicate writes carry identical values, so the scatter
+            # stays deterministic while XLA sees O(log cache) distinct
+            # shapes instead of one fresh compile per step
+            pad = (1 << (k - 1).bit_length()) - k
+            if pad:
+                tgt = np.concatenate([tgt, np.full(pad, tgt[-1], np.int32)])
+                src = np.concatenate([src, np.full(pad, src[-1], np.int32)])
+            cache = self._patch_fn(cache, jnp.asarray(prows),
+                                   jnp.asarray(tgt), jnp.asarray(src))
+            self._c_patched.inc(k)
+        return cache
+
+    def run_step(self, exe, prepared: _Prepared, fetch_list=None,
+                 scope=None, **run_kw):
+        """One training step: swap caches in, run, push updated rows."""
+        from ..core.scope import _scope  # thread-local default scope
+
+        sc = scope if scope is not None else _scope()
+        for b in self.bindings:
+            entry = prepared.entries[b.param]
+            sc.set_var(b.param, self._patched_cache(b, entry))
+        out = exe.run(self.program, feed=prepared.feed,
+                      fetch_list=fetch_list, scope=sc, **run_kw)
+        for b in self.bindings:
+            entry = prepared.entries[b.param]
+            # hand the pusher the full fixed-shape cache: the patcher can
+            # then gather from it without a per-n recompile, and the
+            # device→host sync + [:n] slice happen in the pusher; the
+            # buffer is never re-fed to the program (set_var replaces it
+            # before the next run), so it cannot be donated out from
+            # under the flusher
+            new_cache = sc.find_var(b.param)
+            self._pushers[b.param].submit(entry.uids, new_cache)
+        return out
+
+    def train(self, exe, reader, fetch_list=None, scope=None,
+              max_steps: Optional[int] = None):
+        """Convenience loop: yields each step's fetch results."""
+        done = 0
+        for prepared in self.steps(reader, scope=scope):
+            yield self.run_step(exe, prepared, fetch_list=fetch_list,
+                                scope=scope)
+            done += 1
+            if max_steps is not None and done >= max_steps:
+                break
+        self.flush()
+
+    # ------------------------------------------------------------ lifecycle
+    def flush(self):
+        """Drain every pusher — after this the shards hold every update
+        (checkpoint save and the exactness tests call this)."""
+        for p in self._pushers.values():
+            p.flush()
+
+    def stats(self) -> dict:
+        return {b.param: b.table.stats() for b in self.bindings}
+
+    def close(self):
+        if self._loader is not None:
+            self._loader.close()
+            self._loader = None
+        for p in self._pushers.values():
+            p.close()
+        for b in self.bindings:
+            b.table.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self.flush()
+        finally:
+            self.close()
+        return False

@@ -1,0 +1,331 @@
+"""Transport for the PS embedding tier: clients and the shard server.
+
+Two transports behind one interface:
+
+* ``InProcessClient`` — the shard object lives in this process; calls are
+  direct method dispatch. This is what single-host training and the tier-1
+  tests use (no sockets, no pickling, zero copies beyond the pull itself).
+* ``SocketClient`` / ``ShardServer`` — a length-prefixed binary protocol
+  over TCP so shards can live in other processes or hosts (the reference's
+  pserver processes; ``fleet.run_server()`` ends up in
+  ``ShardServer.serve_forever``). The server side is numpy + stdlib only —
+  a pserver must never import JAX or touch the TPU.
+
+Wire format: every message is ``<u32 length><pickle payload>``; array
+payloads ride as ``(dtype-str, shape, bytes)`` triples so unpickling costs
+one ``np.frombuffer`` (no object arrays, protocol 4). One request, one
+reply; the server is thread-per-connection and a client keeps one
+persistent connection per shard (requests on it are serialized by a lock,
+concurrency comes from fanning out across shards).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .shard import EmbeddingShard
+
+__all__ = ["ShardClient", "InProcessClient", "SocketClient", "ShardServer",
+           "connect"]
+
+_LEN = struct.Struct("<I")
+_MAX_MSG = 1 << 30  # 1 GiB sanity cap on a single message
+
+
+# ---------------------------------------------------------------- encoding
+
+def _enc_arr(a: np.ndarray) -> tuple:
+    a = np.ascontiguousarray(a)
+    return ("__nd__", str(a.dtype), a.shape, a.tobytes())
+
+
+def _dec_arr(t) -> np.ndarray:
+    _, dt, shape, raw = t
+    return np.frombuffer(raw, dtype=dt).reshape(shape)
+
+
+def _maybe_dec(v):
+    if isinstance(v, tuple) and len(v) == 4 and v[0] == "__nd__":
+        return _dec_arr(v)
+    return v
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("ps transport: peer closed mid-message")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_MSG:
+        raise ConnectionError(f"ps transport: message of {n} bytes exceeds "
+                              f"{_MAX_MSG} cap")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# ----------------------------------------------------------------- clients
+
+class ShardClient:
+    """What the table/tier layer codes against — one client per shard.
+
+    All ids are GLOBAL row ids (the shard translates). ``pull`` returns
+    packed ``[k, lanes] uint16`` rows; ``push`` scatter-sets whole rows.
+    """
+
+    def pull(self, name: str, ids: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def push(self, name: str, ids: np.ndarray, rows: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def dump(self, name: str) -> np.ndarray:
+        raise NotImplementedError
+
+    def load(self, name: str, rows: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def meta(self) -> dict:
+        """{table_name: {"lo": int, "hi": int, "lanes": int}}"""
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        """{table_name: shard.stats()} — byte/pull/push counters."""
+        raise NotImplementedError
+
+    def ping(self) -> bool:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InProcessClient(ShardClient):
+    """Direct dispatch onto shard objects living in this process. One
+    'shard worker' may host the matching slice of several tables (the
+    common case: all sparse tables of a model partitioned the same way)."""
+
+    def __init__(self, shards: Sequence[EmbeddingShard]):
+        self._shards: Dict[str, EmbeddingShard] = {}
+        for s in shards:
+            if s.name in self._shards:
+                raise ValueError(f"InProcessClient: duplicate table "
+                                 f"{s.name!r}")
+            self._shards[s.name] = s
+
+    def _get(self, name: str) -> EmbeddingShard:
+        try:
+            return self._shards[name]
+        except KeyError:
+            raise KeyError(f"shard client has no table {name!r}; tables: "
+                           f"{sorted(self._shards)}") from None
+
+    def pull(self, name, ids):
+        return self._get(name).pull(ids)
+
+    def push(self, name, ids, rows):
+        self._get(name).push(ids, rows)
+
+    def dump(self, name):
+        return self._get(name).dump()
+
+    def load(self, name, rows):
+        self._get(name).load(rows)
+
+    def meta(self):
+        return {n: {"lo": s.lo, "hi": s.hi, "lanes": s.rows.shape[1]}
+                for n, s in self._shards.items()}
+
+    def stats(self):
+        return {n: s.stats() for n, s in self._shards.items()}
+
+    def ping(self):
+        return True
+
+
+class SocketClient(ShardClient):
+    """Persistent-connection client for a remote ``ShardServer``."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _call(self, op: str, **kw):
+        msg = {"op": op}
+        for k, v in kw.items():
+            msg[k] = _enc_arr(v) if isinstance(v, np.ndarray) else v
+        with self._lock:
+            _send_msg(self._sock, msg)
+            rep = _recv_msg(self._sock)
+        if rep.get("err"):
+            raise RuntimeError(f"ps shard {self.endpoint} {op}: "
+                               f"{rep['err']}")
+        return _maybe_dec(rep.get("out"))
+
+    def pull(self, name, ids):
+        return self._call("pull", name=name,
+                          ids=np.asarray(ids, dtype=np.int64))
+
+    def push(self, name, ids, rows):
+        self._call("push", name=name,
+                   ids=np.asarray(ids, dtype=np.int64),
+                   rows=np.asarray(rows, dtype=np.uint16))
+
+    def dump(self, name):
+        return self._call("dump", name=name)
+
+    def load(self, name, rows):
+        self._call("load", name=name,
+                   rows=np.asarray(rows, dtype=np.uint16))
+
+    def meta(self):
+        return self._call("meta")
+
+    def stats(self):
+        return self._call("stats")
+
+    def ping(self):
+        return bool(self._call("ping"))
+
+    def shutdown_server(self):
+        """Ask the server process to stop (tests / orderly teardown)."""
+        try:
+            self._call("shutdown")
+        except (ConnectionError, OSError):
+            pass  # server may close before replying
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(endpoint_or_shards) -> ShardClient:
+    """``"host:port"`` → SocketClient; a shard list → InProcessClient."""
+    if isinstance(endpoint_or_shards, str):
+        return SocketClient(endpoint_or_shards)
+    return InProcessClient(endpoint_or_shards)
+
+
+# ------------------------------------------------------------------ server
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: "ShardServer" = self.server.ps_server  # type: ignore
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                msg = _recv_msg(sock)
+            except (ConnectionError, OSError):
+                return
+            op = msg.get("op")
+            if op == "shutdown":
+                try:
+                    _send_msg(sock, {"out": True})
+                finally:
+                    threading.Thread(target=self.server.shutdown,
+                                     daemon=True).start()
+                return
+            try:
+                out = srv.dispatch(op, msg)
+                rep = {"out": _enc_arr(out)
+                       if isinstance(out, np.ndarray) else out}
+            except Exception as e:  # report, keep the connection alive
+                rep = {"err": f"{type(e).__name__}: {e}"}
+            try:
+                _send_msg(sock, rep)
+            except (ConnectionError, OSError):
+                return
+
+
+class _TCP(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ShardServer:
+    """Serves a set of ``EmbeddingShard`` objects over the socket
+    protocol. ``serve_in_thread()`` for tests / co-hosted shards,
+    ``serve_forever()`` for a dedicated pserver process
+    (``fleet.run_server()``)."""
+
+    def __init__(self, shards: Sequence[EmbeddingShard],
+                 host: str = "127.0.0.1", port: int = 0,
+                 delay_ms: float = 0.0):
+        """delay_ms: simulated per-request network latency on pull/push
+        (tests and single-host benches modelling cross-host RTT — a
+        loopback server has none, so overlap A/Bs would otherwise be
+        measuring pure serialization CPU time)."""
+        self.local = InProcessClient(shards)
+        self.delay_ms = float(delay_ms)
+        self._tcp = _TCP((host, port), _Handler)
+        self._tcp.ps_server = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._tcp.server_address[:2]
+        return f"{host}:{port}"
+
+    def dispatch(self, op: str, msg: dict):
+        if op == "ping":
+            return True
+        if op == "meta":
+            return self.local.meta()
+        if op == "stats":
+            return self.local.stats()
+        name = msg.get("name")
+        if op in ("pull", "push") and self.delay_ms:
+            time.sleep(self.delay_ms / 1e3)
+        if op == "pull":
+            return self.local.pull(name, _maybe_dec(msg["ids"]))
+        if op == "push":
+            self.local.push(name, _maybe_dec(msg["ids"]),
+                            _maybe_dec(msg["rows"]))
+            return True
+        if op == "dump":
+            return self.local.dump(name)
+        if op == "load":
+            self.local.load(name, _maybe_dec(msg["rows"]))
+            return True
+        raise ValueError(f"unknown ps op {op!r}")
+
+    def serve_in_thread(self) -> "ShardServer":
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        name=f"ps-server@{self.endpoint}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._tcp.serve_forever()
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,402 @@
+"""Sharded parameter-server embedding tier (paddle_tpu.ps).
+
+The load-bearing claim: training with tables range-partitioned across N
+shards behind the pull/push tier is BITWISE identical to single-table
+packed training at staleness 0 — for any shard count, uneven ranges,
+ids sitting exactly on shard cuts, and with the prefetcher on. With
+push_depth >= 1 a single worker stays bitwise exact through
+read-your-writes patching. Plus: transport round-trips (in-process and
+socket), role-maker env resolution (the reference's TRAINING_ROLE=
+PSERVER launch contract), and checkpoint save/restore of shard slices
+through the manifest-verified path, including onto a different shard
+count.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.initializer import RowPackInitializer
+from paddle_tpu.param_attr import ParamAttr
+from paddle_tpu.ps import (EmbeddingShard, InProcessClient, PsEmbeddingTier,
+                           PsTableBinding, RangeSpec, ShardServer,
+                           ShardedTable, SocketClient, make_shards)
+
+V, D, B, F = 50, 4, 4, 3
+MULT = 2          # adagrad: param + g2sum in-row
+CAP = B * F       # cache rows = max uniques per step
+LANES = 128
+
+
+# ------------------------------------------------------------ range spec
+
+def test_range_spec_even_and_boundaries():
+    spec = RangeSpec.even(10, 3)
+    assert spec.num_shards == 3
+    # first `vocab % n` shards absorb the remainder: 4 + 3 + 3
+    assert [spec.bounds(i) for i in range(3)] == [(0, 4), (4, 7), (7, 10)]
+    # an id ON a cut belongs to the shard that starts there
+    assert spec.shard_of(np.array([0, 3, 4, 6, 7, 9])).tolist() == \
+        [0, 0, 1, 1, 2, 2]
+    cuts = spec.cuts_into(np.array([0, 3, 4, 8, 9]))
+    assert cuts.tolist() == [0, 2, 3, 5]
+    rt = RangeSpec.from_dict(spec.to_dict())
+    assert rt == spec
+
+
+def test_range_spec_uneven_and_validation():
+    spec = RangeSpec(V, [0, 17, 40, V])
+    assert spec.num_shards == 3
+    assert spec.bounds(1) == (17, 40)
+    assert spec.shard_of(np.array([16, 17, 39, 40])).tolist() == [0, 1, 1, 2]
+    with pytest.raises(ValueError):
+        RangeSpec(V, [0, 40, 17, V])   # not ascending
+    with pytest.raises(ValueError):
+        RangeSpec(V, [1, 17, V])       # must start at 0
+    with pytest.raises(ValueError):
+        RangeSpec(V, [0, 17, V + 1])   # must end at vocab
+
+
+# ------------------------------------------------------- shard + transport
+
+def _rand_rows(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 2 ** 16, (n, LANES)).astype(np.uint16)
+
+
+def test_shard_pull_push_roundtrip():
+    rows = _rand_rows(V)
+    sh = EmbeddingShard("tb", 17, 40, rows=rows[17:40].copy())
+    ids = np.array([17, 20, 39], dtype=np.int64)  # global ids, incl. lo
+    np.testing.assert_array_equal(sh.pull(ids), rows[ids])
+    new = _rand_rows(3, seed=9)
+    sh.push(ids, new)
+    np.testing.assert_array_equal(sh.pull(ids), new)
+    dumped = sh.dump()
+    assert dumped.shape == (23, LANES)
+
+
+def test_socket_transport_roundtrip():
+    rows = _rand_rows(V)
+    spec = RangeSpec.even(V, 2)
+    shards = make_shards("tb", spec, full_rows=rows)
+    servers = [ShardServer([s]).serve_in_thread() for s in shards]
+    try:
+        clients = [SocketClient(s.endpoint) for s in servers]
+        assert all(c.ping() for c in clients)
+        meta = clients[0].meta()
+        assert meta["tb"]["lo"] == 0 and meta["tb"]["lanes"] == LANES
+        table = ShardedTable("tb", spec, clients)
+        ids = np.array([0, 24, 25, 49], dtype=np.int64)  # spans the cut
+        np.testing.assert_array_equal(table.pull(ids), rows[ids])
+        new = _rand_rows(4, seed=3)
+        table.push(ids, new)
+        np.testing.assert_array_equal(table.pull(ids), new)
+        full = table.dump_full()
+        assert full.shape == (V, LANES)
+        table.load_full(rows)
+        np.testing.assert_array_equal(table.dump_full(), rows)
+        # server-side errors come back as exceptions, connection survives
+        with pytest.raises(RuntimeError):
+            clients[0].pull("nope", np.array([0], dtype=np.int64))
+        assert clients[0].ping()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_sharded_table_reassembly_matches_fancy_index():
+    rows = _rand_rows(V, seed=4)
+    spec = RangeSpec(V, [0, 17, 40, V])
+    table = ShardedTable.build_in_process("tb", spec, full_rows=rows)
+    ids = np.array([0, 5, 16, 17, 18, 39, 40, 49], dtype=np.int64)
+    np.testing.assert_array_equal(table.pull(ids), rows[ids])
+    st = table.stats()
+    assert [s["rows"] for s in st["shards"]] == [17, 23, 10]
+    assert sum(s["bytes_pulled"] for s in st["shards"]) == ids.size * 256
+
+
+# --------------------------------------------- bitwise training exactness
+
+def _feeds():
+    rng = np.random.RandomState(1)
+    out = [{"ids": rng.randint(0, V, (B, F)).astype("int64")}
+           for _ in range(12)]
+    # one batch of ALL-duplicate ids sitting exactly on an uneven-spec cut
+    out[3] = {"ids": np.full((B, F), 17, dtype="int64")}
+    return out
+
+
+def _build_program(vocab_rows):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [F], dtype="int64")
+        emb = layers.embedding(
+            ids, [vocab_rows, D * MULT], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb", initializer=RowPackInitializer(
+                D, D * MULT, -1.0, 1.0)))
+        emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+        loss = layers.reduce_sum(layers.square(emb))
+        fluid.optimizer.Adagrad(
+            0.1, packed_rows={"rows_per_step": CAP}).minimize(loss)
+    return main, startup, loss
+
+
+def _init_packed():
+    """Deterministic full packed table: visible cols from one RNG, zero
+    optimizer state."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.deferred_rows import pack_rows
+    vis = np.random.RandomState(7).uniform(-1, 1, (V, D)).astype("float32")
+    rows = np.zeros((V, D * MULT), "float32")
+    rows[:, :D] = vis
+    return np.asarray(pack_rows(jnp.asarray(rows)))
+
+
+def _packed_baseline(feeds):
+    """Single-table packed adagrad — the ground truth."""
+    main, startup, loss = _build_program(V)
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        from paddle_tpu.core.scope import global_scope
+        exe.run(startup)
+        import jax.numpy as jnp
+        sc = global_scope()
+        sc.set_var("tb", jnp.asarray(_init_packed()))
+        for f in feeds:
+            (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        final = np.asarray(sc.find_var("tb"))
+    return losses, final
+
+
+def _ps_run(feeds, spec, pull_ahead, push_depth):
+    main, startup, loss = _build_program(CAP)  # cache-sized param
+    table = ShardedTable.build_in_process("tb", spec,
+                                          full_rows=_init_packed())
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        tier = PsEmbeddingTier(main, [PsTableBinding("tb", table, ["ids"])],
+                               pull_ahead=pull_ahead, push_depth=push_depth)
+        try:
+            for prep in tier.steps(lambda: iter(feeds)):
+                (lv,) = tier.run_step(exe, prep, fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+            tier.flush()
+            final = table.dump_full()
+        finally:
+            tier.close()
+    return losses, final
+
+
+SPECS = [RangeSpec.even(V, 1), RangeSpec.even(V, 2), RangeSpec.even(V, 4),
+         RangeSpec(V, [0, 17, 40, V])]
+
+
+@pytest.mark.parametrize("pull_ahead,push_depth", [(0, 0), (1, 0), (2, 1)])
+def test_sharded_training_bitwise_exact(pull_ahead, push_depth):
+    """Every shard count × uneven ranges × boundary-id batch: losses AND
+    the final packed table are bit-identical to the single-table run —
+    at staleness 0 by synchronous push, at push_depth 1 by
+    read-your-writes patching (single worker)."""
+    feeds = _feeds()
+    ref_losses, ref_final = _packed_baseline(feeds)
+    for spec in SPECS:
+        losses, final = _ps_run(feeds, spec, pull_ahead, push_depth)
+        assert losses == ref_losses, (spec.to_dict(), pull_ahead, push_depth)
+        np.testing.assert_array_equal(final, ref_final)
+
+
+def test_cache_overflow_raises():
+    """A batch touching more uniques than the cache param holds is a
+    build-time sizing error, reported as such."""
+    main, startup, loss = _build_program(CAP)
+    table = ShardedTable.build_in_process("tb", RangeSpec.even(V, 2),
+                                          full_rows=_init_packed())
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        tier = PsEmbeddingTier(main, [PsTableBinding("tb", table, ["ids"])],
+                               pull_ahead=0, push_depth=0)
+        try:
+            too_many = np.arange(CAP + 1, dtype=np.int64)
+            with pytest.raises(ValueError, match="cache"):
+                tier._pull_cache(tier.bindings[0], too_many, 0)
+        finally:
+            tier.close()
+
+
+def test_push_failure_surfaces_on_flush():
+    from paddle_tpu.ps.tier import _Pusher
+
+    class _BadTable:
+        name = "tb"
+
+        def push(self, uids, rows):
+            raise OSError("shard down")
+
+    p = _Pusher(_BadTable(), depth=1, window=3)
+    try:
+        p.submit(np.array([1], dtype=np.int64),
+                 np.zeros((1, LANES), np.uint16))
+        with pytest.raises(RuntimeError, match="push to table"):
+            p.flush()
+    finally:
+        p.close()
+
+
+# ------------------------------------------------------------- role makers
+
+def test_pserver_role_from_env(monkeypatch):
+    from paddle_tpu.incubate.fleet.base.role_maker import PaddleCloudRoleMaker
+    eps = "10.0.0.1:6000,10.0.0.2:6000"
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS", eps)
+    monkeypatch.setenv("PADDLE_PSERVER_ID", "1")
+    rm = PaddleCloudRoleMaker()
+    rm.generate_role()
+    assert rm.is_server() and not rm.is_worker()
+    assert rm.server_num() == 2
+    assert rm.server_index() == 1
+    assert rm.server_endpoints() == eps.split(",")
+
+
+def test_pserver_role_resolved_from_pod_ip(monkeypatch):
+    from paddle_tpu.parallel.fleet import PaddleCloudRoleMaker
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    # the launcher spelling of the endpoint list works too
+    monkeypatch.delenv("PADDLE_PSERVER_ENDPOINTS", raising=False)
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "10.0.0.1:6000,10.0.0.2:6001")
+    monkeypatch.delenv("PADDLE_PSERVER_ID", raising=False)
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    monkeypatch.setenv("PADDLE_PORT", "6001")
+    rm = PaddleCloudRoleMaker()
+    rm.generate_role()
+    assert rm.is_server() and rm.server_index() == 1
+
+
+def test_pserver_role_env_errors(monkeypatch):
+    from paddle_tpu.parallel.fleet import PaddleCloudRoleMaker
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.delenv("PADDLE_PSERVER_ENDPOINTS", raising=False)
+    monkeypatch.delenv("PADDLE_PSERVERS_IP_PORT_LIST", raising=False)
+    with pytest.raises(ValueError, match="PSERVER"):
+        PaddleCloudRoleMaker().generate_role()
+    monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS", "10.0.0.1:6000")
+    monkeypatch.setenv("PADDLE_PSERVER_ID", "5")
+    with pytest.raises(ValueError, match="out of range"):
+        PaddleCloudRoleMaker().generate_role()
+
+
+def test_fleet_server_lifecycle():
+    """fleet.init_server + run_server serve real shards; is_server /
+    server_index answer from the role maker."""
+    from paddle_tpu.parallel.fleet import Fleet, Role, UserDefinedRoleMaker
+    f = Fleet()
+    f.init(UserDefinedRoleMaker(current_id=0, role=Role.SERVER,
+                                server_endpoints=["127.0.0.1:0"]))
+    assert f.is_server() and not f.is_worker()
+    assert f.server_num() == 1 and f.server_index() == 0
+    rows = _rand_rows(V, seed=11)
+    srv = f.init_server(shards=[EmbeddingShard("tb", 0, V,
+                                               rows=rows.copy())])
+    t = threading.Thread(target=f.run_server, daemon=True)
+    t.start()
+    try:
+        c = SocketClient(srv.endpoint)
+        assert c.ping()
+        ids = np.array([0, V - 1], dtype=np.int64)
+        np.testing.assert_array_equal(c.pull("tb", ids), rows[ids])
+        c.close()
+    finally:
+        f.stop_server()
+        t.join(timeout=5.0)
+    with pytest.raises(RuntimeError, match="init_server"):
+        f.run_server()
+
+
+# -------------------------------------------------------------- checkpoint
+
+def _tiny_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.fc(x, 2, bias_attr=False,
+                      param_attr=ParamAttr(name="w"))
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup
+
+
+def test_checkpoint_roundtrip_onto_different_shard_count(tmp_path):
+    from paddle_tpu.parallel import Checkpointer
+    rows = _rand_rows(V, seed=21)
+    main, startup = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    ck = Checkpointer(str(tmp_path / "ck"))
+    table4 = ShardedTable.build_in_process("emb", RangeSpec.even(V, 4),
+                                           full_rows=rows)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ck.save(1, program=main, ps_tables={"emb": table4})
+        ck.wait()
+    # restore onto THREE uneven shards — re-partitioned by the live spec
+    table3 = ShardedTable.build_in_process("emb", RangeSpec(V, [0, 17, 40, V]))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        assert ck.restore(program=main, ps_tables={"emb": table3}) == 1
+    np.testing.assert_array_equal(table3.dump_full(), rows)
+
+
+def test_checkpoint_detects_corrupt_ps_shard(tmp_path):
+    from paddle_tpu.parallel import Checkpointer
+    rows = _rand_rows(V, seed=22)
+    main, startup = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    ck = Checkpointer(str(tmp_path / "ck"))
+    table = ShardedTable.build_in_process("emb", RangeSpec.even(V, 2),
+                                          full_rows=rows)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ck.save(1, program=main, ps_tables={"emb": table})
+        ck.wait()
+    # flip one byte in the largest payload file (the PS shard bytes
+    # dominate the tiny fc program) — the SHA-256 manifest must catch it
+    files = sorted((p for p in (tmp_path / "ck").rglob("*") if p.is_file()
+                    and "manifest" not in p.name),
+                   key=lambda p: p.stat().st_size)
+    victim = files[-1]
+    raw = bytearray(victim.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    victim.write_bytes(bytes(raw))
+    fresh = ShardedTable.build_in_process("emb", RangeSpec.even(V, 2))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError):
+            ck.restore(program=main, ps_tables={"emb": fresh})
+
+
+def test_checkpoint_missing_ps_table_fails_before_mutation(tmp_path):
+    from paddle_tpu.parallel import Checkpointer
+    main, startup = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    ck = Checkpointer(str(tmp_path / "ck"))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ck.save(1, program=main)  # no PS tables in this checkpoint
+        ck.wait()
+    sentinel = _rand_rows(V, seed=23)
+    table = ShardedTable.build_in_process("emb", RangeSpec.even(V, 2),
+                                          full_rows=sentinel)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError):
+            ck.restore(program=main, ps_tables={"emb": table})
+    # the failed restore must not have touched the live shards
+    np.testing.assert_array_equal(table.dump_full(), sentinel)

@@ -343,3 +343,92 @@ def test_row_pack_table_rejects_deferred_rows():
         with pytest.raises(ValueError,
                            match=r"row_pack=True.*packed_rows"):
             opt.minimize(loss)
+
+
+# ------------------------------------------------- uniq_merge / lookup_join
+# edge cases: empty batches, all-duplicate batches, capacity overflow, ids
+# sitting on PS shard cuts — the id paths the packed/PS tiers lean on.
+
+def test_uniq_merge_empty_batch():
+    """Zero lookups is all pads by definition (the segment machinery
+    can't see a [0] batch — the guard must synthesize the output)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.deferred_rows import SENTINEL, uniq_merge
+    uids, utot, rep = uniq_merge(jnp.zeros((0,), jnp.int32),
+                                 jnp.zeros((0, D), jnp.float32), 8)
+    assert uids.shape == (8,) and (np.asarray(uids) == SENTINEL).all()
+    assert utot.shape == (8, D) and not np.asarray(utot).any()
+    assert rep.shape == (8,)
+
+
+def test_uniq_merge_all_duplicates():
+    """A batch that is one id repeated Q times: single live unique, rows
+    summed once, rep points at a real occurrence."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.deferred_rows import SENTINEL, uniq_merge
+    q, r = 6, 8
+    ids = jnp.full((q,), 17, jnp.int32)
+    rows = jnp.asarray(np.random.RandomState(0)
+                       .randn(q, D).astype("float32"))
+    uids, utot, rep = uniq_merge(ids, rows, r)
+    uids = np.asarray(uids)
+    assert uids[0] == 17 and (uids[1:] == SENTINEL).all()
+    np.testing.assert_allclose(np.asarray(utot)[0],
+                               np.asarray(rows).sum(0), rtol=1e-6)
+    assert not np.asarray(utot)[1:].any()
+    assert 0 <= int(rep[0]) < q
+
+
+def test_uniq_merge_capacity_overflow_raises():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.deferred_rows import uniq_merge
+    with pytest.raises(ValueError, match="rows_per_step"):
+        uniq_merge(jnp.arange(9, dtype=jnp.int32),
+                   jnp.zeros((9, D), jnp.float32), 8)
+
+
+def test_uniq_merge_shard_boundary_ids():
+    """Ids on and around PS shard cuts (0, the cut itself, vocab-1), with
+    duplicates: uids come back ascending and the per-id sums match a
+    numpy groupby — the contract `ShardedTable` fan-out depends on
+    (ascending uniques slice cleanly into contiguous shard chunks)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.deferred_rows import SENTINEL, uniq_merge
+    ids_np = np.array([17, 0, 49, 17, 16, 0, 17], dtype=np.int32)
+    rows_np = np.random.RandomState(2).randn(ids_np.size, D).astype("f4")
+    uids, utot, rep = uniq_merge(jnp.asarray(ids_np),
+                                 jnp.asarray(rows_np), 8)
+    uids, utot = np.asarray(uids), np.asarray(utot)
+    expect = np.unique(ids_np)
+    n = expect.size
+    np.testing.assert_array_equal(uids[:n], expect)
+    assert (uids[n:] == SENTINEL).all()
+    for k, u in enumerate(expect):
+        np.testing.assert_allclose(utot[k], rows_np[ids_np == u].sum(0),
+                                   rtol=1e-6)
+        assert ids_np[int(np.asarray(rep)[k])] == u
+
+
+def test_lookup_join_hits_misses_and_projection():
+    """Misses (postab == -1) pass base rows through with zero cum; hits
+    add the logged cum row; the lane-padded log (Lw > Dt) narrows
+    exactly."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.deferred_rows import lookup_join
+    rng = np.random.RandomState(4)
+    vocab, c = 10, 3
+    for lw in (D, 128):  # un-padded and lane-padded log widths
+        postab = np.full((vocab,), -1, np.int32)
+        postab[2], postab[7] = 0, 2
+        log = np.zeros((c, lw), np.float32)
+        log[:, :D] = rng.randn(c, D)
+        q = np.array([2, 5, 7, 2], np.int32)
+        base = rng.randn(q.size, D).astype("f4")
+        cur, cum = lookup_join(jnp.asarray(postab), jnp.asarray(log),
+                               jnp.asarray(base), jnp.asarray(q))
+        cum = np.asarray(cum)
+        want_cum = np.stack([log[0, :D], np.zeros(D, "f4"),
+                             log[2, :D], log[0, :D]])
+        np.testing.assert_array_equal(cum, want_cum)
+        np.testing.assert_allclose(np.asarray(cur), base + want_cum,
+                                   rtol=1e-6)
