@@ -178,6 +178,11 @@ class EmbeddingShard:
     def load(self, rows: np.ndarray) -> None:
         """Replace the full slice — the checkpoint restore path."""
         rows = np.ascontiguousarray(rows, dtype=np.uint16)
+        if not rows.flags.writeable:
+            # socket transport hands us np.frombuffer views (read-only, and
+            # ascontiguousarray passes them through); the slice must stay
+            # pushable after restore
+            rows = rows.copy()
         if rows.shape != self.rows.shape:
             raise ValueError(
                 f"shard {self.name!r}: load shape {rows.shape} != "

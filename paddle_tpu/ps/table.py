@@ -100,6 +100,11 @@ class ShardedTable:
         ids. ``sorted_ids`` must be ascending (asserted cheaply at the
         ends — full monotonicity is the caller's contract)."""
         sorted_ids = np.asarray(sorted_ids, dtype=np.int64)
+        if sorted_ids.size and sorted_ids[0] > sorted_ids[-1]:
+            raise ValueError(
+                f"ShardedTable {self.name!r}: ids must be ascending "
+                f"(first={int(sorted_ids[0])} > last={int(sorted_ids[-1])}); "
+                f"an unsorted pull would reassemble rows in the wrong order")
         cuts = self.spec.cuts_into(sorted_ids)
         out = []
         for i in range(self.spec.num_shards):

@@ -165,10 +165,14 @@ class _Pusher:
         self._check()
 
     def _check(self):
+        # a drained-push failure means a batch was DROPPED on the shards;
+        # the pusher stays poisoned so no later submit/flush (e.g. a
+        # retried checkpoint save) can report success over missing rows —
+        # recovery is rebuilding the tier from a known-good state
         if self._err is not None:
-            err, self._err = self._err, None
             raise RuntimeError(
-                f"ps push to table {self.table.name!r} failed") from err
+                f"ps push to table {self.table.name!r} failed; pusher is "
+                f"poisoned — rebuild the tier") from self._err
 
     def close(self):
         if self._thread is not None:

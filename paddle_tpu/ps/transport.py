@@ -11,22 +11,31 @@ Two transports behind one interface:
   ``ShardServer.serve_forever``). The server side is numpy + stdlib only —
   a pserver must never import JAX or touch the TPU.
 
-Wire format: every message is ``<u32 length><pickle payload>``; array
-payloads ride as ``(dtype-str, shape, bytes)`` triples so unpickling costs
-one ``np.frombuffer`` (no object arrays, protocol 4). One request, one
-reply; the server is thread-per-connection and a client keeps one
-persistent connection per shard (requests on it are serialized by a lock,
-concurrency comes from fanning out across shards).
+Wire format: every message is ``<u32 length><u32 json_len><json
+header><array blobs>``. The header is plain JSON (op names, table names,
+counters); each ndarray in the message is replaced by a
+``{"__nd__": [dtype, shape, offset, nbytes]}`` marker pointing into the
+raw blob region that follows, so decoding an array costs one
+``np.frombuffer``. Deliberately NOT pickle: a pserver port accepts
+connections from anything that can reach it, and ``pickle.loads`` on that
+input is arbitrary code execution — JSON + validated buffer slices can
+only ever produce dicts/lists/scalars/ndarrays. The port should still be
+network-isolated (trainer-cluster only): the protocol is unauthenticated,
+so anyone who can reach it can read and overwrite table rows. One
+request, one reply; the server is thread-per-connection and a client
+keeps one persistent connection per shard (requests on it are serialized
+by a lock, concurrency comes from fanning out across shards).
 """
 from __future__ import annotations
 
-import pickle
+import json
+import math
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -41,24 +50,85 @@ _MAX_MSG = 1 << 30  # 1 GiB sanity cap on a single message
 
 # ---------------------------------------------------------------- encoding
 
-def _enc_arr(a: np.ndarray) -> tuple:
-    a = np.ascontiguousarray(a)
-    return ("__nd__", str(a.dtype), a.shape, a.tobytes())
+_ND = "__nd__"  # reserved header key marking an array blob
 
 
-def _dec_arr(t) -> np.ndarray:
-    _, dt, shape, raw = t
-    return np.frombuffer(raw, dtype=dt).reshape(shape)
+def _pack_msg(obj) -> bytes:
+    """JSON header + concatenated array blobs (see module docstring)."""
+    blobs: List[bytes] = []
+    off = 0
+
+    def enc(v):
+        nonlocal off
+        if isinstance(v, np.ndarray):
+            a = np.ascontiguousarray(v)
+            raw = a.tobytes()
+            mark = {_ND: [str(a.dtype), list(a.shape), off, len(raw)]}
+            blobs.append(raw)
+            off += len(raw)
+            return mark
+        if isinstance(v, dict):
+            if _ND in v:
+                raise ValueError(f"ps transport: key {_ND!r} is reserved")
+            return {k: enc(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [enc(x) for x in v]
+        if isinstance(v, (np.integer, np.floating, np.bool_)):
+            return v.item()
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        raise TypeError(
+            f"ps transport cannot encode {type(v).__name__}")
+
+    head = json.dumps(enc(obj), separators=(",", ":")).encode("utf-8")
+    return b"".join([_LEN.pack(len(head)), head] + blobs)
 
 
-def _maybe_dec(v):
-    if isinstance(v, tuple) and len(v) == 4 and v[0] == "__nd__":
-        return _dec_arr(v)
-    return v
+def _unpack_msg(payload: bytes):
+    if len(payload) < _LEN.size:
+        raise ConnectionError("ps transport: truncated frame")
+    (nhead,) = _LEN.unpack_from(payload)
+    blob0 = _LEN.size + nhead
+    if blob0 > len(payload):
+        raise ConnectionError("ps transport: header overruns frame")
+    try:
+        head = json.loads(payload[_LEN.size:blob0].decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ConnectionError(f"ps transport: bad header: {e}") from None
+
+    def dec_arr(mark) -> np.ndarray:
+        try:
+            dt, shape, off, nbytes = mark
+            dtype = np.dtype(dt)
+            shape = tuple(int(s) for s in shape)
+            off, nbytes = int(off), int(nbytes)
+        except (TypeError, ValueError) as e:
+            raise ConnectionError(
+                f"ps transport: bad array marker: {e}") from None
+        if dtype.hasobject or any(s < 0 for s in shape) or off < 0:
+            raise ConnectionError("ps transport: bad array marker")
+        count = math.prod(shape)
+        if nbytes != count * dtype.itemsize \
+                or blob0 + off + nbytes > len(payload):
+            raise ConnectionError("ps transport: array segment out of "
+                                  "bounds")
+        return np.frombuffer(payload, dtype=dtype, count=count,
+                             offset=blob0 + off).reshape(shape)
+
+    def dec(v):
+        if isinstance(v, dict):
+            if _ND in v:
+                return dec_arr(v[_ND])
+            return {k: dec(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [dec(x) for x in v]
+        return v
+
+    return dec(head)
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=4)
+    payload = _pack_msg(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
@@ -78,7 +148,7 @@ def _recv_msg(sock: socket.socket):
     if n > _MAX_MSG:
         raise ConnectionError(f"ps transport: message of {n} bytes exceeds "
                               f"{_MAX_MSG} cap")
-    return pickle.loads(_recv_exact(sock, n))
+    return _unpack_msg(_recv_exact(sock, n))
 
 
 # ----------------------------------------------------------------- clients
@@ -172,16 +242,14 @@ class SocketClient(ShardClient):
         self._lock = threading.Lock()
 
     def _call(self, op: str, **kw):
-        msg = {"op": op}
-        for k, v in kw.items():
-            msg[k] = _enc_arr(v) if isinstance(v, np.ndarray) else v
+        msg = {"op": op, **kw}
         with self._lock:
             _send_msg(self._sock, msg)
             rep = _recv_msg(self._sock)
         if rep.get("err"):
             raise RuntimeError(f"ps shard {self.endpoint} {op}: "
                                f"{rep['err']}")
-        return _maybe_dec(rep.get("out"))
+        return rep.get("out")
 
     def pull(self, name, ids):
         return self._call("pull", name=name,
@@ -250,9 +318,7 @@ class _Handler(socketserver.BaseRequestHandler):
                                      daemon=True).start()
                 return
             try:
-                out = srv.dispatch(op, msg)
-                rep = {"out": _enc_arr(out)
-                       if isinstance(out, np.ndarray) else out}
+                rep = {"out": srv.dispatch(op, msg)}
             except Exception as e:  # report, keep the connection alive
                 rep = {"err": f"{type(e).__name__}: {e}"}
             try:
@@ -301,15 +367,14 @@ class ShardServer:
         if op in ("pull", "push") and self.delay_ms:
             time.sleep(self.delay_ms / 1e3)
         if op == "pull":
-            return self.local.pull(name, _maybe_dec(msg["ids"]))
+            return self.local.pull(name, msg["ids"])
         if op == "push":
-            self.local.push(name, _maybe_dec(msg["ids"]),
-                            _maybe_dec(msg["rows"]))
+            self.local.push(name, msg["ids"], msg["rows"])
             return True
         if op == "dump":
             return self.local.dump(name)
         if op == "load":
-            self.local.load(name, _maybe_dec(msg["rows"]))
+            self.local.load(name, msg["rows"])
             return True
         raise ValueError(f"unknown ps op {op!r}")
 

@@ -98,6 +98,12 @@ def test_socket_transport_roundtrip():
         assert full.shape == (V, LANES)
         table.load_full(rows)
         np.testing.assert_array_equal(table.dump_full(), rows)
+        # restore-then-train: rows arrive server-side as read-only
+        # np.frombuffer views; a push after load must not hit a
+        # read-only destination
+        new2 = _rand_rows(4, seed=5)
+        table.push(ids, new2)
+        np.testing.assert_array_equal(table.pull(ids), new2)
         # server-side errors come back as exceptions, connection survives
         with pytest.raises(RuntimeError):
             clients[0].pull("nope", np.array([0], dtype=np.int64))
@@ -105,6 +111,38 @@ def test_socket_transport_roundtrip():
     finally:
         for s in servers:
             s.stop()
+
+
+def test_transport_wire_format_roundtrip_and_hostile_frames():
+    """The socket protocol is JSON + raw blobs, not pickle: decoding
+    untrusted bytes can yield dicts/lists/scalars/ndarrays or a protocol
+    error — never code execution."""
+    import json
+    import struct
+
+    from paddle_tpu.ps.transport import _pack_msg, _unpack_msg
+
+    msg = {"op": "push", "name": "tb",
+           "ids": np.array([1, 2], dtype=np.int64),
+           "rows": np.zeros((2, 8), np.uint16),
+           "meta": {"n": 3, "ok": True, "f": 1.5, "none": None,
+                    "l": [1, "x"]}}
+    rt = _unpack_msg(_pack_msg(msg))
+    np.testing.assert_array_equal(rt["ids"], msg["ids"])
+    np.testing.assert_array_equal(rt["rows"], msg["rows"])
+    assert rt["meta"] == msg["meta"]
+    empty = _unpack_msg(_pack_msg(
+        {"ids": np.zeros((0,), np.int64)}))["ids"]
+    assert empty.shape == (0,) and empty.dtype == np.int64
+    bad_heads = [
+        b"\xff\xfe",                                        # not JSON
+        json.dumps({"__nd__": ["object", [1], 0, 8]}).encode(),   # O dtype
+        json.dumps({"__nd__": ["int64", [100], 0, 800]}).encode(),  # OOB
+        json.dumps({"__nd__": ["int64", [-1], 0, 8]}).encode(),   # neg dim
+    ]
+    for head in bad_heads:
+        with pytest.raises(ConnectionError):
+            _unpack_msg(struct.pack("<I", len(head)) + head)
 
 
 def test_sharded_table_reassembly_matches_fancy_index():
@@ -116,6 +154,9 @@ def test_sharded_table_reassembly_matches_fancy_index():
     st = table.stats()
     assert [s["rows"] for s in st["shards"]] == [17, 23, 10]
     assert sum(s["bytes_pulled"] for s in st["shards"]) == ids.size * 256
+    # unsorted ids would silently reassemble rows in the wrong order
+    with pytest.raises(ValueError, match="ascending"):
+        table.pull(np.array([40, 5], dtype=np.int64))
 
 
 # --------------------------------------------- bitwise training exactness
@@ -246,6 +287,14 @@ def test_push_failure_surfaces_on_flush():
                  np.zeros((1, LANES), np.uint16))
         with pytest.raises(RuntimeError, match="push to table"):
             p.flush()
+        # a dropped batch poisons the pusher permanently: a retried
+        # flush (e.g. a checkpoint save re-attempt) or a fresh submit
+        # must NOT report success over the missing rows
+        with pytest.raises(RuntimeError, match="poisoned"):
+            p.flush()
+        with pytest.raises(RuntimeError, match="poisoned"):
+            p.submit(np.array([2], dtype=np.int64),
+                     np.zeros((1, LANES), np.uint16))
     finally:
         p.close()
 
