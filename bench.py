@@ -756,6 +756,154 @@ def bench_ps_embedding(on_tpu):
     return out
 
 
+def bench_ps_fault(on_tpu):
+    """Fault-tolerance tax on the PS tier (PR 10): SIGKILL one real
+    pserver subprocess mid-run and measure what recover-and-resume
+    costs — the wall-clock pause the worker eats (shard ping-wait +
+    verified-checkpoint slice load + push-journal replay) against the
+    median healthy step. Exactness is measured, not assumed: the
+    interrupted run's losses must bitwise-match the uninterrupted
+    baseline (the ISSUE-10 acceptance cell, at bench scale)."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import deepfm
+    from paddle_tpu.observability.registry import get_registry
+    from paddle_tpu.parallel import Checkpointer
+    from paddle_tpu.ps import (PsEmbeddingTier, PsTableBinding, RangeSpec,
+                               ShardedTable, SocketClient)
+
+    batch, vocab, steps, kill_step = ((1024, 262_144, 18, 8) if on_tpu
+                                      else (128, 20_000, 12, 5))
+    fields, cap = 26, batch * 26
+    sim_net_ms = float(os.environ.get("PDTPU_PS_BENCH_NET_MS",
+                                      "0" if on_tpu else "5"))
+    rng = np.random.RandomState(7)
+    feeds = [{"sparse_ids": rng.randint(
+                  0, vocab, (batch, fields)).astype("int64"),
+              "dense": rng.rand(batch, 13).astype("float32"),
+              "label": rng.randint(0, 2, (batch, 1)).astype("float32")}
+             for _ in range(steps)]
+    reg = get_registry()
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "ps_server_runner.py")
+    spec = RangeSpec.even(vocab, 2)
+
+    def launch(i, port=0):
+        lo, hi = spec.bounds(i)
+        p = subprocess.Popen(
+            [sys.executable, runner, "--port", str(port),
+             "--table", f"fm_t:{lo}:{hi}", "--delay-ms", str(sim_net_ms)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        ep = p.stdout.readline().strip()
+        if not ep:
+            raise RuntimeError("pserver runner died at boot")
+        return p, ep
+
+    # loopback recovers fast; don't let the ping-wait default (100 ms
+    # poll) and the stock backoff dominate a millisecond-scale bench
+    knobs = {"PDTPU_PS_RETRIES": "60", "PDTPU_PS_RETRY_BACKOFF_MS": "20",
+             "PDTPU_PS_TIMEOUT": "10"}
+    saved_env = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    ckdir = tempfile.mkdtemp(prefix="pdtpu_bench_psfault_")
+
+    def run(kill):
+        procs, eps = [], []
+        for i in range(2):
+            p, ep = launch(i)
+            procs.append(p)
+            eps.append(ep)
+        table = ShardedTable("fm_t", spec,
+                             [SocketClient(ep) for ep in eps])
+        restarter = None
+        try:
+            main, startup, _, loss, _ = deepfm.build_train_program(
+                vocab_size=cap, lr=0.05, is_sparse=True, fused_table=True,
+                embedding_optimizer="adagrad",
+                packed_rows={"rows_per_step": cap}, hidden_sizes=(64,))
+            exe = fluid.Executor(fluid.TPUPlace())
+            losses, step_ms = [], []
+            sc = fluid.Scope()
+            with fluid.scope_guard(sc):
+                exe.run(startup)
+                sub = os.path.join(ckdir, "kill" if kill else "base")
+                ck = Checkpointer(sub)
+                ck.save(0, program=main, scope=sc,
+                        blocking=True, ps_tables={"fm_t": table})
+                tier = PsEmbeddingTier(
+                    main, [PsTableBinding("fm_t", table, ["sparse_ids"])],
+                    pull_ahead=1, push_depth=0)
+                tier.attach_checkpointer(ck)
+                try:
+                    for i, prep in enumerate(tier.steps(
+                            lambda: iter(feeds))):
+                        if kill and i == kill_step:
+                            procs[1].kill()
+                            procs[1].wait()
+                            port1 = int(eps[1].rsplit(":", 1)[1])
+
+                            def _restart():
+                                time.sleep(0.25)
+                                procs[1], _ = launch(1, port=port1)
+
+                            restarter = threading.Thread(target=_restart,
+                                                         daemon=True)
+                            restarter.start()
+                        t0 = time.time()
+                        (lv,) = tier.run_step(exe, prep, fetch_list=[loss])
+                        step_ms.append((time.time() - t0) * 1e3)
+                        losses.append(float(np.asarray(lv)))
+                    tier.flush()
+                finally:
+                    tier.close()
+            return losses, step_ms
+        finally:
+            if restarter is not None:
+                restarter.join(timeout=10.0)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+
+    try:
+        base_losses, base_ms = run(kill=False)
+        recov0 = reg.counter("ps/recoveries").value
+        retry0 = reg.counter("ps/rpc_retries").value
+        kill_losses, kill_ms = run(kill=True)
+        healthy = sorted(m for i, m in enumerate(kill_ms)
+                         if i != kill_step)
+        median = healthy[len(healthy) // 2] if healthy else None
+        return {
+            "batch": batch, "vocab": vocab, "steps": steps,
+            "kill_step": kill_step, "sim_net_ms": sim_net_ms,
+            # the whole claim: a SIGKILL'd shard costs one paused step,
+            # not a crashed worker and not a single wrong bit
+            "bitwise_equal": kill_losses == base_losses,
+            "recoveries": reg.counter("ps/recoveries").value - recov0,
+            "rpc_retries": reg.counter("ps/rpc_retries").value - retry0,
+            "recovery_pause_ms": (round(kill_ms[kill_step] - median, 1)
+                                  if median is not None else None),
+            "healthy_step_ms_p50": (round(median, 2)
+                                    if median is not None else None),
+            "baseline_step_ms_p50": round(
+                sorted(base_ms)[len(base_ms) // 2], 2),
+            "journal_bytes": int(reg.gauge(
+                "ps/journal_bytes", table="fm_t").value),
+        }
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def bench_dispatch_overhead(on_tpu):
     """Per-step HOST overhead at batch-1 on a trivial train program, for
     the three dispatch strategies: `run` (one Python dispatch per step),
@@ -1261,6 +1409,15 @@ def main():
     except Exception as e:  # pragma: no cover
         extras2["ps_embedding"] = {"error": str(e)[:120]}
     _end_section(extras2, "ps_embedding")
+
+    # fault-tolerance tax: SIGKILL a real pserver mid-run, measure the
+    # recovery pause (checkpoint slice + journal replay) and assert the
+    # interrupted run stays bitwise-exact (PR 10 recovery machinery)
+    try:
+        extras2["ps_fault"] = bench_ps_fault(on_tpu)
+    except Exception as e:  # pragma: no cover
+        extras2["ps_fault"] = {"error": str(e)[:120]}
+    _end_section(extras2, "ps_fault")
 
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms

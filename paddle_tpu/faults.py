@@ -22,6 +22,10 @@ Sites (``fault_point("<site>")`` probes embedded in the codebase):
                        (distributed/elastic.py)
 ``loader.next``        every reader pull in the DeviceLoader worker
 ``exec.dispatch``      every ``Executor.run`` dispatch
+``ps.rpc``             every request the PS shard server receives
+                       (ps/transport.py), BEFORE dispatch — the network
+                       chaos probe
+``ps.pull``/``ps.push``  worker-side PS tier pull/push (ps/tier.py)
 ====================  ====================================================
 
 Actions, triggered deterministically by hit count:
@@ -32,7 +36,15 @@ Actions, triggered deterministically by hit count:
   so transient-I/O retry loops treat it exactly like the real thing);
 - ``delay_ms=N`` — sleep N ms (slow NFS, GC pause, straggler);
 - ``corrupt``    — flip bytes in the file the probe just wrote (bitrot /
-  torn write that survives into a committed file).
+  torn write that survives into a committed file);
+- ``drop``       — raise :class:`InjectedNetworkFault`; the PS shard
+  server interprets it at ``ps.rpc`` by swallowing the request and
+  closing the connection without a reply (a half-open peer / silent
+  packet loss — the client sees a read timeout);
+- ``reset``      — like ``drop`` but the server closes with an RST
+  (``SO_LINGER 0``) so the client sees ``ECONNRESET`` immediately (a
+  crashed or restarted pserver). At non-transport sites ``drop``/
+  ``reset`` behave like ``raise``.
 
 Spec grammar (``PDTPU_FAULT_SPEC`` or :func:`install`)::
 
@@ -63,19 +75,32 @@ from typing import Dict, List, Optional
 from .observability.registry import get_registry
 
 __all__ = ["fault_point", "install", "clear", "hits", "active_rules",
-           "parse_spec", "InjectedFault", "CRASH_EXIT_CODE"]
+           "parse_spec", "InjectedFault", "InjectedNetworkFault",
+           "CRASH_EXIT_CODE"]
 
 # EX_SOFTWARE: lets a supervisor (and the chaos tests) tell an injected
 # crash apart from a real one or a signal death
 CRASH_EXIT_CODE = 70
 
-_ACTIONS = ("crash", "raise", "delay_ms", "corrupt")
+_ACTIONS = ("crash", "raise", "delay_ms", "corrupt", "drop", "reset")
 
 
 class InjectedFault(OSError):
     """Raised by the ``raise`` action. Deliberately an ``OSError``: the
     checkpoint writer's transient-I/O retry loop must not be able to tell
     an injected failure from a real one."""
+
+
+class InjectedNetworkFault(InjectedFault):
+    """Raised by the ``drop``/``reset`` actions. A transport layer that
+    embeds a probe (the PS shard server's ``ps.rpc``) catches this and
+    performs the real network misbehavior — swallow the request (drop) or
+    RST the connection (reset); anywhere else it propagates like a
+    ``raise``-action :class:`InjectedFault`."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
 
 
 class _Rule:
@@ -215,6 +240,10 @@ def _fire(rule: _Rule, site: str, path: Optional[str], hit: int) -> None:
     elif rule.action == "raise":
         raise InjectedFault(
             f"injected fault at site {site!r} (hit {hit})")
+    elif rule.action in ("drop", "reset"):
+        raise InjectedNetworkFault(
+            rule.action,
+            f"injected {rule.action} at site {site!r} (hit {hit})")
     elif rule.action == "crash":
         # a real preemption: no unwinding, no cleanup, no flushes
         os._exit(CRASH_EXIT_CODE)

@@ -226,10 +226,13 @@ class Checkpointer:
 
     # -- background write --------------------------------------------------
     def _write(self, step: int, vals: Dict[str, object], shards=(),
-               rank: int = 0):
+               rank: int = 0, on_commit=()):
         """Writer-thread entry: retry transient I/O with capped exponential
         backoff; any residual failure is surfaced by the next wait()/save()
-        (a silently lost checkpoint must not look durable)."""
+        (a silently lost checkpoint must not look durable). `on_commit`
+        callbacks run only after the write fully commits (manifest +
+        marker durable) — e.g. PS journal truncation, which must never
+        happen for a checkpoint that might not be restorable."""
         retries = int(os.environ.get("PDTPU_CKPT_RETRIES", "3"))
         backoff_ms = float(os.environ.get("PDTPU_CKPT_RETRY_BACKOFF_MS",
                                           "100"))
@@ -237,6 +240,11 @@ class Checkpointer:
         while True:
             try:
                 self._write_impl(step, vals, shards, rank)
+                for cb in on_commit:
+                    try:
+                        cb()
+                    except Exception:
+                        pass  # commit stands; truncation is best-effort
                 return
             except OSError as e:
                 # transient filesystem error (NFS blip, EIO, injected
@@ -429,6 +437,50 @@ class Checkpointer:
                         "(corrupt)")
         return problems
 
+    def load_ps_table(self, tname: str):
+        """Shard-recovery read path: ``(full_rows, journal_mark, step)``
+        for PS table `tname` from the newest checkpoint that passes
+        integrity verification. Touches no scope and needs no Program —
+        it is called from inside the tier's pull/push threads while the
+        training loop is blocked on the dead shard. Deliberately does NOT
+        ``wait()`` on an in-flight save: an uncommitted step has no
+        manifest yet and simply isn't a candidate."""
+        psn = f"{tname}@ps"
+        failures: List[str] = []
+        for st in sorted(set(self.all_steps()), reverse=True):
+            path = self._existing_path(st)
+            if path is None:
+                continue
+            bad = self.verify(st)
+            if not bad:
+                try:
+                    if path.endswith(".ptck"):
+                        from ..native import read_bundle
+                        bundle = read_bundle(path)
+                        if bundle is None:
+                            raise RuntimeError(
+                                f"cannot read native checkpoint {path}")
+                    else:
+                        with open(path, "rb") as f:
+                            bundle = pickle.load(f)["vars"]
+                    assembled = self._assemble_shards(st)
+                    if psn not in assembled:
+                        raise RuntimeError(f"no {psn!r} shards")
+                    mark = int(np.asarray(
+                        bundle.get(f"@ps_mark@{tname}", 0)).reshape(()))
+                    return assembled[psn], mark, st
+                except (RuntimeError, OSError, EOFError, ValueError,
+                        pickle.UnpicklingError) as e:
+                    bad = [f"{type(e).__name__}: {e}"]
+            failures.append(f"step {st}: {'; '.join(bad)}")
+            _FALLBACK.inc()
+        raise RuntimeError(
+            f"ps recovery: no verifiable checkpoint holding table "
+            f"{tname!r} in {self.dirname!r}"
+            + (f" ({' | '.join(failures)})" if failures else
+               " (no checkpoints at all — save one before training so a "
+               "restarted shard has a recovery base)"))
+
     # -- save --------------------------------------------------------------
     def save(self, step: int, program: Optional[Program] = None,
              scope: Optional[Scope] = None, blocking: bool = False,
@@ -445,7 +497,10 @@ class Checkpointer:
         each shard's slice is dumped NOW (snapshot semantics — flush the
         tier's pushers first) under the ``<name>@ps`` key, one record per
         shard, so a shard's bytes ride the identical tmp→fsync→rename +
-        SHA-256 commit protocol as a ZeRO-sharded var."""
+        SHA-256 commit protocol as a ZeRO-sharded var. The table's push
+        journal mark rides along as ``@ps_mark@<name>`` (read back by
+        shard recovery) and the journal is truncated to it once — and
+        only once — this checkpoint COMMITS."""
         import jax
 
         program = program or default_main_program()
@@ -454,10 +509,20 @@ class Checkpointer:
         vals, shards = _snapshot(program, scope)
         shards = list(shards)
         ps_names = []
+        on_commit = []
         for tname, table in (ps_tables or {}).items():
             psn = f"{tname}@ps"
             ps_names.append(psn)
             spec, lanes = table.spec, table.lanes
+            if hasattr(table, "journal_mark"):
+                # mark BEFORE the dumps: an entry with seq <= mark was
+                # applied before the caller's flush, so the dumped bytes
+                # contain it; a racing push lands at seq > mark and stays
+                # journaled (replay is idempotent either way)
+                mark = int(table.journal_mark())
+                vals[f"@ps_mark@{tname}"] = np.asarray(mark, np.int64)
+                on_commit.append(
+                    lambda t=table, m=mark: t.journal_truncate(m))
             for i in range(spec.num_shards):
                 lo, hi = spec.bounds(i)
                 shards.append((psn, ((lo, hi), (0, lanes)),
@@ -498,7 +563,8 @@ class Checkpointer:
         for k, v in (extra or {}).items():
             vals[k] = np.asarray(v)
         self._thread = threading.Thread(
-            target=self._write, args=(step, vals, shards, rank), daemon=True)
+            target=self._write, args=(step, vals, shards, rank, on_commit),
+            daemon=True)
         self._thread.start()
         if blocking:
             self.wait()
@@ -613,7 +679,10 @@ class Checkpointer:
             else:
                 rng_key = jnp.asarray(raw)
         extra = {k: v for k, v in vars_.items() if k.startswith("@dataio@")}
-        return to_set, rng_key, extra, assembled
+        ps_marks = {k[len("@ps_mark@"):]: int(np.asarray(v).reshape(()))
+                    for k, v in vars_.items()
+                    if k.startswith("@ps_mark@")}
+        return to_set, rng_key, extra, assembled, ps_marks
 
     def restore(self, step: Optional[int] = None,
                 program: Optional[Program] = None,
@@ -683,13 +752,18 @@ class Checkpointer:
                     f"integrity verification ({desc}); falling back to the "
                     "next older checkpoint", RuntimeWarning)
                 continue
-            to_set, rng_key, extra, assembled = loaded
+            to_set, rng_key, extra, assembled, ps_marks = loaded
             for n, arr in to_set.items():
                 scope.set_var(n, arr)
             if rng_key is not None:
                 scope.set_var(_RNG_STATE, rng_key)
             for tname, table in (ps_tables or {}).items():
                 table.load_full(assembled[f"{tname}@ps"])
+                if hasattr(table, "journal_reset"):
+                    # the live journal (possibly from another process
+                    # lifetime) no longer describes deltas over what was
+                    # just loaded; re-anchor it at this checkpoint's mark
+                    table.journal_reset(int(ps_marks.get(tname, 0)))
             self.last_extra = extra
             return st
         if failures:

@@ -8,26 +8,38 @@ repo's packed row-major tables:
   ``EmbeddingShard`` (one table slice as packed ``[n, 128] uint16`` rows;
   numpy-only so pserver processes never import JAX);
 * :mod:`.transport` — ``ShardClient`` (in-process direct dispatch or a
-  length-prefixed socket protocol) and ``ShardServer`` (what
-  ``fleet.run_server()`` runs);
+  length-prefixed socket protocol with reconnect + capped-backoff retry
+  and a typed ``TransportError(transient)`` taxonomy) and ``ShardServer``
+  (what ``fleet.run_server()`` runs);
 * :mod:`.table` — ``ShardedTable``: sorted-id fan-out pull/push with
-  per-shard byte accounting;
+  per-shard byte accounting, plus the client-side push journal and
+  ``recover_shard`` (lossless rebuild of a restarted shard from the
+  newest verified checkpoint + journal replay);
+* :mod:`.health` — ``ShardMonitor``: periodic shard pings driving
+  ``ps/shard_up`` gauges and the ``ps/shards`` /healthz check;
 * :mod:`.tier` — ``PsEmbeddingTier``: the worker-side training driver
   with async pull prefetch (rides ``dataio.DeviceLoader``) and bounded-
-  depth async push, bitwise-exact vs the single-table packed baseline.
+  depth async push, bitwise-exact vs the single-table packed baseline;
+  ``attach_checkpointer`` arms recover-and-resume on shard outages.
 
 Configured through ``DistributedStrategy`` (``embedding_shards``,
 ``pull_ahead``, ``push_depth``) and the fleet role makers
-(``TRAINING_ROLE=PSERVER`` + ``PADDLE_PSERVER_ENDPOINTS``).
+(``TRAINING_ROLE=PSERVER`` + ``PADDLE_PSERVER_ENDPOINTS``). Failure
+semantics (retry env knobs, journal durability contract, recovery
+walkthrough) are documented in docs/migration.md "Distributed
+embeddings → Failure semantics".
 """
+from .health import ShardMonitor  # noqa: F401
 from .shard import EmbeddingShard, RangeSpec, make_shards  # noqa: F401
 from .table import ShardedTable  # noqa: F401
 from .tier import PsEmbeddingTier, PsTableBinding  # noqa: F401
 from .transport import (InProcessClient, ShardClient,  # noqa: F401
-                        ShardServer, SocketClient, connect)
+                        ShardRestartedError, ShardServer, SocketClient,
+                        TransportError, connect, probe)
 
 __all__ = [
     "RangeSpec", "EmbeddingShard", "make_shards",
     "ShardClient", "InProcessClient", "SocketClient", "ShardServer",
-    "connect", "ShardedTable", "PsTableBinding", "PsEmbeddingTier",
+    "TransportError", "ShardRestartedError", "connect", "probe",
+    "ShardedTable", "ShardMonitor", "PsTableBinding", "PsEmbeddingTier",
 ]

@@ -42,7 +42,9 @@ the table layer.
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence
 
@@ -51,6 +53,7 @@ import numpy as np
 from ..faults import fault_point
 from ..observability import get_registry
 from .table import ShardedTable
+from .transport import ShardRestartedError, TransportError
 
 __all__ = ["PsTableBinding", "PsEmbeddingTier"]
 
@@ -231,8 +234,69 @@ class PsEmbeddingTier:
         self._c_miss = reg.counter("ps/prefetch_miss")
         self._c_patched = reg.counter("ps/patched_rows")
         self._c_repulls = reg.counter("ps/repulls")
+        self._c_recoveries = reg.counter("ps/recoveries")
         self._loader = None
         self._patch_fn = None  # lazily-jitted gather+scatter (no jax here)
+        self._ck = None        # Checkpointer armed by attach_checkpointer
+        self._recover_lock = threading.Lock()
+
+    # --------------------------------------------------------- shard outage
+    def attach_checkpointer(self, ck) -> None:
+        """Arm lossless shard recovery. With a Checkpointer attached,
+        a transient shard outage no longer kills the step: the failing
+        pull/push blocks (which naturally pauses the prefetcher and the
+        pusher — they are the threads doing the failing calls), the tier
+        waits for the shard to answer pings again (bounded by
+        ``PDTPU_WEDGE_TIMEOUT``), rebuilds it from the newest VERIFIED
+        checkpoint slice plus the table's push-journal replay
+        (``ShardedTable.recover_shard``), and the interrupted op retries
+        — bitwise-identical to a never-crashed run at staleness 0. Save
+        a checkpoint (``ps_tables=``) before training so a recovery base
+        exists. Without attachment, outages surface as TransportError
+        after transport-level retries, exactly as before."""
+        self._ck = ck
+        for b in self.bindings:
+            b.table.set_recovery(
+                lambda i, exc, t=b.table: self._recover(t, i, exc))
+
+    def _recover(self, table: ShardedTable, i: int,
+                 exc: BaseException) -> None:
+        """Recovery hook (runs on whichever thread hit the dead shard —
+        prefetcher, pusher, or the step thread). Serialized: concurrent
+        victims of the same outage queue here, and all but the first
+        find the shard already healthy and return to their retry."""
+        with self._recover_lock:
+            deadline = time.monotonic() + float(
+                os.environ.get("PDTPU_WEDGE_TIMEOUT", "300"))
+            client = table.clients[i]
+            while True:
+                try:
+                    client.ping()
+                    # reachable under the SAME instance id: the process
+                    # never died (blip / slow shard) — rows are intact,
+                    # no rebuild needed, let the op retry
+                    return
+                except ShardRestartedError:
+                    break  # reachable but reborn: rebuild below
+                except TransportError as e:
+                    if not e.transient or time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"ps shard {i} of table {table.name!r} "
+                            "unreachable past PDTPU_WEDGE_TIMEOUT — tier "
+                            "is wedged, not recovering") from e
+                    time.sleep(0.1)
+            self._c_recoveries.inc()
+            if self._ck is None:
+                raise RuntimeError(
+                    f"ps shard {i} of table {table.name!r} restarted and "
+                    "lost its rows, but no checkpointer is attached — "
+                    "call tier.attach_checkpointer(ck) for lossless "
+                    "recovery") from exc
+            full_rows, mark, step = self._ck.load_ps_table(table.name)
+            replayed = table.recover_shard(i, full_rows, mark)
+            del full_rows
+            get_registry().counter(
+                "ps/recovered_batches", table=table.name).inc(replayed)
 
     # ----------------------------------------------------------- pull path
     def _pull_cache(self, binding: PsTableBinding, uids: np.ndarray,

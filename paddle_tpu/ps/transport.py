@@ -25,27 +25,95 @@ so anyone who can reach it can read and overwrite table rows. One
 request, one reply; the server is thread-per-connection and a client
 keeps one persistent connection per shard (requests on it are serialized
 by a lock, concurrency comes from fanning out across shards).
+
+Failure taxonomy. Every transport-level failure is a
+:class:`TransportError` carrying ``transient``:
+
+* ``transient=True`` — connect refused, timeout, peer closed / short
+  read, ``ECONNRESET``: the kind of error a restarting or briefly
+  unreachable shard produces. ``SocketClient`` retries these itself —
+  reconnect + capped exponential backoff, ``PDTPU_PS_RETRIES`` attempts
+  (default 5) starting at ``PDTPU_PS_RETRY_BACKOFF_MS`` (default 50,
+  capped at 5 s), per-socket ``PDTPU_PS_TIMEOUT`` seconds (default 30) —
+  counting each retry on ``ps/rpc_retries``. Only when retries are
+  exhausted does the error reach the caller (still ``transient=True``:
+  the shard may yet come back — this is what the tier's recovery hook
+  keys on).
+* ``transient=False`` — a structurally invalid frame (bad header JSON,
+  bad array marker, > cap message): reconnecting cannot fix a peer that
+  speaks garbage, so these surface immediately.
+
+Restart detection: every server reply carries the server's random
+instance id; a client that sees the id change between replies raises
+:class:`ShardRestartedError` (transient) instead of silently reading a
+freshly-booted — and therefore EMPTY — shard. Recovery code calls
+``reset_instance_expectation()`` after repopulating the shard.
+
+Chaos: the server probes ``fault_point("ps.rpc")`` on every request
+(paddle_tpu.faults) — ``drop`` swallows the request and closes the
+connection with no reply, ``reset`` closes with an RST (``SO_LINGER 0``),
+``delay_ms`` models a slow shard, ``crash`` is a real pserver death — so
+every client-visible failure mode is deterministically injectable.
 """
 from __future__ import annotations
 
 import json
 import math
+import os
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..faults import InjectedNetworkFault, fault_point
+from ..observability.registry import get_registry
 from .shard import EmbeddingShard
 
-__all__ = ["ShardClient", "InProcessClient", "SocketClient", "ShardServer",
-           "connect"]
+__all__ = ["TransportError", "ShardRestartedError", "ShardClient",
+           "InProcessClient", "SocketClient", "ShardServer", "connect",
+           "probe"]
 
 _LEN = struct.Struct("<I")
 _MAX_MSG = 1 << 30  # 1 GiB sanity cap on a single message
+
+_RPC_RETRIES = get_registry().counter("ps/rpc_retries")
+
+
+class TransportError(ConnectionError):
+    """A PS transport failure. ``transient=True`` means a reconnect might
+    succeed (shard restarting / network blip) — retry loops and the
+    recovery hook key on it; ``transient=False`` means the peer is
+    speaking a broken protocol and retrying is pointless. A
+    ``ConnectionError`` subclass so pre-taxonomy ``except`` clauses (and
+    the server's per-connection loop) keep working."""
+
+    def __init__(self, msg: str, transient: bool, endpoint: str = "",
+                 attempts: int = 0):
+        if endpoint:
+            msg = f"ps shard {endpoint}: {msg}"
+        if attempts > 1:
+            msg += f" (after {attempts} attempts)"
+        super().__init__(msg)
+        self.transient = bool(transient)
+        self.endpoint = endpoint
+        self.attempts = attempts
+
+
+class ShardRestartedError(TransportError):
+    """The shard answered with a different server instance id than the
+    last reply: the pserver process restarted (losing its in-memory rows)
+    between two RPCs. Always transient — the fix is repopulating the
+    shard (``ShardedTable.recover_shard``), not giving up."""
+
+    def __init__(self, endpoint: str, old: str, new: str):
+        super().__init__(
+            f"server instance changed {old!r} -> {new!r}: the pserver "
+            "restarted and its in-memory rows are gone; recover the shard "
+            "before trusting reads", transient=True, endpoint=endpoint)
 
 
 # ---------------------------------------------------------------- encoding
@@ -86,15 +154,15 @@ def _pack_msg(obj) -> bytes:
 
 def _unpack_msg(payload: bytes):
     if len(payload) < _LEN.size:
-        raise ConnectionError("ps transport: truncated frame")
+        raise TransportError("truncated frame", transient=False)
     (nhead,) = _LEN.unpack_from(payload)
     blob0 = _LEN.size + nhead
     if blob0 > len(payload):
-        raise ConnectionError("ps transport: header overruns frame")
+        raise TransportError("header overruns frame", transient=False)
     try:
         head = json.loads(payload[_LEN.size:blob0].decode("utf-8"))
     except (UnicodeDecodeError, ValueError) as e:
-        raise ConnectionError(f"ps transport: bad header: {e}") from None
+        raise TransportError(f"bad header: {e}", transient=False) from None
 
     def dec_arr(mark) -> np.ndarray:
         try:
@@ -103,15 +171,15 @@ def _unpack_msg(payload: bytes):
             shape = tuple(int(s) for s in shape)
             off, nbytes = int(off), int(nbytes)
         except (TypeError, ValueError) as e:
-            raise ConnectionError(
-                f"ps transport: bad array marker: {e}") from None
+            raise TransportError(
+                f"bad array marker: {e}", transient=False) from None
         if dtype.hasobject or any(s < 0 for s in shape) or off < 0:
-            raise ConnectionError("ps transport: bad array marker")
+            raise TransportError("bad array marker", transient=False)
         count = math.prod(shape)
         if nbytes != count * dtype.itemsize \
                 or blob0 + off + nbytes > len(payload):
-            raise ConnectionError("ps transport: array segment out of "
-                                  "bounds")
+            raise TransportError("array segment out of bounds",
+                                 transient=False)
         return np.frombuffer(payload, dtype=dtype, count=count,
                              offset=blob0 + off).reshape(shape)
 
@@ -133,11 +201,18 @@ def _send_msg(sock: socket.socket, obj) -> None:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly `n` bytes. A half-closed socket (peer died or sent a
+    torn frame) raises a TRANSIENT TransportError naming how much of the
+    frame arrived — reconnect + retry gets a fresh, resynchronized
+    stream, which is exactly what the client's retry loop does."""
+    want = n
     chunks = []
     while n:
         b = sock.recv(min(n, 1 << 20))
         if not b:
-            raise ConnectionError("ps transport: peer closed mid-message")
+            raise TransportError(
+                f"peer closed mid-message: expected {want} bytes, "
+                f"got {want - n}", transient=True)
         chunks.append(b)
         n -= len(b)
     return b"".join(chunks)
@@ -146,8 +221,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_msg(sock: socket.socket):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > _MAX_MSG:
-        raise ConnectionError(f"ps transport: message of {n} bytes exceeds "
-                              f"{_MAX_MSG} cap")
+        raise TransportError(f"message of {n} bytes exceeds {_MAX_MSG} "
+                             "cap", transient=False)
     return _unpack_msg(_recv_exact(sock, n))
 
 
@@ -182,6 +257,11 @@ class ShardClient:
 
     def ping(self) -> bool:
         raise NotImplementedError
+
+    def reset_instance_expectation(self) -> None:
+        """Forget the remembered server instance id: the next reply's id
+        is adopted without raising ShardRestartedError. Recovery calls
+        this once the restarted shard has been repopulated."""
 
     def close(self) -> None:
         pass
@@ -231,21 +311,94 @@ class InProcessClient(ShardClient):
 
 
 class SocketClient(ShardClient):
-    """Persistent-connection client for a remote ``ShardServer``."""
+    """Persistent-connection client for a remote ``ShardServer``.
 
-    def __init__(self, endpoint: str, timeout: float = 30.0):
+    The connection is LAZY (first RPC connects) and self-healing: any
+    transient failure drops the socket, backs off, reconnects, and
+    re-sends — safe because every op is idempotent (pull reads, push/load
+    scatter-SET absolute rows). Constructor args override the
+    ``PDTPU_PS_*`` environment defaults; ``retries=0`` makes a
+    single-shot probe client (what ShardMonitor uses)."""
+
+    def __init__(self, endpoint: str, timeout: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff_ms: Optional[float] = None):
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._retries = retries
+        self._backoff_ms = backoff_ms
+        self._sock: Optional[socket.socket] = None
+        self._inst: Optional[str] = None
         self._lock = threading.Lock()
 
-    def _call(self, op: str, **kw):
+    # env resolved per call, not per client: tests and operators tune the
+    # knobs on a live process
+    def _cfg(self) -> Tuple[float, int, float]:
+        t = (self._timeout if self._timeout is not None
+             else float(os.environ.get("PDTPU_PS_TIMEOUT", "30")))
+        r = (self._retries if self._retries is not None
+             else int(os.environ.get("PDTPU_PS_RETRIES", "5")))
+        b = (self._backoff_ms if self._backoff_ms is not None
+             else float(os.environ.get("PDTPU_PS_RETRY_BACKOFF_MS", "50")))
+        return t, r, b
+
+    def _ensure_sock(self, timeout: float) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self._addr, timeout=timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        else:
+            self._sock.settimeout(timeout)
+        return self._sock
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call(self, op: str, _retryable: bool = True, **kw):
         msg = {"op": op, **kw}
+        timeout, retries, backoff_ms = self._cfg()
+        attempt = 0
         with self._lock:
-            _send_msg(self._sock, msg)
-            rep = _recv_msg(self._sock)
+            while True:
+                try:
+                    sock = self._ensure_sock(timeout)
+                    _send_msg(sock, msg)
+                    rep = _recv_msg(sock)
+                    break
+                except OSError as e:  # TransportError, timeout, ECONNRESET
+                    # a dirty socket cannot be reused: mid-frame state is
+                    # unknowable after any failure
+                    self._drop_sock()
+                    transient = getattr(e, "transient", True)
+                    if (not transient or not _retryable
+                            or attempt >= retries):
+                        raise TransportError(
+                            f"{op}: {e}", transient=transient,
+                            endpoint=self.endpoint,
+                            attempts=attempt + 1) from e
+                    _RPC_RETRIES.inc()
+                    time.sleep(min(backoff_ms * (2 ** attempt), 5000.0)
+                               / 1e3)
+                    attempt += 1
+            inst = rep.get("inst")
+            if isinstance(inst, str):
+                if self._inst is None:
+                    self._inst = inst
+                elif self._inst != inst:
+                    # do NOT adopt: every call keeps failing until
+                    # recovery repopulates the shard and calls
+                    # reset_instance_expectation() — otherwise the first
+                    # raise would "cure" the client and the next read
+                    # would silently see a freshly-booted EMPTY shard
+                    raise ShardRestartedError(self.endpoint, self._inst,
+                                              inst)
         if rep.get("err"):
             raise RuntimeError(f"ps shard {self.endpoint} {op}: "
                                f"{rep['err']}")
@@ -276,18 +429,34 @@ class SocketClient(ShardClient):
     def ping(self):
         return bool(self._call("ping"))
 
+    def reset_instance_expectation(self):
+        with self._lock:
+            self._inst = None
+
     def shutdown_server(self):
         """Ask the server process to stop (tests / orderly teardown)."""
         try:
-            self._call("shutdown")
+            self._call("shutdown", _retryable=False)
         except (ConnectionError, OSError):
             pass  # server may close before replying
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_sock()
+
+
+def probe(endpoint: str, timeout: float = 2.0) -> bool:
+    """One-shot liveness check: fresh connection, single ping, close.
+    Never retries, never touches a persistent client's socket or
+    instance expectation — safe to call from a monitor thread at any
+    rate. Returns False on ANY failure."""
+    c = SocketClient(endpoint, timeout=timeout, retries=0)
+    try:
+        return c.ping()
+    except Exception:
+        return False
+    finally:
+        c.close()
 
 
 def connect(endpoint_or_shards) -> ShardClient:
@@ -300,6 +469,15 @@ def connect(endpoint_or_shards) -> ShardClient:
 # ------------------------------------------------------------------ server
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # registration makes shutdown() able to unblock this thread's
+        # recv by closing the socket out from under it
+        self.server.ps_server._track(self.request,
+                                     threading.current_thread())
+
+    def finish(self):
+        self.server.ps_server._untrack(self.request)
+
     def handle(self):
         srv: "ShardServer" = self.server.ps_server  # type: ignore
         sock = self.request
@@ -309,18 +487,33 @@ class _Handler(socketserver.BaseRequestHandler):
                 msg = _recv_msg(sock)
             except (ConnectionError, OSError):
                 return
+            try:
+                fault_point("ps.rpc")
+            except InjectedNetworkFault as f:
+                if f.kind == "reset":
+                    # SO_LINGER 0 → close sends RST, the client sees
+                    # ECONNRESET (a crashed pserver); plain close models
+                    # a swallowed request (drop)
+                    try:
+                        sock.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+                    except OSError:
+                        pass
+                return
             op = msg.get("op")
             if op == "shutdown":
                 try:
-                    _send_msg(sock, {"out": True})
+                    _send_msg(sock, {"out": True, "inst": srv.instance_id})
                 finally:
-                    threading.Thread(target=self.server.shutdown,
+                    threading.Thread(target=srv.stop,
                                      daemon=True).start()
                 return
             try:
                 rep = {"out": srv.dispatch(op, msg)}
             except Exception as e:  # report, keep the connection alive
                 rep = {"err": f"{type(e).__name__}: {e}"}
+            rep["inst"] = srv.instance_id
             try:
                 _send_msg(sock, rep)
             except (ConnectionError, OSError):
@@ -330,6 +523,10 @@ class _Handler(socketserver.BaseRequestHandler):
 class _TCP(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # ShardServer.stop() does its own BOUNDED join after closing the live
+    # connection sockets; the stdlib's unbounded _threads.join() would
+    # hang on a handler blocked in recv()
+    block_on_close = False
 
 
 class ShardServer:
@@ -347,14 +544,29 @@ class ShardServer:
         measuring pure serialization CPU time)."""
         self.local = InProcessClient(shards)
         self.delay_ms = float(delay_ms)
+        # random per-boot token: lets clients detect "this pserver
+        # restarted (and lost its rows) between my RPCs"
+        self.instance_id = os.urandom(8).hex()
         self._tcp = _TCP((host, port), _Handler)
         self._tcp.ps_server = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        self._conn_lock = threading.Lock()
+        self._conns: Dict[socket.socket, threading.Thread] = {}
+        self._serving = False
+        self._stopped = False
 
     @property
     def endpoint(self) -> str:
         host, port = self._tcp.server_address[:2]
         return f"{host}:{port}"
+
+    def _track(self, sock: socket.socket, thread: threading.Thread):
+        with self._conn_lock:
+            self._conns[sock] = thread
+
+    def _untrack(self, sock: socket.socket):
+        with self._conn_lock:
+            self._conns.pop(sock, None)
 
     def dispatch(self, op: str, msg: dict):
         if op == "ping":
@@ -379,6 +591,7 @@ class ShardServer:
         raise ValueError(f"unknown ps op {op!r}")
 
     def serve_in_thread(self) -> "ShardServer":
+        self._serving = True
         self._thread = threading.Thread(target=self._tcp.serve_forever,
                                         name=f"ps-server@{self.endpoint}",
                                         daemon=True)
@@ -386,11 +599,43 @@ class ShardServer:
         return self
 
     def serve_forever(self):
+        self._serving = True
         self._tcp.serve_forever()
 
-    def stop(self):
-        self._tcp.shutdown()
+    def stop(self, join_timeout: float = 5.0):
+        """Stop accepting, unblock and join every live per-connection
+        handler (bounded): a test teardown or the ``shutdown`` op must
+        not leak daemon threads holding the port — or sockets — into the
+        next test case. Idempotent."""
+        with self._conn_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        if self._serving:
+            # BaseServer.shutdown() blocks on serve_forever's exit event;
+            # calling it on a never-served server would wait forever
+            self._tcp.shutdown()
         self._tcp.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+        with self._conn_lock:
+            live = list(self._conns.items())
+        for sock, _ in live:
+            # recv() in the handler returns immediately once the socket
+            # is shut down under it
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + join_timeout
+        me = threading.current_thread()
+        for _, t in live:
+            if t is me or not t.is_alive():
+                continue
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if self._thread is not None and self._thread is not me:
+            self._thread.join(timeout=max(0.0,
+                                          deadline - time.monotonic()))
             self._thread = None

@@ -1,0 +1,121 @@
+"""Operator CLI for a running PS shard fleet.
+
+The command-line view of what ``ps.ShardMonitor`` watches: point it at
+the pserver endpoint list and ask each shard how it's doing. Never
+imports JAX (it must run on a bastion or a pserver host), never retries
+(an operator wants the truthful instantaneous answer, not the
+self-healed one), and always exits 0/1 so it can sit in a cron or a
+k8s liveness probe.
+
+CLI::
+
+    python -m paddle_tpu.tools.ps_admin ping
+    python -m paddle_tpu.tools.ps_admin stats --endpoints h1:6000,h2:6000
+    python -m paddle_tpu.tools.ps_admin meta
+    python -m paddle_tpu.tools.ps_admin dump-health --json
+
+Endpoints come from ``--endpoints`` (comma-separated), else
+``PADDLE_PSERVER_ENDPOINTS``, else ``PADDLE_PSERVERS_IP_PORT_LIST``
+(both reference-style env spellings are honored, same as the fleet role
+makers).
+
+Commands:
+
+* ``ping``        — one-shot liveness per shard (fresh connection each);
+* ``meta``        — which tables each shard hosts and their row ranges;
+* ``stats``       — per-shard pull/push byte counters;
+* ``dump-health`` — the ShardMonitor view as one JSON document: runs a
+  single synchronous sweep and prints ``status`` (ok/degraded/failing),
+  per-shard up flags, and the endpoint list — what the in-process
+  ``/healthz`` check ``ps/shards`` reports, minus the wedge timer
+  (a one-shot CLI has no down-since history).
+
+Exit code 0 when every shard answered, 1 otherwise (plus 2 for usage
+errors, argparse's convention).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["main"]
+
+
+def _endpoints(arg: str) -> list:
+    eps = (arg or os.environ.get("PADDLE_PSERVER_ENDPOINTS")
+           or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST") or "")
+    out = [e.strip() for e in eps.replace(";", ",").split(",") if e.strip()]
+    if not out:
+        raise SystemExit(
+            "ps_admin: no endpoints — pass --endpoints host:port,... or "
+            "set PADDLE_PSERVER_ENDPOINTS")
+    for e in out:
+        if ":" not in e:
+            raise SystemExit(f"ps_admin: bad endpoint {e!r} "
+                             "(expected host:port)")
+    return out
+
+
+def _ask(endpoint: str, op: str, timeout: float):
+    """(ok, payload-or-error) for one shard, single attempt."""
+    from ..ps.transport import SocketClient
+
+    c = SocketClient(endpoint, timeout=timeout, retries=0)
+    try:
+        if op == "ping":
+            return True, c.ping()
+        return True, getattr(c, op)()
+    except Exception as e:
+        return False, f"{type(e).__name__}: {e}"
+    finally:
+        c.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ps_admin",
+        description="inspect a running PS shard fleet")
+    ap.add_argument("cmd", choices=["ping", "stats", "meta", "dump-health"])
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated host:port list (default: "
+                         "PADDLE_PSERVER_ENDPOINTS)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-shard socket timeout, seconds (default 2)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (dump-health always is)")
+    args = ap.parse_args(argv)
+    eps = _endpoints(args.endpoints)
+
+    if args.cmd == "dump-health":
+        from ..ps.health import ShardMonitor
+        mon = ShardMonitor.for_endpoints(eps)
+        mon.poll_now()
+        doc = mon.status()
+        print(json.dumps(doc, indent=None if args.json else 2,
+                         sort_keys=True))
+        return 0 if all(s["up"] for s in doc["shards"]) else 1
+
+    op = {"ping": "ping", "stats": "stats", "meta": "meta"}[args.cmd]
+    rows = []
+    all_up = True
+    for i, ep in enumerate(eps):
+        ok, payload = _ask(ep, op, args.timeout)
+        all_up &= ok
+        rows.append({"shard": i, "endpoint": ep, "up": ok,
+                     ("error" if not ok else op): payload})
+    if args.json:
+        print(json.dumps(rows, sort_keys=True))
+    else:
+        for r in rows:
+            state = "up" if r["up"] else f"DOWN ({r['error']})"
+            line = f"shard {r['shard']} {r['endpoint']}: {state}"
+            if r["up"] and op != "ping":
+                line += " " + json.dumps(r[op], sort_keys=True)
+            print(line)
+    return 0 if all_up else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 CI deselects these (`-m "not slow"`); registration keeps
+    # pytest from warning on the unknown marker
+    config.addinivalue_line(
+        "markers", "slow: long chaos/soak cells excluded from tier-1")
+
+
 @pytest.fixture()
 def xla_8dev_subprocess_env():
     """Env for subprocess runners that must see 8 fake CPU devices from a
