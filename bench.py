@@ -1247,6 +1247,192 @@ def bench_ckpt_integrity():
     return out
 
 
+def bench_serving_fleet(on_tpu):
+    """Serving-fleet economics, the three arms the subsystem claims:
+    (a) 1-replica vs N-replica closed-loop throughput, (b) the
+    client-visible pause of a zero-downtime weight swap under sustained
+    load (max gap between consecutive completions while the rollout
+    runs, plus error/drop counts — both must be zero), (c) PS-backed CTR
+    serving (cache-sized replica pulling rows from a live ShardedTable)
+    vs the local-table Predictor, with the bitwise-identity flag and the
+    resident-bytes fraction."""
+    import shutil
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu.tools import serving_bench as sb
+
+    out = {}
+    in_dim, hidden, n_req = (512, 2048, 256) if on_tpu else (64, 128, 96)
+    buckets = (1, 2, 4, 8)
+    dirs = [tempfile.mkdtemp(prefix=f"fleet_bench_v{i}_") for i in (1, 2)]
+    dps = tempfile.mkdtemp(prefix="fleet_bench_ps_")
+    try:
+        # -- (a) scale-out: one served replica vs a 3-replica fleet
+        pred = sb.build_predictor(model_dir=dirs[0], in_dim=in_dim,
+                                  hidden=hidden)
+        rows = sb._gen_rows(n_req, in_dim)
+        served = sb.bench_served(pred, rows, concurrency=16,
+                                 buckets=buckets, batch_delay_ms=1.0)
+        fleet3 = sb.bench_fleet(dirs[0], rows, replicas=3, concurrency=16,
+                                buckets=buckets, batch_delay_ms=1.0)
+        out["one_replica_rps"] = round(served["throughput_rps"], 1)
+        out["fleet3_rps"] = round(fleet3["throughput_rps"], 1)
+        out["fleet3_p99_ms"] = round(fleet3["p99_ms"], 2)
+        out["fleet3_errors"] = fleet3["errors"]
+        out["scaleout_speedup"] = round(
+            fleet3["throughput_rps"]
+            / max(served["throughput_rps"], 1e-9), 2)
+
+        # -- (b) swap-under-load pause: one client hammers the fleet
+        # while every replica warms + flips to v2; the "pause" is the
+        # longest gap between consecutive completions
+        sb.build_predictor(model_dir=dirs[1], in_dim=in_dim, hidden=hidden)
+        from paddle_tpu.serving import fleet as fleet_mod
+        reg = fleet_mod.ModelRegistry()
+        reg.register("v1", dirs[0])
+        reg.register("v2", dirs[1])
+        fl = fleet_mod.ServingFleet(
+            reg, "v1", replicas=3, buckets=buckets,
+            server_kwargs={"max_batch_delay_ms": 1.0,
+                           "max_queue_size": 1024})
+        stamps, errs = [], [0]
+        done = threading.Event()
+
+        def client():
+            i = 0
+            while not done.is_set():
+                try:
+                    fl.infer({"x": rows[i % len(rows)]})
+                    stamps.append(time.monotonic())
+                except Exception:
+                    errs[0] += 1
+                i += 1
+
+        with fl:
+            t = threading.Thread(target=client)
+            t.start()
+            time.sleep(0.3)
+            rollout = fl.rollout("v2")
+            time.sleep(0.3)
+            done.set()
+            t.join()
+        gaps = np.diff(np.asarray(stamps)) * 1e3 if len(stamps) > 1 else [0.0]
+        out["swap_under_load"] = {
+            "rollout_wall_ms": round(rollout["wall_ms"], 1),
+            "requests_completed": len(stamps),
+            "max_completion_gap_ms": round(float(np.max(gaps)), 2),
+            "errors": errs[0],
+            "versions_live": rollout["version"],
+        }
+
+        # -- (c) PS-backed vs local-table CTR arm
+        out["ps_vs_local"] = _bench_ps_serving_arm(dps, on_tpu)
+    finally:
+        for d in dirs + [dps]:
+            shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
+def _bench_ps_serving_arm(workdir, on_tpu):
+    """Per-request latency of the local-table Predictor vs the
+    PsLookupPredictor (rows pulled from a live in-process ShardedTable
+    through an LRU row cache), same checkpoint — plus the bitwise flag
+    and the replica's resident-bytes fraction of the full table."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.initializer import RowPackInitializer
+    from paddle_tpu.ops.deferred_rows import pack_rows
+    from paddle_tpu.param_attr import ParamAttr
+    from paddle_tpu.ps import RangeSpec, ShardedTable
+
+    V, D, MULT, F, CAP = (65536, 8, 2, 16, 1024) if on_tpu \
+        else (4096, 8, 2, 8, 256)
+
+    def build_and_save(vocab_rows, model_dir, packed=None, dense=None):
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            ids = layers.data("ids", [F], dtype="int64")
+            emb = layers.embedding(
+                ids, [vocab_rows, D * MULT], is_sparse=True, row_pack=True,
+                param_attr=ParamAttr(name="tb",
+                                     initializer=RowPackInitializer(
+                                         D, D * MULT, -1.0, 1.0)))
+            emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+            r = layers.reshape(emb, [-1, F * D])
+            out_v = layers.fc(r, 16, act="softmax")
+        exe = fluid.Executor(fluid.TPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            sc = global_scope()
+            if packed is not None:
+                sc.set_var("tb", jnp.asarray(packed))
+                dense = {n: np.asarray(sc.find_var(n))
+                         for n in sc.var_names()
+                         if n != "tb"
+                         and np.asarray(sc.find_var(n)).dtype == np.float32}
+            else:
+                for n, v in dense.items():
+                    sc.set_var(n, jnp.asarray(v))
+                sc.set_var("tb", jnp.zeros((vocab_rows, 128), jnp.uint16))
+            fluid.io.save_inference_model(model_dir, ["ids"], [out_v],
+                                          exe, main_p)
+        return dense
+
+    vis = np.random.RandomState(7).uniform(-1, 1, (V, D)).astype("float32")
+    full = np.zeros((V, D * MULT), "float32")
+    full[:, :D] = vis
+    packed = np.asarray(pack_rows(jnp.asarray(full)))
+    d_local = os.path.join(workdir, "local")
+    d_ps = os.path.join(workdir, "ps")
+    dense = build_and_save(V, d_local, packed=packed)
+    build_and_save(CAP, d_ps, dense=dense)
+
+    ref = inference.create_predictor(inference.Config(d_local))
+    table = ShardedTable.build_in_process("tb", RangeSpec.even(V, 3),
+                                          full_rows=packed)
+    try:
+        ps = inference.PsLookupPredictor(
+            inference.create_predictor(inference.Config(d_ps)),
+            [inference.PsLookupBinding("tb", table, ["ids"])],
+            cache_rows_per_table=2 * CAP)
+        rng = np.random.RandomState(3)
+        batches = [rng.randint(0, V, size=(8, F)).astype(np.int64)
+                   for _ in range(32)]
+        ref.run_padded({"ids": batches[0]}, 8)   # compile outside clocks
+        ps.run_padded({"ids": batches[0]}, 8)
+        bitwise = True
+        t_local = t_ps = 0.0
+        for ids in batches:
+            t0 = time.perf_counter()
+            o_ref = ref.run_padded({"ids": ids}, 8)
+            t_local += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            o_ps = ps.run_padded({"ids": ids}, 8)
+            t_ps += time.perf_counter() - t0
+            for a, b in zip(o_ref, o_ps):
+                if not (np.asarray(a) == np.asarray(b)).all():
+                    bitwise = False
+        st = ps.stats()["tb"]
+        return {
+            "bitwise_identical": bitwise,
+            "local_ms_per_req": round(t_local / len(batches) * 1e3, 3),
+            "ps_ms_per_req": round(t_ps / len(batches) * 1e3, 3),
+            "lookup_overhead_x": round(t_ps / max(t_local, 1e-12), 2),
+            "cache": {k: st[k] for k in ("hits", "misses", "evictions")},
+            "resident_bytes": ps.resident_table_bytes(),
+            "full_table_bytes": int(packed.nbytes),
+            "resident_fraction": round(
+                ps.resident_table_bytes() / packed.nbytes, 4),
+        }
+    finally:
+        table.close()
+
+
 def main():
     import jax
 
@@ -1418,6 +1604,15 @@ def main():
     except Exception as e:  # pragma: no cover
         extras2["ps_fault"] = {"error": str(e)[:120]}
     _end_section(extras2, "ps_fault")
+
+    # serving fleet: 1-vs-N replica scale-out throughput, zero-downtime
+    # swap pause under load, and the PS-backed CTR arm vs a local table
+    # (PR 11 fleet subsystem)
+    try:
+        extras2["serving_fleet"] = bench_serving_fleet(on_tpu)
+    except Exception as e:  # pragma: no cover
+        extras2["serving_fleet"] = {"error": str(e)[:120]}
+    _end_section(extras2, "serving_fleet")
 
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms

@@ -28,7 +28,8 @@ from ..core.scope import Scope, scope_guard
 from ..ir import PassBuilder
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "AnalysisConfig", "create_paddle_predictor"]
+           "PrecisionType", "AnalysisConfig", "create_paddle_predictor",
+           "PsLookupBinding", "PsLookupPredictor", "RowCache"]
 
 
 class PrecisionType:
@@ -37,6 +38,24 @@ class PrecisionType:
     # API-compat alias: the reference's Half means fp16 on GPU; on TPU the
     # low-precision serving dtype is bf16.
     Half = "bfloat16"
+
+
+_PRECISION_ALIASES = {
+    "f32": PrecisionType.Float32, "fp32": PrecisionType.Float32,
+    "float32": PrecisionType.Float32,
+    "bf16": PrecisionType.Bfloat16, "bfloat16": PrecisionType.Bfloat16,
+    "half": PrecisionType.Bfloat16, "fp16": PrecisionType.Bfloat16,
+    "float16": PrecisionType.Bfloat16,
+}
+
+
+def _resolve_precision(precision) -> str:
+    try:
+        return _PRECISION_ALIASES[str(precision).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision {precision!r}; expected one of "
+            f"{sorted(set(_PRECISION_ALIASES))}") from None
 
 
 def _is_reference_model_file(path: str) -> bool:
@@ -153,13 +172,18 @@ class Tensor:
 class Predictor:
     """AnalysisPredictor parity: load → optimize → AOT-jit → run."""
 
-    def __init__(self, config: Config, _shared=None):
+    def __init__(self, config: Config, precision: Optional[str] = None,
+                 _shared=None):
         import jax
         self._config = config
         self._jax = jax
         self._cache: Dict = {}
         self._feed_buf: Dict[str, np.ndarray] = {}
         self._fetch_buf: Dict[str, np.ndarray] = {}
+        # `precision` overrides Config.enable_tpu's dtype per-predictor —
+        # the same Config (or model dir) can serve f32 and bf16 replicas
+        self._precision = (_resolve_precision(precision)
+                           if precision is not None else config._precision)
         if _shared is not None:
             # clone path (analysis_predictor.cc:479): share program + weights
             self._program, self._feed_names, self._fetch_names, self._state = _shared
@@ -206,7 +230,7 @@ class Predictor:
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = fetch_names
-        dtype = cfg._precision
+        dtype = self._precision
         self._state = {}
         for v in program.list_vars():
             if v.persistable and scope.has_var(v.name):
@@ -235,7 +259,7 @@ class Predictor:
     def clone(self) -> "Predictor":
         """New predictor sharing program + device weights (zero-copy; the
         reference's clone-weights optimization)."""
-        return Predictor(self._config,
+        return Predictor(self._config, precision=self._precision,
                          _shared=(self._program, self._feed_names,
                                   self._fetch_names, self._state))
 
@@ -256,7 +280,7 @@ class Predictor:
             # never alias (and so never donate) a caller-owned jax array
             val = jnp.array(feed[n], dtype=var.dtype if var is not None else None,
                             copy=True)
-            if (self._config._precision == PrecisionType.Bfloat16
+            if (self._precision == PrecisionType.Bfloat16
                     and val.dtype == jnp.float32):
                 val = val.astype(jnp.bfloat16)
             feed_vals[n] = val
@@ -332,10 +356,15 @@ class Predictor:
         return self._jax.jit(serve, donate_argnums=donate)
 
 
-def create_predictor(config: Config) -> Predictor:
-    return Predictor(config)
+def create_predictor(config: Config,
+                     precision: Optional[str] = None) -> Predictor:
+    return Predictor(config, precision=precision)
 
 
 def create_paddle_predictor(config: Config) -> Predictor:
     """Old-API alias (CreatePaddlePredictor)."""
     return Predictor(config)
+
+
+from .ps_lookup import (PsLookupBinding, PsLookupPredictor,  # noqa: E402,F401
+                        RowCache)

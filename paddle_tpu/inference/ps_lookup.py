@@ -1,0 +1,272 @@
+"""PS-backed CTR inference: serve a big-table model from small replicas.
+
+The trained CTR model's embedding table ([vocab, 128] packed uint16 rows,
+33.5M rows at production vocab = ~8.6 GB) lives on the parameter-server
+tier (paddle_tpu.ps). Loading it into every inference replica would cap
+the fleet size at table-bytes-per-host; instead each replica holds a
+**cache-sized** table param (`cache_rows` x 128 uint16) plus an LRU row
+cache, and pulls only the rows each request actually touches from the
+live `ShardedTable` (the PR 9 transport with PR 10 retry/instance-id
+semantics underneath).
+
+Bitwise identity with the local-table Predictor is by construction, not
+luck: the `lookup_table` op with `row_pack_dt` is a per-row gather
+followed by a bit-exact unpack (`jnp.take` + `unpack_rows`), so remapping
+global ids to cache-local positions and gathering from a small table
+holding the *same row bytes* produces the same output bits. Per request:
+
+1. concatenate the binding's id feeds, `np.unique(return_inverse=True)`
+   → sorted unique global ids + the inverse map,
+2. serve hits from the replica's LRU `RowCache`, pull misses from the
+   `ShardedTable` (the unique-id list is ascending — the table's pull
+   contract — and the miss subset of a sorted list stays sorted),
+3. assemble the fixed-shape `[cache_rows, 128]` cache param (constant
+   shape ⇒ the XLA executable set stays exactly the bucketed set),
+4. rewrite the id feeds to cache-local positions and run the base
+   Predictor with the cache param swapped into its state.
+
+Read-only by design: serving never pushes. Staleness is whatever the row
+cache holds — `invalidate()` drops it (e.g. after the training side
+publishes a new checkpoint).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PsLookupBinding", "PsLookupPredictor", "RowCache"]
+
+
+class RowCache:
+    """LRU cache of packed embedding rows (global id → `[lanes]` uint16).
+
+    Slab storage: one preallocated `[capacity, lanes]` array plus an
+    id→slot map, so memory is bounded and visible (`nbytes`) — the number
+    the replica-footprint assertion in the fleet tests keys on.
+    """
+
+    def __init__(self, capacity: int, lanes: int):
+        if capacity < 1:
+            raise ValueError("RowCache capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.lanes = int(lanes)
+        self._store = np.zeros((self.capacity, self.lanes), np.uint16)
+        self._slot: Dict[int, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._slot)
+
+    @property
+    def nbytes(self) -> int:
+        return self._store.nbytes
+
+    def lookup(self, uids: np.ndarray):
+        """rows `[k, lanes]` (hit rows filled) + boolean miss mask."""
+        k = len(uids)
+        rows = np.zeros((k, self.lanes), np.uint16)
+        miss = np.zeros(k, bool)
+        for j, u in enumerate(uids.tolist()):
+            s = self._slot.get(u)
+            if s is None:
+                miss[j] = True
+            else:
+                rows[j] = self._store[s]
+                self._lru.move_to_end(u)
+        nm = int(miss.sum())
+        self.misses += nm
+        self.hits += k - nm
+        return rows, miss
+
+    def insert(self, uids: np.ndarray, rows: np.ndarray) -> None:
+        for j, u in enumerate(uids.tolist()):
+            s = self._slot.get(u)
+            if s is None:
+                if self._free:
+                    s = self._free.pop()
+                else:
+                    old, _ = self._lru.popitem(last=False)
+                    s = self._slot.pop(old)
+                    self.evictions += 1
+                self._slot[u] = s
+            self._store[s] = rows[j]
+            self._lru[u] = None
+            self._lru.move_to_end(u)
+
+    def clear(self) -> None:
+        self._slot.clear()
+        self._lru.clear()
+        self._free = list(range(self.capacity - 1, -1, -1))
+
+    def stats(self) -> dict:
+        return {"rows": len(self._slot), "capacity": self.capacity,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "bytes": self.nbytes}
+
+
+class PsLookupBinding:
+    """One PS-resident table in the serving program: the (cache-sized)
+    param it binds to, the ShardedTable (or any object with
+    ``pull(sorted_uids) -> [k, lanes] uint16``), and the feeds carrying
+    its global row ids."""
+
+    def __init__(self, param: str, table, id_feeds: Sequence[str]):
+        if not id_feeds:
+            raise ValueError(f"binding for {param!r}: no id feeds")
+        self.param = param
+        self.table = table
+        self.id_feeds = list(id_feeds)
+
+
+class PsLookupPredictor:
+    """Read-only Predictor wrapper that resolves embedding rows through
+    the PS tier (module docstring has the contract). The wrapped
+    predictor's table params must be cache-sized (`[cache_rows, lanes]`)
+    — save the serving model with a small table; this class fills it per
+    request. Drop-in for `serving.InferenceServer` (run / run_padded /
+    clone / the warmup surface)."""
+
+    def __init__(self, predictor, bindings: Sequence[PsLookupBinding],
+                 cache_rows_per_table: Optional[int] = None):
+        self._pred = predictor
+        # private state *mapping* (the arrays stay shared): per-request
+        # cache-param swaps must never leak into sibling clones mid-flight
+        self._pred._state = dict(self._pred._state)
+        self._bindings = list(bindings)
+        self._lock = threading.RLock()
+        if cache_rows_per_table is None:
+            cache_rows_per_table = int(
+                os.environ.get("PDTPU_PS_SERVE_CACHE_ROWS", "65536"))
+        self._shapes: Dict[str, tuple] = {}
+        self._caches: Dict[str, RowCache] = {}
+        for b in self._bindings:
+            st = self._pred._state.get(b.param)
+            if st is None:
+                raise ValueError(
+                    f"PsLookupPredictor: param {b.param!r} not in the "
+                    f"predictor's state; persistable vars: "
+                    f"{sorted(self._pred._state)}")
+            if st.ndim != 2 or str(st.dtype) != "uint16":
+                raise ValueError(
+                    f"PsLookupPredictor: param {b.param!r} is "
+                    f"{st.shape}/{st.dtype}, expected a packed "
+                    f"[cache_rows, lanes] uint16 table")
+            self._shapes[b.param] = tuple(int(d) for d in st.shape)
+            self._caches[b.param] = RowCache(
+                max(cache_rows_per_table, st.shape[0]), int(st.shape[1]))
+
+    # -- serving surface (what InferenceServer/warmup/batcher touch) -------
+    @property
+    def _program(self):
+        return self._pred._program
+
+    @property
+    def _cache(self):
+        return self._pred._cache
+
+    @property
+    def _feed_names(self):
+        return self._pred._feed_names
+
+    @property
+    def _fetch_names(self):
+        return self._pred._fetch_names
+
+    def get_input_names(self) -> List[str]:
+        return self._pred.get_input_names()
+
+    def get_output_names(self) -> List[str]:
+        return self._pred.get_output_names()
+
+    def clone(self) -> "PsLookupPredictor":
+        """Clone for a sibling serve worker: shares program + dense
+        weights (zero-copy) and the ShardedTable connections, but gets
+        its own row cache (caches are per-worker working sets)."""
+        return PsLookupPredictor(
+            self._pred.clone(), self._bindings,
+            cache_rows_per_table=next(iter(self._caches.values())).capacity)
+
+    # -- the lookup path ----------------------------------------------------
+    def _localize(self, feed: Dict[str, np.ndarray]):
+        feed2 = {k: np.asarray(v) for k, v in feed.items()}
+        overrides: Dict[str, np.ndarray] = {}
+        for b in self._bindings:
+            cache_rows, lanes = self._shapes[b.param]
+            parts = []
+            for n in b.id_feeds:
+                if n not in feed2:
+                    raise ValueError(
+                        f"PsLookupPredictor: id feed {n!r} (binding "
+                        f"{b.param!r}) missing from the request")
+                parts.append(feed2[n].reshape(-1).astype(np.int64))
+            flat = np.concatenate(parts)
+            uids, inverse = np.unique(flat, return_inverse=True)
+            if uids.size > cache_rows:
+                raise ValueError(
+                    f"PsLookupPredictor: request touches {uids.size} "
+                    f"distinct rows of {b.param!r} but the cache param "
+                    f"holds {cache_rows}; resave the serving model with "
+                    f"a larger cache table")
+            cache = self._caches[b.param]
+            rows, miss = cache.lookup(uids)
+            if miss.any():
+                pulled = np.asarray(b.table.pull(uids[miss]))
+                rows[miss] = pulled
+                cache.insert(uids[miss], pulled)
+            arr = np.zeros((cache_rows, lanes), np.uint16)
+            arr[:uids.size] = rows
+            overrides[b.param] = arr
+            off = 0
+            for n in b.id_feeds:
+                a = feed2[n]
+                feed2[n] = (inverse[off:off + a.size]
+                            .reshape(a.shape).astype(a.dtype))
+                off += a.size
+        return feed2, overrides
+
+    def _apply(self, overrides: Dict[str, np.ndarray]) -> None:
+        import jax.numpy as jnp
+        for p, arr in overrides.items():
+            self._pred._state[p] = jnp.asarray(arr)
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        with self._lock:
+            feed2, overrides = self._localize(feed)
+            self._apply(overrides)
+            return self._pred.run(feed2)
+
+    def run_padded(self, feed: Dict[str, np.ndarray],
+                   batch_size: int) -> List[np.ndarray]:
+        # localize BEFORE padding: edge padding then replicates the last
+        # row's cache-local ids, which are valid positions by construction
+        with self._lock:
+            feed2, overrides = self._localize(feed)
+            self._apply(overrides)
+            return self._pred.run_padded(feed2, batch_size)
+
+    # -- introspection -------------------------------------------------------
+    def invalidate(self) -> None:
+        """Drop every cached row (next requests re-pull — call after the
+        training side publishes fresher table bytes)."""
+        with self._lock:
+            for c in self._caches.values():
+                c.clear()
+
+    def resident_table_bytes(self) -> int:
+        """Bytes of table data this replica actually holds: the
+        cache-sized device param(s) + the host LRU slab. The fleet test
+        asserts this is a small fraction of the full table."""
+        dev = sum(rows * lanes * 2 for rows, lanes in self._shapes.values())
+        return dev + sum(c.nbytes for c in self._caches.values())
+
+    def stats(self) -> dict:
+        return {p: c.stats() for p, c in self._caches.items()}

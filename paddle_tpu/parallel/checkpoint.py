@@ -437,6 +437,13 @@ class Checkpointer:
                         "(corrupt)")
         return problems
 
+    def verified_steps(self) -> List[int]:
+        """Every step whose manifest verification passes, newest first —
+        the set a serving ModelRegistry may claim lineage from (a torn or
+        corrupt training checkpoint never becomes a serving version)."""
+        return [s for s in sorted(self.all_steps(), reverse=True)
+                if not self.verify(s)]
+
     def load_ps_table(self, tname: str):
         """Shard-recovery read path: ``(full_rows, journal_mark, step)``
         for PS table `tname` from the newest checkpoint that passes

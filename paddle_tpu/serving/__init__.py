@@ -50,5 +50,16 @@ __all__ = [
     "DEFAULT_BUCKETS", "DynamicBatcher", "ServingError", "bucket_for",
     "item_signature", "Counter", "Gauge", "Histogram", "Metrics",
     "InferenceServer", "QueueFullError", "Request", "ServerClosedError",
-    "warmup",
+    "fleet", "warmup",
 ]
+
+
+def __getattr__(name):
+    # lazy subpackage: `serving.fleet` without paying its import (and the
+    # ps transport import underneath) on every `import paddle_tpu`
+    if name == "fleet":
+        import importlib
+        mod = importlib.import_module(".fleet", __name__)
+        globals()["fleet"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

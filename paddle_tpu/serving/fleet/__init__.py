@@ -1,0 +1,40 @@
+"""paddle_tpu.serving.fleet — replica scale-out for the serving tier.
+
+The fleet half of "millions of users": N shared-nothing
+`InferenceServer` replicas (threads or SIGKILL-able subprocesses)
+behind a `FleetRouter` that distributes requests (least-outstanding or
+round-robin), sheds load off per-replica `/healthz` signals (degraded →
+deprioritize, draining → stop sending, failing → eject, re-admit on
+recovery) and replays idempotent requests on a different replica when
+one dies mid-flight; a `ModelRegistry` of versioned, manifest-verified
+model directories; `ServingFleet.rollout()` for zero-downtime weight
+swaps (background-warm → atomic flip → drain, one replica at a time)
+and `ab_split()` for weighted A/B between two live versions.
+
+PS-backed CTR serving plugs in through `predictor_factory`: build each
+replica's predictor as an `inference.PsLookupPredictor` and the fleet
+serves a big-table model while every replica holds only an LRU row
+cache (rows pulled from the live `paddle_tpu.ps.ShardedTable`).
+
+Minimal end-to-end::
+
+    from paddle_tpu.serving import fleet
+
+    reg = fleet.ModelRegistry()
+    reg.register("v1", model_dir_v1)
+    with fleet.ServingFleet(reg, "v1", replicas=3, mode="process") as f:
+        out, = f.infer({"x": rows})
+        reg.register("v2", model_dir_v2)
+        f.rollout("v2")            # zero requests dropped
+"""
+from .fleet import ServingFleet  # noqa: F401
+from .registry import ModelRegistry, ModelVersion  # noqa: F401
+from .replica import (ProcessReplica, ReplicaDeadError,  # noqa: F401
+                      ThreadReplica)
+from .router import FleetRouter, NoReplicaAvailableError  # noqa: F401
+
+__all__ = [
+    "FleetRouter", "ModelRegistry", "ModelVersion",
+    "NoReplicaAvailableError", "ProcessReplica", "ReplicaDeadError",
+    "ServingFleet", "ThreadReplica",
+]

@@ -1,0 +1,169 @@
+"""ServingFleet: registry + replicas + router, wired end to end.
+
+The deployment object a serving host runs: build N shared-nothing
+replicas (threads or subprocesses) for a registered model version, put
+the FleetRouter in front, and drive lifecycle operations against
+*versions*, never raw files:
+
+* ``rollout(version)`` — zero-downtime fleet-wide weight swap. One
+  replica at a time: background-warm the new version's executables
+  (`warmup()` + the persistent compile cache make this cheap), flip
+  atomically, drain the old server. The rest of the fleet keeps serving
+  throughout, so fleet capacity never drops below N-1 warm replicas and
+  no request is dropped.
+* ``ab_split(version_b, weight_b)`` — swap a subset of replicas to
+  version B and weight the router: weighted A/B between two live
+  versions.
+* ``submit()/infer()`` — the router's failover-wrapped request path.
+"""
+from __future__ import annotations
+
+import math
+import time
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..batcher import DEFAULT_BUCKETS
+from .registry import ModelRegistry
+from .replica import ProcessReplica, ThreadReplica
+from .router import FleetRouter
+
+__all__ = ["ServingFleet"]
+
+
+class ServingFleet:
+    def __init__(self, registry: ModelRegistry, version: Optional[str] = None,
+                 replicas: int = 3, mode: str = "thread",
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 policy: str = "least_outstanding", warm: bool = True,
+                 predictor_factory=None, example_feed=None,
+                 server_kwargs: Optional[dict] = None,
+                 env: Optional[dict] = None,
+                 health_interval_s: Optional[float] = None, seed: int = 0):
+        if replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        if mode not in ("thread", "process"):
+            raise ValueError(f"unknown fleet mode {mode!r}")
+        if mode == "process" and predictor_factory is not None:
+            raise ValueError("predictor_factory is thread-mode only (a "
+                             "subprocess builds its own predictor)")
+        self.registry = registry
+        version = version if version is not None else registry.latest()
+        if version is None:
+            raise ValueError("registry is empty — register a version first")
+        model = registry.resolve(version)
+        self.mode = mode
+        self._replicas: List = []
+        if mode == "thread":
+            for i in range(replicas):
+                self._replicas.append(ThreadReplica(
+                    f"replica-{i}", model, buckets=buckets,
+                    predictor_factory=predictor_factory, warm=warm,
+                    example_feed=example_feed, server_kwargs=server_kwargs))
+        else:
+            # spawn all workers first, then wait: startup cost is one
+            # worker's wall time, not N of them
+            for i in range(replicas):
+                self._replicas.append(ProcessReplica(
+                    f"replica-{i}", model, buckets=buckets, warm=warm,
+                    env=env, server_kwargs=server_kwargs))
+            for r in self._replicas:
+                r.wait_ready()
+        self.router = FleetRouter(self._replicas, policy=policy,
+                                  health_interval_s=health_interval_s,
+                                  seed=seed)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServingFleet":
+        self.router.start()
+        return self
+
+    def stop(self) -> dict:
+        self.router.close()
+        reports = {}
+        for r in self._replicas:
+            try:
+                reports[r.name] = r.stop()
+            except Exception as e:
+                reports[r.name] = {"error": str(e)[:200]}
+        return reports
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request path -------------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray],
+               timeout_ms: Optional[float] = None):
+        return self.router.submit(feed, timeout_ms=timeout_ms)
+
+    def infer(self, feed: Dict[str, np.ndarray],
+              timeout_ms: Optional[float] = None) -> List[np.ndarray]:
+        return self.router.infer(feed, timeout_ms=timeout_ms)
+
+    # -- version management -------------------------------------------------
+    @property
+    def replicas(self) -> List:
+        return list(self._replicas)
+
+    def versions_live(self) -> Dict[str, int]:
+        live: Dict[str, int] = {}
+        for r in self._replicas:
+            if r.alive:
+                live[r.version] = live.get(r.version, 0) + 1
+        return live
+
+    def rollout(self, version: str,
+                only: Optional[Sequence[str]] = None) -> dict:
+        """Swap every live replica (or the named subset) to `version`,
+        one at a time, each swap warm-then-flip-then-drain. Returns the
+        per-replica swap reports; a replica that died mid-rollout is
+        reported, not fatal (the rest of the fleet still converges)."""
+        model = self.registry.resolve(version)
+        t0 = time.monotonic()
+        reports = {}
+        names = set(only) if only is not None else None
+        for r in self._replicas:
+            if names is not None and r.name not in names:
+                continue
+            if not r.alive:
+                reports[r.name] = {"skipped": "replica dead"}
+                continue
+            try:
+                reports[r.name] = r.swap(model)
+            except Exception as e:
+                reports[r.name] = {"error": f"{type(e).__name__}: "
+                                            f"{str(e)[:200]}"}
+        # re-sweep now: replicas that looked draining mid-swap are
+        # eligible again the moment their new server answers healthy
+        self.router.sweep()
+        return {"version": version, "wall_ms": (time.monotonic() - t0) * 1e3,
+                "replicas": reports}
+
+    def ab_split(self, version_b: str, weight_b: float = 0.5,
+                 count: Optional[int] = None) -> dict:
+        """Weighted A/B: swap `count` replicas (default: the weighted
+        share, at least 1) to `version_b` and set router weights so
+        traffic splits `1-weight_b` / `weight_b` between the versions."""
+        if not 0.0 < weight_b < 1.0:
+            raise ValueError("weight_b must be in (0, 1)")
+        live = [r for r in self._replicas if r.alive]
+        if len(live) < 2:
+            raise ValueError("A/B needs at least 2 live replicas")
+        if count is None:
+            count = max(1, min(len(live) - 1,
+                               int(math.floor(weight_b * len(live) + 0.5))))
+        version_a = live[0].version
+        report = self.rollout(version_b,
+                              only=[r.name for r in live[-count:]])
+        self.router.set_version_weights(
+            {version_a: 1.0 - weight_b, version_b: weight_b})
+        return report
+
+    def stats(self) -> dict:
+        return {"mode": self.mode, "versions_live": self.versions_live(),
+                "router": self.router.stats()}

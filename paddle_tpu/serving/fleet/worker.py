@@ -1,0 +1,143 @@
+"""Fleet worker: one replica process = one InferenceServer + RPC loop.
+
+``python -m paddle_tpu.serving.fleet.worker --model-dir DIR`` builds the
+predictor, warms every (signature × bucket) executable, starts the
+InferenceServer, then serves the PS tier's length-prefixed JSON+blob
+frame protocol (paddle_tpu.ps.transport — pickle-free by construction)
+on a loopback port. It prints ``PDTPU_FLEET_WORKER_READY port=<p>`` on
+stdout once — and only once — traffic is safe, so the parent
+(`ProcessReplica`) never routes to a cold replica.
+
+Ops: ``infer`` (feed arrays → output arrays; user errors travel back as
+``{"err", "kind"}`` and are re-raised client-side), ``health`` (the
+server's /healthz view + state), ``swap`` (warm the new version in this
+process, atomic flip, drain the old server — the in-process half of
+zero-downtime rollout), ``ping``, ``stop`` (drain, reply with the drain
+report, exit).
+
+Thread-per-connection: concurrent parent connections land in the same
+InferenceServer queue, so dynamic batching still merges them.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+
+import numpy as np
+
+
+def _serve_conn(conn, replica, stop_evt):
+    from ...ps.transport import TransportError, _recv_msg, _send_msg
+    try:
+        while not stop_evt.is_set():
+            try:
+                msg = _recv_msg(conn)
+            except TransportError:
+                return  # peer went away / torn frame: drop the connection
+            op = msg.get("op") if isinstance(msg, dict) else None
+            try:
+                if op == "ping":
+                    reply = {"ok": True, "pid": os.getpid()}
+                elif op == "infer":
+                    feed = {k: np.asarray(v)
+                            for k, v in (msg.get("feed") or {}).items()}
+                    outs = replica.infer(feed,
+                                         timeout_ms=msg.get("timeout_ms"))
+                    reply = {"out": [np.asarray(o) for o in outs]}
+                elif op == "health":
+                    reply = replica.health()
+                elif op == "swap":
+                    from .registry import ModelVersion
+                    mv = ModelVersion(msg["version"], msg["model_dir"],
+                                      msg.get("precision"), {})
+                    reply = replica.swap(mv)
+                elif op == "stop":
+                    report = replica.stop()
+                    _send_msg(conn, {"ok": True, "report": report})
+                    stop_evt.set()
+                    return
+                else:
+                    reply = {"err": f"unknown op {op!r}", "kind": "ValueError"}
+            except Exception as e:
+                reply = {"err": str(e)[:500], "kind": type(e).__name__}
+            _send_msg(conn, reply)
+    except OSError:
+        pass
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--version", default="v0")
+    ap.add_argument("--precision", default=None)
+    ap.add_argument("--buckets", default="1,2,4,8,16,32")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--max-queue-size", type=int, default=256)
+    ap.add_argument("--max-batch-delay-ms", type=float, default=2.0)
+    ap.add_argument("--num-workers", type=int, default=1)
+    ap.add_argument("--no-warm", action="store_true")
+    args = ap.parse_args(argv)
+
+    from .registry import ModelVersion
+    from .replica import ThreadReplica
+
+    model = ModelVersion(args.version, args.model_dir, args.precision, {})
+    replica = ThreadReplica(
+        f"worker-{os.getpid()}", model,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        warm=not args.no_warm,
+        server_kwargs={"max_queue_size": args.max_queue_size,
+                       "max_batch_delay_ms": args.max_batch_delay_ms,
+                       "num_workers": args.num_workers})
+
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((args.host, args.port))
+    lsock.listen(64)
+    lsock.settimeout(0.25)
+    stop_evt = threading.Event()
+
+    def on_term(signum, frame):
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    # the readiness line the parent blocks on — executables are compiled,
+    # the server is started, the port is bound
+    print(f"PDTPU_FLEET_WORKER_READY port={lsock.getsockname()[1]}",
+          flush=True)
+
+    conns = []
+    try:
+        while not stop_evt.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=_serve_conn,
+                                 args=(conn, replica, stop_evt), daemon=True)
+            t.start()
+            conns.append(t)
+    finally:
+        lsock.close()
+        if replica.alive:
+            replica.stop()  # SIGTERM path: drain before exiting
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,7 @@ already admitted.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -115,7 +116,13 @@ class InferenceServer:
         self._started = False
         self._closed = False
         self._draining = False
+        self._inflight: set = set()   # popped from queue, future unresolved
+        self._stop_lock = threading.Lock()
+        self._stop_report: Optional[dict] = None
         self._health_names: List[str] = []
+        self._health_fns = [("queue", self._check_queue),
+                            ("deadlines", self._check_deadlines),
+                            ("workers", self._check_workers)]
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "InferenceServer":
@@ -137,76 +144,145 @@ class InferenceServer:
         maybe_serve_from_env()
         return self
 
-    def stop(self, drain: bool = True) -> None:
+    def stop(self, drain: bool = True,
+             grace_ms: Optional[float] = None) -> dict:
         """Refuse new submissions; with drain=True (default) every already
         admitted request is still served before the workers exit, with
-        drain=False pending requests are failed with ServerClosedError."""
+        drain=False pending requests are failed with ServerClosedError.
+
+        `grace_ms` (default `PDTPU_SERVE_DRAIN_GRACE_MS`, 0) keeps the
+        queue OPEN for that long while `/healthz` already reports the
+        degraded `draining` state — a router polling health stops sending
+        new work before admission actually closes, so a cooperative fleet
+        drains without a single rejected submit.
+
+        Returns the drain report for the requests that were in flight
+        (admitted but unresolved) at stop time:
+        ``{"pending": n, "completed": served_ok, "rejected": failed}``.
+        Idempotent — a second stop() returns the first report.
+        """
+        with self._stop_lock:
+            if self._stop_report is not None:
+                return dict(self._stop_report)
+            with self._cond:
+                self._draining = True
+                self._cond.notify_all()
+            if grace_ms is None:
+                grace_ms = float(
+                    os.environ.get("PDTPU_SERVE_DRAIN_GRACE_MS", "0"))
+            if grace_ms > 0:
+                time.sleep(grace_ms / 1e3)
+            with self._cond:
+                self._closed = True
+                pending = list(self._queue) + list(self._inflight)
+                # a never-started server has no workers to drain the queue
+                if not drain or not self._started:
+                    while self._queue:
+                        r = self._queue.popleft()
+                        if not r.future.done():
+                            r.future.set_exception(ServerClosedError(
+                                "server stopped without drain"))
+                self.metrics.gauge("serving/queue_depth").set(len(self._queue))
+                self._cond.notify_all()
+            for t in self._workers:
+                t.join()
+            self._workers = []
+            completed = sum(1 for r in pending
+                            if r.future.done() and r.future.exception() is None)
+            report = {"pending": len(pending), "completed": completed,
+                      "rejected": len(pending) - completed}
+            with self._cond:
+                self._draining = False
+                self._stop_report = report
+            for name in self._health_names:
+                unregister_health_check(name)
+            self._health_names = []
+            return dict(report)
+
+    @property
+    def state(self) -> str:
+        """'idle' | 'serving' | 'draining' | 'stopped' — routers key on
+        'draining' to stop sending new work before the queue closes."""
         with self._cond:
-            self._closed = True
-            self._draining = drain
-            if not drain:
-                while self._queue:
-                    r = self._queue.popleft()
-                    if not r.future.done():
-                        r.future.set_exception(
-                            ServerClosedError("server stopped without drain"))
-            self.metrics.gauge("serving/queue_depth").set(len(self._queue))
-            self._cond.notify_all()
-        for t in self._workers:
-            t.join()
-        self._workers = []
-        for name in self._health_names:
-            unregister_health_check(name)
-        self._health_names = []
+            if self._stop_report is not None:
+                return "stopped"
+            if self._draining:
+                return "draining"
+            if self._closed:
+                return "stopped"
+            return "serving" if self._started else "idle"
 
     # -- health checks (served at /healthz) --------------------------------
+    def _check_queue(self):
+        with self._cond:
+            depth, cap = len(self._queue), self.max_queue_size
+        if depth >= cap:
+            return ("degraded",
+                    f"queue full ({depth}/{cap}) — shedding load")
+        if depth >= 0.8 * cap:
+            return ("degraded", f"queue {depth}/{cap} (>= 80% full)")
+        return ("ok", f"queue {depth}/{cap}")
+
+    def _check_deadlines(self):
+        req = self.metrics.counter("serving/requests").value
+        missed = self.metrics.counter("serving/timeouts").value
+        rate = missed / req if req else 0.0
+        detail = f"{missed}/{req} requests missed their deadline"
+        if rate > 0.5:
+            return ("failing", detail)
+        if rate > 0.05:
+            return ("degraded", detail)
+        return ("ok", detail)
+
+    def _check_workers(self):
+        with self._cond:
+            started, closed, draining = (self._started, self._closed,
+                                         self._draining)
+        workers = list(self._workers)
+        if draining:
+            # degraded, not failing: admitted work is still being served —
+            # a router should deprioritize, not declare the replica dead
+            return ("degraded",
+                    "draining — serving admitted requests, "
+                    + ("admission closing soon" if not closed
+                       else "admission closed"))
+        if closed:
+            return ("degraded", "server stopped")
+        if not started:
+            return ("degraded", "server not started")
+        dead = sum(1 for t in workers if not t.is_alive())
+        if dead:
+            return ("failing",
+                    f"{dead}/{len(workers)} serve workers dead — "
+                    f"dispatch is stalled")
+        return ("ok", f"{len(workers)} serve workers alive")
+
     def _register_health_checks(self) -> None:
         with _server_seq_lock:
             _server_seq[0] += 1
             seq = _server_seq[0]
         prefix = "serving" if seq == 1 else f"serving#{seq}"
-
-        def check_queue():
-            with self._cond:
-                depth, cap = len(self._queue), self.max_queue_size
-            if depth >= cap:
-                return ("degraded",
-                        f"queue full ({depth}/{cap}) — shedding load")
-            if depth >= 0.8 * cap:
-                return ("degraded", f"queue {depth}/{cap} (>= 80% full)")
-            return ("ok", f"queue {depth}/{cap}")
-
-        def check_deadlines():
-            req = self.metrics.counter("serving/requests").value
-            missed = self.metrics.counter("serving/timeouts").value
-            rate = missed / req if req else 0.0
-            detail = f"{missed}/{req} requests missed their deadline"
-            if rate > 0.5:
-                return ("failing", detail)
-            if rate > 0.05:
-                return ("degraded", detail)
-            return ("ok", detail)
-
-        def check_workers():
-            with self._cond:
-                started, closed = self._started, self._closed
-            workers = list(self._workers)
-            if closed:
-                return ("degraded", "server stopped")
-            if not started:
-                return ("degraded", "server not started")
-            dead = sum(1 for t in workers if not t.is_alive())
-            if dead:
-                return ("failing",
-                        f"{dead}/{len(workers)} serve workers dead — "
-                        f"dispatch is stalled")
-            return ("ok", f"{len(workers)} serve workers alive")
-
-        for name, fn in ((f"{prefix}/queue", check_queue),
-                         (f"{prefix}/deadlines", check_deadlines),
-                         (f"{prefix}/workers", check_workers)):
+        for short, fn in self._health_fns:
+            name = f"{prefix}/{short}"
             register_health_check(name, fn)
             self._health_names.append(name)
+
+    def health(self) -> dict:
+        """This server's own /healthz view (no global registry involved):
+        ``{"status": worst, "state": ..., "checks": {name: {status,
+        detail}}}`` — what a fleet router polls per replica."""
+        order = {"ok": 0, "degraded": 1, "failing": 2}
+        checks = {}
+        worst = "ok"
+        for short, fn in self._health_fns:
+            try:
+                status, detail = fn()
+            except Exception as e:  # a broken check is itself a failure
+                status, detail = "failing", f"check raised: {e!r}"
+            checks[short] = {"status": status, "detail": detail}
+            if order.get(status, 2) > order[worst]:
+                worst = status
+        return {"status": worst, "state": self.state, "checks": checks}
 
     def __enter__(self) -> "InferenceServer":
         return self.start()
@@ -338,8 +414,14 @@ class InferenceServer:
                     live.append(r)
             if not live:
                 continue
+            with self._cond:
+                self._inflight.update(live)
             t0 = time.monotonic()
-            batcher.dispatch(live)
+            try:
+                batcher.dispatch(live)
+            finally:
+                with self._cond:
+                    self._inflight.difference_update(live)
             done = time.monotonic()
             lat = self.metrics.histogram("serving/latency_ms")
             wait = self.metrics.histogram("serving/queue_wait_ms")

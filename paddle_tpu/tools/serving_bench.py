@@ -43,7 +43,7 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["build_predictor", "bench_sequential", "bench_served",
-           "percentile_row", "main"]
+           "bench_fleet", "percentile_row", "main"]
 
 
 def build_predictor(model_dir: Optional[str] = None, in_dim: int = 512,
@@ -159,6 +159,62 @@ def bench_served(predictor, rows: List[np.ndarray], concurrency: int = 32,
     return out
 
 
+def bench_fleet(model_dir: str, rows: List[np.ndarray], replicas: int = 3,
+                concurrency: int = 32, buckets=(1, 2, 4, 8, 16, 32),
+                batch_delay_ms: float = 2.0, mode: str = "thread",
+                env=None) -> dict:
+    """Closed-loop drive of a ServingFleet: `concurrency` client threads
+    racing the request list through the router (least-outstanding). The
+    multi-replica analog of bench_served — same latency accounting, so
+    the 1-vs-N rows compare directly."""
+    from paddle_tpu.serving import fleet as fleet_mod
+
+    reg = fleet_mod.ModelRegistry()
+    reg.register("bench-v1", model_dir)
+    fl = fleet_mod.ServingFleet(
+        reg, "bench-v1", replicas=replicas, mode=mode, buckets=buckets,
+        env=env,
+        server_kwargs={"max_batch_delay_ms": batch_delay_ms,
+                       "max_queue_size": max(len(rows), 1024)})
+    lats = [0.0] * len(rows)
+    errors = [0]
+    with fl:
+        t0 = time.monotonic()
+        it = iter(list(enumerate(rows)))
+        lock = threading.Lock()
+
+        def drive():
+            while True:
+                with lock:
+                    nxt = next(it, None)
+                if nxt is None:
+                    return
+                i, r = nxt
+                s = time.monotonic()
+                try:
+                    fl.infer({"x": r})
+                    lats[i] = (time.monotonic() - s) * 1e3
+                except Exception:
+                    errors[0] += 1
+
+        threads = [threading.Thread(target=drive)
+                   for _ in range(max(1, concurrency))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        stats = fl.stats()
+    out = _summarize(f"fleet(n={replicas},c={concurrency})",
+                     len(rows) - errors[0], wall,
+                     [x for x in lats if x > 0])
+    out["errors"] = errors[0]
+    out["replicas"] = replicas
+    out["fleet"] = {"mode": stats["mode"],
+                    "metrics": stats["router"]["metrics"]}
+    return out
+
+
 def _summarize(mode: str, n: int, wall: float, lats: List[float]) -> dict:
     arr = np.asarray(sorted(lats)) if lats else np.asarray([0.0])
 
@@ -193,6 +249,16 @@ def main(argv=None) -> int:
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--skip-sequential", action="store_true")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="also closed-loop a ServingFleet of N replicas "
+                         "behind the router (1 = single-server only)")
+    ap.add_argument("--fleet-mode", choices=("thread", "process"),
+                    default="thread",
+                    help="fleet replica isolation for --replicas")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="p99 latency SLO gate: exit 2 if the headline "
+                         "mode (fleet with --replicas > 1, else served) "
+                         "exceeds it or saw any request error")
     ap.add_argument("--metrics-out", default=None,
                     help="dump the unified observability Registry "
                          "snapshot (serving + executor metrics) as JSON")
@@ -212,8 +278,9 @@ def main(argv=None) -> int:
     n = (args.requests if args.qps <= 0
          else max(1, int(args.qps * args.duration)))
     rows = _gen_rows(n, args.in_dim, args.seed)
-    pred = build_predictor(in_dim=args.in_dim, hidden=args.hidden,
-                           layers=args.layers)
+    model_dir = tempfile.mkdtemp(prefix="serving_bench_")
+    pred = build_predictor(model_dir=model_dir, in_dim=args.in_dim,
+                           hidden=args.hidden, layers=args.layers)
 
     header = (f"{'mode':<18}{'reqs':>6}{'wall_s':>9}{'rps':>12}"
               f"{'mean_ms':>10}{'p50_ms':>10}{'p95_ms':>10}{'p99_ms':>10}")
@@ -238,6 +305,13 @@ def main(argv=None) -> int:
     if scraper is not None:
         scraper.join(timeout=10)
     print(percentile_row(served))
+    flt = None
+    if args.replicas > 1:
+        flt = bench_fleet(model_dir, rows, replicas=args.replicas,
+                          concurrency=args.concurrency, buckets=buckets,
+                          batch_delay_ms=args.batch_delay_ms,
+                          mode=args.fleet_mode)
+        print(percentile_row(flt))
     print()
     bs = served["metrics"].get("serving/batch_rows") or {}
     print(f"batches={served['metrics'].get('serving/batches', 0)} "
@@ -280,6 +354,15 @@ def main(argv=None) -> int:
         if served["throughput_rps"] <= seq["throughput_rps"]:
             print("FAIL: dynamic batching did not beat sequential")
             return 1
+    if args.slo_p99_ms is not None:
+        head = flt if flt is not None else served
+        breached = (head["p99_ms"] > args.slo_p99_ms
+                    or head.get("errors", 0) > 0)
+        print(f"SLO p99 <= {args.slo_p99_ms:g}ms on {head['mode']}: "
+              f"p99={head['p99_ms']:.2f}ms errors={head.get('errors', 0)} "
+              f"-> {'FAIL' if breached else 'ok'}")
+        if breached:
+            return 2
     return 0
 
 

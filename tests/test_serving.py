@@ -166,6 +166,87 @@ def test_stop_without_drain_fails_pending(predictor):
         fut.result(timeout=5)
 
 
+def test_stop_reports_completed_vs_rejected(predictor):
+    """stop() returns the drain accounting: everything admitted completes
+    under drain=True; drain=False rejects the queue — and the report is
+    idempotent on repeat stops."""
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=BUCKETS)
+    server.start()
+    futs = [server.submit({"x": _rows(2, seed=i)}) for i in range(8)]
+    report = server.stop()  # drain=True default
+    assert report["completed"] == report["pending"]
+    assert report["rejected"] == 0
+    assert all(f.done() and f.exception() is None for f in futs)
+    assert server.stop() == report  # second stop: same report, no work
+    assert server.state == "stopped"
+
+    server2 = serving.InferenceServer(predictor, buckets=BUCKETS)
+    for i in range(3):
+        server2.submit({"x": _rows(1, seed=i)})
+    report2 = server2.stop(drain=False)
+    assert report2 == {"pending": 3, "completed": 0, "rejected": 3}
+
+
+def test_draining_shows_degraded_on_healthz(predictor):
+    """During the stop(drain=True) grace window /healthz reports
+    degraded (state 'draining'), not failing — the router signal that
+    says 'finish what you sent, send nothing new'."""
+    import threading
+
+    from paddle_tpu import serving
+
+    server = serving.InferenceServer(predictor, buckets=BUCKETS)
+    server.start()
+    assert server.health()["status"] == "ok"
+    assert server.state in ("idle", "serving")
+    seen = {}
+    t = threading.Thread(target=lambda: seen.setdefault(
+        "report", server.stop(grace_ms=300)))
+    t.start()
+    time.sleep(0.1)  # inside the grace window
+    h = server.health()
+    assert h["state"] == "draining"
+    assert h["status"] == "degraded"
+    assert any("draining" in c["detail"] for c in h["checks"].values())
+    # admission stays open during the grace window
+    fut = server.submit({"x": _rows(1)})
+    t.join()
+    assert fut.result(timeout=5)[0].shape == (1, CLASSES)
+    assert seen["report"]["rejected"] == 0
+    # once stopped the state flips: this is what a router ejects on
+    assert server.health()["state"] == "stopped"
+
+
+# -- precision knob -------------------------------------------------------
+
+def test_predictor_bf16_parity(predictor, tmp_path_factory):
+    """precision='bf16' serves from a bf16-cast state within loose
+    tolerance of the f32 predictor; aliases resolve; junk raises."""
+    from paddle_tpu import inference
+
+    cfg = predictor._config
+    bf = inference.create_predictor(cfg, precision="bf16")
+    import jax.numpy as jnp
+    assert all(v.dtype == jnp.bfloat16 for v in bf._state.values())
+    x = _rows(4, seed=5)
+    ref = predictor.run({"x": x})[0]
+    got = np.asarray(bf.run({"x": x})[0], np.float32)
+    assert got.shape == ref.shape
+    # bf16 has ~3 decimal digits; softmax outputs live in [0, 1]
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.02)
+    # clone keeps the precision
+    assert bf.clone()._precision == bf._precision
+    # aliases all land on the two canonical dtypes
+    assert inference.create_predictor(cfg, precision="float32")._precision \
+        == inference.PrecisionType.Float32
+    assert inference.create_predictor(cfg, precision="half")._precision \
+        == inference.PrecisionType.Bfloat16
+    with pytest.raises(ValueError, match="unknown precision"):
+        inference.create_predictor(cfg, precision="int3")
+
+
 # -- warmup ---------------------------------------------------------------
 
 def test_warmup_compiles_all_buckets(predictor):

@@ -1,0 +1,659 @@
+"""paddle_tpu.serving.fleet: replica scale-out acceptance surface.
+
+Covers the fleet contract end to end on the CPU backend: registry
+validation + checkpoint lineage gating, request routing and spread
+across thread replicas, failover replay when a replica dies mid-flight
+(thread kill and real subprocess SIGKILL), health-sweep eject/re-admit,
+zero-downtime rollout under closed-loop load, weighted A/B between two
+live versions, PS-backed CTR serving that is bitwise identical to the
+local-table Predictor while each replica holds only its row cache, and
+the serving_bench SLO gate's exit code.
+"""
+import os
+import threading
+import time
+
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+IN_DIM = 6
+CLASSES = 4
+BUCKETS = (1, 2, 4)
+
+
+def _save_mlp(model_dir, seed):
+    """One tiny MLP inference model dir; `seed` picks its weights, so two
+    saves give two observably different versions."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.scope import global_scope
+
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        h = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.fc(h, CLASSES, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sc = global_scope()
+        rng = np.random.RandomState(seed)
+        for n in sc.var_names():
+            v = np.asarray(sc.find_var(n))
+            if v.dtype == np.float32:
+                sc.set_var(n, jnp.asarray(
+                    rng.uniform(-0.5, 0.5, v.shape).astype(np.float32)))
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+    return model_dir
+
+
+@pytest.fixture(scope="module")
+def two_models(tmp_path_factory):
+    """v1/v2 model dirs + their reference predictors (ground truth for
+    'which version served this request')."""
+    from paddle_tpu import inference
+    from paddle_tpu.core import program as prog_mod
+    from paddle_tpu.core import scope as scope_mod
+
+    old = (prog_mod._main_program, prog_mod._startup_program,
+           scope_mod._global_scope, scope_mod._current_scope)
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    scope_mod._global_scope = scope_mod.Scope()
+    scope_mod._current_scope = scope_mod._global_scope
+    try:
+        root = tmp_path_factory.mktemp("fleet_models")
+        d1 = _save_mlp(str(root / "v1"), seed=1)
+        d2 = _save_mlp(str(root / "v2"), seed=2)
+        return {
+            "v1": d1, "v2": d2,
+            "ref1": inference.create_predictor(inference.Config(d1)),
+            "ref2": inference.create_predictor(inference.Config(d2)),
+        }
+    finally:
+        (prog_mod._main_program, prog_mod._startup_program,
+         scope_mod._global_scope, scope_mod._current_scope) = old
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).rand(n, IN_DIM).astype(np.float32)
+
+
+def _matches(out, ref):
+    return out.shape == ref.shape and np.allclose(out, ref,
+                                                  rtol=1e-5, atol=1e-6)
+
+
+# -- registry -------------------------------------------------------------
+
+def test_registry_basics(two_models, tmp_path):
+    from paddle_tpu.serving import fleet
+
+    reg = fleet.ModelRegistry()
+    mv = reg.register("v1", two_models["v1"], precision="f32", note="first")
+    assert mv.meta["note"] == "first"
+    reg.register("v2", two_models["v2"])
+    assert reg.versions() == ["v1", "v2"]
+    assert reg.latest() == "v2"
+    assert "v1" in reg and len(reg) == 2
+    assert reg.resolve("v1").model_dir == two_models["v1"]
+    # versions are immutable
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("v1", two_models["v2"])
+    with pytest.raises(KeyError, match="unknown version"):
+        reg.resolve("v9")
+    # a version must be a real inference-model dir
+    with pytest.raises(ValueError, match="does not exist"):
+        reg.register("bad", str(tmp_path / "nope"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="__model__"):
+        reg.register("bad", str(empty))
+
+
+def test_registry_checkpoint_lineage(two_models, tmp_path):
+    """Only verified training checkpoints can be promoted to serving: a
+    corrupted step disappears from verified_steps() and register(step=)
+    refuses it."""
+    import json
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import Checkpointer
+    from paddle_tpu.serving import fleet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        y = fluid.layers.fc(x, CLASSES)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ck = Checkpointer(str(tmp_path / "ck"))
+        ck.save(1, program=main)
+        ck.save(2, program=main)
+        ck.wait()
+    assert sorted(ck.verified_steps()) == [1, 2]
+
+    # corrupt one file that step 2's manifest lists
+    ckdir = tmp_path / "ck"
+    manifest = next(f for f in os.listdir(ckdir)
+                    if f.startswith("ckpt-2.manifest-"))
+    with open(ckdir / manifest) as f:
+        victim = sorted(json.load(f)["files"])[0]
+    with open(ckdir / victim, "ab") as f:
+        f.write(b"\0torn")
+    assert ck.verified_steps() == [1]
+
+    reg = fleet.ModelRegistry()
+    mv = reg.register("good", two_models["v1"], checkpointer=ck)
+    assert mv.meta["checkpoint_step"] == 1  # newest *verified*, not 2
+    with pytest.raises(ValueError, match="not verified"):
+        reg.register("bad", two_models["v1"], checkpointer=ck, step=2)
+
+
+# -- thread fleet: routing, failover, rollout, A/B ------------------------
+
+def _fleet(two_models, version="v1", n=3, **kw):
+    from paddle_tpu.serving import fleet
+
+    reg = fleet.ModelRegistry()
+    reg.register("v1", two_models["v1"])
+    reg.register("v2", two_models["v2"])
+    kw.setdefault("server_kwargs", {"max_batch_delay_ms": 1.0})
+    kw.setdefault("health_interval_s", 0.1)
+    return fleet.ServingFleet(reg, version, replicas=n, buckets=BUCKETS,
+                              **kw)
+
+
+def test_thread_fleet_routes_and_spreads(two_models):
+    """N=3 replicas serve correct results and round-robin actually
+    spreads requests across every replica."""
+    fl = _fleet(two_models, policy="round_robin")
+    feeds = [_rows(1 + i % 3, seed=i) for i in range(12)]
+    refs = [two_models["ref1"].run({"x": f})[0] for f in feeds]
+    with fl:
+        outs = [fl.infer({"x": f})[0] for f in feeds]
+        served = [r._server.metrics.snapshot()["serving/requests"]
+                  for r in fl.replicas]
+    for got, ref in zip(outs, refs):
+        assert _matches(got, ref)
+    assert sum(served) == 12
+    assert all(c >= 1 for c in served), served
+
+
+def test_thread_fleet_survives_replica_kill(two_models):
+    """Killing one replica mid-traffic: later requests keep succeeding,
+    the health sweep ejects the corpse, stats say so."""
+    fl = _fleet(two_models)
+    with fl:
+        assert _matches(fl.infer({"x": _rows(2)})[0],
+                        two_models["ref1"].run({"x": _rows(2)})[0])
+        victim = fl.replicas[1]
+        victim.kill()
+        for i in range(10):
+            f = _rows(1 + i % 3, seed=50 + i)
+            assert _matches(fl.infer({"x": f})[0],
+                            two_models["ref1"].run({"x": f})[0])
+        fl.router.sweep()
+        st = fl.router.stats()
+        assert st["replicas"][victim.name]["ejected"]
+        assert not st["replicas"][victim.name]["alive"]
+        assert st["metrics"]["fleet/ejections"] >= 1
+        assert fl.versions_live() == {"v1": 2}
+
+
+def test_rollout_under_load_drops_nothing(two_models):
+    """Satellite: zero-downtime weight swap under closed-loop load — no
+    client-visible error, every response is exactly v1's or v2's output,
+    every drained server rejected nothing, and after the rollout the
+    fleet serves only v2."""
+    fl = _fleet(two_models)
+    feeds = [_rows(1 + i % 4, seed=100 + i) for i in range(6)]
+    refs1 = [two_models["ref1"].run({"x": f})[0] for f in feeds]
+    refs2 = [two_models["ref2"].run({"x": f})[0] for f in feeds]
+    # the two versions must be distinguishable for this test to prove
+    # anything
+    assert not _matches(refs1[0], refs2[0])
+
+    errors, mismatches = [], []
+    done = threading.Event()
+
+    def client(k):
+        i = 0
+        while not done.is_set():
+            j = (k + i) % len(feeds)
+            try:
+                out = fl.infer({"x": feeds[j]})[0]
+            except Exception as e:  # any client-visible error fails the test
+                errors.append(repr(e))
+                return
+            if not (_matches(out, refs1[j]) or _matches(out, refs2[j])):
+                mismatches.append(j)
+            i += 1
+
+    with fl:
+        clients = [threading.Thread(target=client, args=(k,))
+                   for k in range(4)]
+        for t in clients:
+            t.start()
+        time.sleep(0.1)  # load is flowing
+        report = fl.rollout("v2")
+        time.sleep(0.1)  # keep hammering the post-swap fleet
+        done.set()
+        for t in clients:
+            t.join()
+        assert errors == []
+        assert mismatches == []
+        for name, rep in report["replicas"].items():
+            assert rep["version"] == "v2", (name, rep)
+            assert rep["drained"]["rejected"] == 0, (name, rep)
+        assert fl.versions_live() == {"v2": 3}
+        # post-rollout traffic is v2 only
+        for j, f in enumerate(feeds):
+            assert _matches(fl.infer({"x": f})[0], refs2[j])
+
+
+def test_ab_split_serves_both_versions(two_models):
+    """ab_split swaps a share of replicas to B and the weighted router
+    actually serves both versions."""
+    fl = _fleet(two_models, policy="round_robin")
+    f = _rows(2, seed=7)
+    ref1 = two_models["ref1"].run({"x": f})[0]
+    ref2 = two_models["ref2"].run({"x": f})[0]
+    with fl:
+        rep = fl.ab_split("v2", weight_b=0.5, count=1)
+        assert all("error" not in r for r in rep["replicas"].values())
+        assert fl.versions_live() == {"v1": 2, "v2": 1}
+        hits = {"v1": 0, "v2": 0}
+        for _ in range(40):
+            out = fl.infer({"x": f})[0]
+            if _matches(out, ref1):
+                hits["v1"] += 1
+            elif _matches(out, ref2):
+                hits["v2"] += 1
+            else:
+                pytest.fail("output matches neither version")
+        # 50/50 weights over 40 requests: both arms must be visibly live
+        assert hits["v1"] >= 5 and hits["v2"] >= 5, hits
+        fl.router.set_version_weights(None)
+
+
+# -- router unit surface (fake replicas: controllable health/failures) ----
+
+class _FakeReplica:
+    def __init__(self, name, version="v1"):
+        self.name = name
+        self.version = version
+        self.alive = True
+        self.outstanding = 0
+        self.submits = 0
+        self.raise_on_submit = None
+        self.fail_future_with = None
+        self._health = {"status": "ok", "state": "serving", "checks": {}}
+
+    def set_health(self, status, state):
+        self._health = {"status": status, "state": state, "checks": {}}
+
+    def health(self):
+        return dict(self._health)
+
+    def submit(self, feed, timeout_ms=None):
+        self.submits += 1
+        if self.raise_on_submit is not None:
+            raise self.raise_on_submit
+        fut = Future()
+        if self.fail_future_with is not None:
+            fut.set_exception(self.fail_future_with)
+        else:
+            fut.set_result([self.name])
+        return fut
+
+
+def _router(*replicas, **kw):
+    from paddle_tpu.serving.fleet import FleetRouter
+    from paddle_tpu.serving.metrics import Metrics
+
+    kw.setdefault("metrics", Metrics(attach=False))
+    return FleetRouter(replicas, **kw)
+
+
+def test_router_eject_and_readmit():
+    """failing → ejected; healthy again → re-admitted (counters track
+    both); draining → out of rotation WITHOUT an ejection."""
+    a, b = _FakeReplica("a"), _FakeReplica("b")
+    rt = _router(a, b)
+    b.set_health("failing", "serving")
+    rt.sweep()
+    st = rt.stats()["replicas"]
+    assert st["b"]["ejected"] and not st["b"]["eligible"]
+    assert rt.metrics.counter("fleet/ejections").value == 1
+    assert [rt.infer({})[0] for _ in range(3)] == ["a", "a", "a"]
+
+    b.set_health("ok", "serving")
+    rt.sweep()
+    st = rt.stats()["replicas"]
+    assert not st["b"]["ejected"] and st["b"]["eligible"]
+    assert rt.metrics.counter("fleet/readmissions").value == 1
+
+    b.set_health("degraded", "draining")
+    rt.sweep()
+    st = rt.stats()["replicas"]
+    assert not st["b"]["eligible"] and not st["b"]["ejected"]
+    assert rt.metrics.counter("fleet/ejections").value == 1  # unchanged
+
+
+def test_router_deprioritizes_degraded():
+    """A degraded replica receives traffic only when no healthy replica
+    is eligible."""
+    a, b = _FakeReplica("a"), _FakeReplica("b")
+    rt = _router(a, b)
+    a.set_health("degraded", "serving")
+    rt.sweep()
+    assert [rt.infer({})[0] for _ in range(5)] == ["b"] * 5
+    b.set_health("failing", "serving")
+    rt.sweep()
+    assert rt.infer({})[0] == "a"  # degraded beats nothing
+
+
+def test_router_failover_replays_on_other_replica():
+    """Sync raise and async future-failure both replay the request on a
+    different replica; the dead one is suspected immediately."""
+    from paddle_tpu.serving.fleet import ReplicaDeadError
+    from paddle_tpu.ps.transport import TransportError
+
+    a, b = _FakeReplica("a"), _FakeReplica("b")
+    rt = _router(a, b, policy="round_robin")
+    a.raise_on_submit = ReplicaDeadError("gone")
+    b.fail_future_with = None
+    outs = {rt.infer({})[0] for _ in range(4)}
+    assert outs == {"b"}
+    assert rt.metrics.counter("fleet/retries").value >= 1
+    assert not rt.stats()["replicas"]["a"]["eligible"]  # suspected
+
+    # async: the replica accepted the request, then died under it
+    a.raise_on_submit = None
+    b.fail_future_with = TransportError("conn reset", transient=True)
+    rt.sweep()  # re-admit a
+    assert rt.infer({})[0] == "a"
+
+
+def test_router_surfaces_non_replica_errors_and_exhaustion():
+    from paddle_tpu.serving import QueueFullError
+    from paddle_tpu.serving.fleet import NoReplicaAvailableError
+
+    a, b = _FakeReplica("a"), _FakeReplica("b")
+    rt = _router(a, b)
+    # a bad feed is the caller's bug: no replay
+    a.fail_future_with = ValueError("bad feed")
+    b.fail_future_with = ValueError("bad feed")
+    with pytest.raises(ValueError, match="bad feed"):
+        rt.infer({})
+    assert rt.metrics.counter("fleet/retries").value == 0
+
+    # every replica full -> backpressure surfaces as QueueFullError
+    a.fail_future_with = b.fail_future_with = None
+    a.raise_on_submit = QueueFullError("full")
+    b.raise_on_submit = QueueFullError("full")
+    with pytest.raises(QueueFullError):
+        rt.infer({})
+    st = rt.stats()["replicas"]
+    assert st["a"]["eligible"] and st["b"]["eligible"]  # full != dead
+
+    # everything ejected -> NoReplicaAvailableError
+    a.set_health("failing", "dead")
+    b.set_health("failing", "dead")
+    rt.sweep()
+    with pytest.raises(NoReplicaAvailableError):
+        rt.infer({})
+
+
+# -- process fleet: the SIGKILL acceptance drill --------------------------
+
+def test_process_fleet_sigkill_failover(two_models, xla_8dev_subprocess_env):
+    """Acceptance: N=3 subprocess replicas under closed-loop load, one
+    SIGKILLed mid-flight — zero client-visible errors, every response is
+    correct, the corpse is ejected."""
+    fl = _fleet(two_models, mode="process", env=xla_8dev_subprocess_env,
+                server_kwargs={"max_batch_delay_ms": 1.0})
+    feeds = [_rows(1 + i % 2, seed=200 + i) for i in range(4)]
+    refs = [two_models["ref1"].run({"x": f})[0] for f in feeds]
+    errors, bad = [], []
+
+    def client(k):
+        for i in range(8):
+            j = (k + i) % len(feeds)
+            try:
+                out = fl.infer({"x": feeds[j]})[0]
+            except Exception as e:
+                errors.append(repr(e))
+                return
+            if not _matches(out, refs[j]):
+                bad.append(j)
+
+    with fl:
+        victim = fl.replicas[1]
+        clients = [threading.Thread(target=client, args=(k,))
+                   for k in range(3)]
+        for t in clients:
+            t.start()
+        time.sleep(0.05)
+        victim.kill()  # real SIGKILL: in-flight RPCs die with the worker
+        for t in clients:
+            t.join()
+        assert errors == [], errors
+        assert bad == []
+        fl.router.sweep()
+        st = fl.router.stats()
+        assert not st["replicas"][victim.name]["alive"]
+        assert st["replicas"][victim.name]["ejected"]
+        assert fl.versions_live() == {"v1": 2}
+        # survivors still serve
+        assert _matches(fl.infer({"x": feeds[0]})[0], refs[0])
+
+
+# -- PS-backed CTR serving ------------------------------------------------
+
+V, D, MULT, F, CAP = 512, 4, 2, 3, 24
+
+
+def _save_ctr(model_dir, vocab_rows, packed=None, dense=None):
+    """CTR model over a packed embedding table: save with the full table
+    (`packed`) or as the cache-sized serving copy reusing `dense`."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.initializer import RowPackInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [F], dtype="int64")
+        emb = layers.embedding(
+            ids, [vocab_rows, D * MULT], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb", initializer=RowPackInitializer(
+                D, D * MULT, -1.0, 1.0)))
+        emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+        r = layers.reshape(emb, [-1, F * D])
+        out = layers.fc(r, CLASSES, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sc = global_scope()
+        if packed is not None:
+            sc.set_var("tb", jnp.asarray(packed))
+            dense = {n: np.asarray(sc.find_var(n))
+                     for n in sc.var_names()
+                     if n != "tb"
+                     and np.asarray(sc.find_var(n)).dtype == np.float32}
+        else:
+            for n, v in dense.items():
+                sc.set_var(n, jnp.asarray(v))
+            sc.set_var("tb", jnp.zeros((vocab_rows, 128), jnp.uint16))
+        fluid.io.save_inference_model(model_dir, ["ids"], [out], exe, main)
+    return dense
+
+
+def _packed_table():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.deferred_rows import pack_rows
+
+    vis = np.random.RandomState(7).uniform(-1, 1, (V, D)).astype("float32")
+    rows = np.zeros((V, D * MULT), "float32")
+    rows[:, :D] = vis
+    return np.asarray(pack_rows(jnp.asarray(rows)))
+
+
+def test_ps_lookup_bitwise_identical_with_bounded_footprint(tmp_path):
+    """The tentpole CTR claim: PsLookupPredictor over a live ShardedTable
+    returns the local-table Predictor's output BITS, while the replica
+    holds well under a quarter of the table (cache param + LRU slab),
+    with the LRU demonstrably cycling (hits, misses, evictions all
+    nonzero)."""
+    from paddle_tpu import inference
+    from paddle_tpu.ps import RangeSpec, ShardedTable
+
+    packed = _packed_table()
+    dense = _save_ctr(str(tmp_path / "local"), V, packed=packed)
+    _save_ctr(str(tmp_path / "ps"), CAP, dense=dense)
+
+    ref = inference.create_predictor(inference.Config(str(tmp_path / "local")))
+    table = ShardedTable.build_in_process(
+        "tb", RangeSpec.even(V, 3), full_rows=packed)
+    try:
+        base = inference.create_predictor(inference.Config(str(tmp_path / "ps")))
+        ps = inference.PsLookupPredictor(
+            base, [inference.PsLookupBinding("tb", table, ["ids"])],
+            cache_rows_per_table=32)
+        rng = np.random.RandomState(3)
+        for i in range(12):
+            b = int(rng.randint(1, 5))
+            ids = rng.randint(0, V, size=(b, F)).astype(np.int64)
+            o_ref = ref.run_padded({"ids": ids}, 4)
+            o_ps = ps.run_padded({"ids": ids}, 4)
+            assert len(o_ref) == len(o_ps)
+            for x, y in zip(o_ref, o_ps):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        st = ps.stats()["tb"]
+        assert st["hits"] > 0 and st["misses"] > 0 and st["evictions"] > 0
+        # footprint: cache param + LRU slab stay well under the table
+        assert ps.resident_table_bytes() * 4 <= packed.nbytes, (
+            ps.resident_table_bytes(), packed.nbytes)
+    finally:
+        table.close()
+
+
+def test_fleet_serves_ps_backed_ctr(tmp_path, two_models):
+    """PS-backed serving through the whole stack: a thread fleet whose
+    predictor_factory wraps each replica's predictor in a
+    PsLookupPredictor — outputs bitwise-match the local-table reference
+    and every replica's resident bytes stay cache-sized."""
+    from paddle_tpu import inference
+    from paddle_tpu.ps import RangeSpec, ShardedTable
+    from paddle_tpu.serving import fleet
+
+    packed = _packed_table()
+    dense = _save_ctr(str(tmp_path / "local"), V, packed=packed)
+    _save_ctr(str(tmp_path / "ps"), CAP, dense=dense)
+    ref = inference.create_predictor(inference.Config(str(tmp_path / "local")))
+    table = ShardedTable.build_in_process(
+        "tb", RangeSpec.even(V, 2), full_rows=packed)
+    wrappers = []
+
+    def factory(model):
+        base = inference.create_predictor(
+            inference.Config(model.model_dir))
+        ps = inference.PsLookupPredictor(
+            base, [inference.PsLookupBinding("tb", table, ["ids"])],
+            cache_rows_per_table=32)
+        wrappers.append(ps)
+        return ps
+
+    reg = fleet.ModelRegistry()
+    reg.register("ctr-v1", str(tmp_path / "ps"))
+    rng = np.random.RandomState(5)
+    example = {"ids": rng.randint(0, V, size=(1, F)).astype(np.int64)}
+    fl = fleet.ServingFleet(
+        reg, "ctr-v1", replicas=2, buckets=(1, 2, 4),
+        predictor_factory=factory, example_feed=example,
+        server_kwargs={"max_batch_delay_ms": 1.0}, health_interval_s=0.2)
+    try:
+        with fl:
+            for _ in range(10):
+                b = int(rng.randint(1, 5))
+                ids = rng.randint(0, V, size=(b, F)).astype(np.int64)
+                out = fl.infer({"ids": ids})[0]
+                np.testing.assert_array_equal(
+                    np.asarray(out), np.asarray(ref.run({"ids": ids})[0]))
+        assert len(wrappers) == 2  # one PS wrapper per replica
+        for w in wrappers:
+            assert w.resident_table_bytes() * 4 <= packed.nbytes
+    finally:
+        table.close()
+
+
+@pytest.mark.slow
+def test_rollout_soak_alternating_versions(two_models):
+    """Soak: 8 closed-loop clients while the fleet ping-pongs v1↔v2
+    through six consecutive rollouts — zero errors, zero rejected
+    requests, every response attributable to a registered version."""
+    fl = _fleet(two_models)
+    feeds = [_rows(1 + i % 4, seed=300 + i) for i in range(8)]
+    refs1 = [two_models["ref1"].run({"x": f})[0] for f in feeds]
+    refs2 = [two_models["ref2"].run({"x": f})[0] for f in feeds]
+    errors, mismatches, served = [], [], [0]
+    done = threading.Event()
+
+    def client(k):
+        i = 0
+        while not done.is_set():
+            j = (k + i) % len(feeds)
+            try:
+                out = fl.infer({"x": feeds[j]})[0]
+            except Exception as e:
+                errors.append(repr(e))
+                return
+            if not (_matches(out, refs1[j]) or _matches(out, refs2[j])):
+                mismatches.append(j)
+            served[0] += 1
+            i += 1
+
+    with fl:
+        clients = [threading.Thread(target=client, args=(k,))
+                   for k in range(8)]
+        for t in clients:
+            t.start()
+        reports = []
+        for v in ("v2", "v1", "v2", "v1", "v2", "v1"):
+            time.sleep(0.3)
+            reports.append(fl.rollout(v))
+        time.sleep(0.3)
+        done.set()
+        for t in clients:
+            t.join()
+        assert errors == []
+        assert mismatches == []
+        assert served[0] > 100  # the soak actually soaked
+        for rep in reports:
+            for name, r in rep["replicas"].items():
+                assert r["drained"]["rejected"] == 0, (name, r)
+        assert fl.versions_live() == {"v1": 3}
+
+
+# -- serving_bench SLO gate -----------------------------------------------
+
+def test_serving_bench_slo_gate_exit_codes():
+    """--slo-p99-ms gates the exit code: generous SLO passes (0), an
+    impossible SLO fails (2)."""
+    from paddle_tpu.tools import serving_bench as sb
+
+    common = ["--requests", "12", "--concurrency", "4", "--in-dim", "8",
+              "--hidden", "16", "--buckets", "1,2,4", "--replicas", "2",
+              "--skip-sequential"]
+    assert sb.main(common + ["--slo-p99-ms", "60000"]) == 0
+    assert sb.main(common + ["--slo-p99-ms", "0.000001"]) == 2
