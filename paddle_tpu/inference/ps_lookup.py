@@ -34,10 +34,11 @@ from __future__ import annotations
 import os
 import threading
 
-from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ..ps.slab import LruOrder, SlotMap
 
 __all__ = ["PsLookupBinding", "PsLookupPredictor", "RowCache"]
 
@@ -45,9 +46,12 @@ __all__ = ["PsLookupBinding", "PsLookupPredictor", "RowCache"]
 class RowCache:
     """LRU cache of packed embedding rows (global id → `[lanes]` uint16).
 
-    Slab storage: one preallocated `[capacity, lanes]` array plus an
-    id→slot map, so memory is bounded and visible (`nbytes`) — the number
-    the replica-footprint assertion in the fleet tests keys on.
+    Slab storage: one preallocated `[capacity, lanes]` array plus the
+    shared `ps.slab.SlotMap`/`LruOrder` bookkeeping (the training-side
+    `ps.hot_cache.HotRowCache` sits on the same core — the policies
+    differ, the uid→slot mechanics don't), so memory is bounded and
+    visible (`nbytes`) — the number the replica-footprint assertion in
+    the fleet tests keys on.
     """
 
     def __init__(self, capacity: int, lanes: int):
@@ -56,15 +60,14 @@ class RowCache:
         self.capacity = int(capacity)
         self.lanes = int(lanes)
         self._store = np.zeros((self.capacity, self.lanes), np.uint16)
-        self._slot: Dict[int, int] = {}
-        self._lru: "OrderedDict[int, None]" = OrderedDict()
-        self._free = list(range(self.capacity - 1, -1, -1))
+        self._slots = SlotMap(self.capacity)
+        self._lru = LruOrder()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
     def __len__(self) -> int:
-        return len(self._slot)
+        return len(self._slots)
 
     @property
     def nbytes(self) -> int:
@@ -75,40 +78,35 @@ class RowCache:
         k = len(uids)
         rows = np.zeros((k, self.lanes), np.uint16)
         miss = np.zeros(k, bool)
-        for j, u in enumerate(uids.tolist()):
-            s = self._slot.get(u)
+        for j, u in enumerate(np.asarray(uids).tolist()):
+            s = self._slots.get(u)
             if s is None:
                 miss[j] = True
             else:
                 rows[j] = self._store[s]
-                self._lru.move_to_end(u)
+                self._lru.touch(u)
         nm = int(miss.sum())
         self.misses += nm
         self.hits += k - nm
         return rows, miss
 
     def insert(self, uids: np.ndarray, rows: np.ndarray) -> None:
-        for j, u in enumerate(uids.tolist()):
-            s = self._slot.get(u)
+        for j, u in enumerate(np.asarray(uids).tolist()):
+            s = self._slots.get(u)
             if s is None:
-                if self._free:
-                    s = self._free.pop()
-                else:
-                    old, _ = self._lru.popitem(last=False)
-                    s = self._slot.pop(old)
+                if not self._slots.free_slots:
+                    self._slots.pop(self._lru.pop_coldest())
                     self.evictions += 1
-                self._slot[u] = s
+                s = self._slots.assign(u)  # LIFO: reuses the victim's slot
             self._store[s] = rows[j]
-            self._lru[u] = None
-            self._lru.move_to_end(u)
+            self._lru.touch(u)
 
     def clear(self) -> None:
-        self._slot.clear()
+        self._slots.clear()
         self._lru.clear()
-        self._free = list(range(self.capacity - 1, -1, -1))
 
     def stats(self) -> dict:
-        return {"rows": len(self._slot), "capacity": self.capacity,
+        return {"rows": len(self._slots), "capacity": self.capacity,
                 "hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions, "bytes": self.nbytes}
 

@@ -65,7 +65,8 @@ except Exception:  # pragma: no cover
     pl = pltpu = None
     _HAVE_PALLAS = False
 
-__all__ = ["fused_adagrad_update", "enabled", "supports",
+__all__ = ["fused_adagrad_update", "fused_row_gather", "fused_row_scatter",
+           "enabled", "supports", "rows_enabled",
            "FORCE_PALLAS_INTERPRET"]
 
 # Must match ops/deferred_rows.py (not imported: that module imports us).
@@ -95,6 +96,17 @@ def enabled(vis: int, lanes: int = _PACK_LANES) -> bool:
     packable, a backend that can run it (TPU, or interpreter when forced),
     and no `PDTPU_FUSED_SPARSE=0` kill switch."""
     if not _HAVE_PALLAS or not supports(vis, lanes):
+        return False
+    if os.environ.get("PDTPU_FUSED_SPARSE", "1") == "0":
+        return False
+    return _on_tpu() or FORCE_PALLAS_INTERPRET
+
+
+def rows_enabled(lanes: int = _PACK_LANES) -> bool:
+    """Gate for the row-maintenance kernels (hot-cache write-back gather /
+    admission scatter) — same switches as `enabled` minus the vis-fits
+    check: these move whole packed rows, no unpacking."""
+    if not _HAVE_PALLAS or lanes <= 0:
         return False
     if os.environ.get("PDTPU_FUSED_SPARSE", "1") == "0":
         return False
@@ -197,3 +209,93 @@ def fused_adagrad_update(table, uids, utot, lr, *, vis, eps,
         input_output_aliases={3: 0},
         interpret=bool(interpret),
     )(uids, nu, lr_arr, table, utot)
+
+
+# ---------------------------------------------------------------------------
+# Row-maintenance kernels for the hot-row cache (ps/hot_cache.py): move
+# whole packed rows between the resident slab and flat buffers with the
+# same one-row-per-grid-step DMA steering as the Adagrad kernel. No
+# sentinel machinery: callers pad index vectors to a power-of-two bucket
+# by REPEATING THE LAST ELEMENT, so tail steps re-address the same block
+# — Pallas sees an unchanged block index (no refetch) and rewrites
+# identical bytes, which keeps the aliased scatter deterministic and the
+# executable set at O(log slab) shapes.
+# ---------------------------------------------------------------------------
+
+
+def _copy_row_kernel(slots_ref, table_ref, out_ref):
+    del slots_ref
+    out_ref[...] = table_ref[...]
+
+
+def fused_row_gather(table, slots, *, interpret=None):
+    """``out[i] = table[slots[i]]`` — the write-back gather.
+
+    table: (V, lanes) uint16 packed rows. slots: (R,) int — duplicate
+    entries are allowed (reads). Returns (R, lanes) uint16.
+    """
+    v, lanes = table.shape
+    r = int(slots.shape[0])
+    if interpret is None:
+        interpret = bool(FORCE_PALLAS_INTERPRET) or not _on_tpu()
+    slots = slots.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r,),
+        in_specs=[pl.BlockSpec(
+            (1, lanes), lambda i, s: (jnp.clip(s[i], 0, v - 1), 0))],
+        out_specs=pl.BlockSpec((1, lanes), lambda i, s: (i, 0)),
+    )
+    return pl.pallas_call(
+        _copy_row_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, lanes), table.dtype),
+        interpret=bool(interpret),
+    )(slots, table)
+
+
+def _scatter_row_kernel(slots_ref, src_ref, table_ref, rows_ref, out_ref):
+    del slots_ref, src_ref, table_ref
+    out_ref[...] = rows_ref[...]
+
+
+def fused_row_scatter(table, slots, rows, src=None, *, interpret=None):
+    """``table[slots[i]] = rows[src[i]]`` for every grid step — the
+    admission scatter, aliased in->out so untouched rows keep their bytes
+    without a copy.
+
+    The non-padding prefix of `slots` must be distinct (each output block
+    is flushed once); the padded tail must repeat the last (slot, src)
+    pair — same block index, identical bytes, a no-op rewrite.
+    """
+    v, lanes = table.shape
+    r = int(slots.shape[0])
+    if interpret is None:
+        interpret = bool(FORCE_PALLAS_INTERPRET) or not _on_tpu()
+    slots = slots.astype(jnp.int32)
+    src = (jnp.arange(r, dtype=jnp.int32) if src is None
+           else src.astype(jnp.int32))
+
+    def _tbl_map(i, slots_s, src_s):
+        return (jnp.clip(slots_s[i], 0, v - 1), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, lanes), _tbl_map),
+            pl.BlockSpec((1, lanes), lambda i, slots_s, src_s:
+                         (src_s[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, lanes), _tbl_map),
+    )
+    return pl.pallas_call(
+        _scatter_row_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        # table is the 3rd positional input after the two scalar-prefetch
+        # args
+        input_output_aliases={2: 0},
+        interpret=bool(interpret),
+    )(slots, src, table, rows)

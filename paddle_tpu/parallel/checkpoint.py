@@ -521,6 +521,12 @@ class Checkpointer:
             psn = f"{tname}@ps"
             ps_names.append(psn)
             spec, lanes = table.spec, table.lanes
+            hook = getattr(table, "flush_hook", None)
+            if hook is not None:
+                # flush-before-save: the tier writes back device-resident
+                # dirty rows (hot cache) and drains its pusher, so the
+                # mark taken below covers every update the dumps contain
+                hook()
             if hasattr(table, "journal_mark"):
                 # mark BEFORE the dumps: an entry with seq <= mark was
                 # applied before the caller's flush, so the dumped bytes

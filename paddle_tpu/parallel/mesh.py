@@ -73,6 +73,11 @@ class DistributedStrategy:
         # k >= 1 = at most k push batches in flight behind compute)
         self.pull_ahead = 1
         self.push_depth = 0
+        # device-resident hot-row cache over the PS tier (ps.hot_cache):
+        # 0 = stream every touched row per step; N >= 1 = keep N
+        # LFU-admitted rows resident in HBM with write-back eviction
+        # (PDTPU_PS_HOT_ROWS overrides when left at 0)
+        self.hot_rows = 0
         # reference-compat knobs (no-ops on TPU; XLA owns these)
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False

@@ -30,7 +30,9 @@ walkthrough) are documented in docs/migration.md "Distributed
 embeddings → Failure semantics".
 """
 from .health import ShardMonitor  # noqa: F401
+from .hot_cache import HotRowCache  # noqa: F401
 from .shard import EmbeddingShard, RangeSpec, make_shards  # noqa: F401
+from .slab import FreqSketch, LruOrder, SlotMap  # noqa: F401
 from .table import ShardedTable  # noqa: F401
 from .tier import PsEmbeddingTier, PsTableBinding  # noqa: F401
 from .transport import (InProcessClient, ShardClient,  # noqa: F401
@@ -42,4 +44,5 @@ __all__ = [
     "ShardClient", "InProcessClient", "SocketClient", "ShardServer",
     "TransportError", "ShardRestartedError", "connect", "probe",
     "ShardedTable", "ShardMonitor", "PsTableBinding", "PsEmbeddingTier",
+    "HotRowCache", "SlotMap", "LruOrder", "FreqSketch",
 ]

@@ -140,6 +140,11 @@ class ShardedTable:
         self._evicted_upto = [0] * spec.num_shards
         self._rw = _RWLock()
         self._recovery: Optional[Callable[[int, BaseException], None]] = None
+        # armed by the tier: Checkpointer.save() calls it before taking
+        # the journal mark / dumping shards, so device-resident dirty rows
+        # (hot cache) and queued async pushes land first (see
+        # set_flush_hook)
+        self.flush_hook: Optional[Callable[[], None]] = None
         # with a dual channel, pulls and pushes run concurrently — size
         # the pool so one side never starves the other of workers
         self._pool = (ThreadPoolExecutor(
@@ -349,6 +354,15 @@ class ShardedTable:
     def journal_bytes(self) -> int:
         with self._jlock:
             return self._journal_nbytes
+
+    def set_flush_hook(self, hook: Optional[Callable[[], None]]) -> None:
+        """Install (or clear) the make-shards-authoritative callback the
+        Checkpointer invokes before snapshotting this table. The tier
+        points it at its flush path — dirty hot-cache rows are written
+        back and the pusher drained — so ``journal_mark()`` taken right
+        after really covers the dumped shard bytes, without every save
+        call site having to remember ``tier.flush()``."""
+        self.flush_hook = hook
 
     def set_recovery(self,
                      hook: Optional[Callable[[int, BaseException], None]]

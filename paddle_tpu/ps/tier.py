@@ -35,10 +35,22 @@ stale that its missing pushes have left the patch window falls back to
 flush + re-pull (``ps/repulls``). Net: single-worker training is bitwise
 exact at ANY depth; ``push_depth`` only relaxes cross-worker visibility.
 
+Hot-row cache (``hot_rows`` > 0, or ``PDTPU_PS_HOT_ROWS``): the cache
+param becomes a persistent ``[hot_rows + step_rows]`` slab managed by
+``ps.hot_cache.HotRowCache`` — LFU-admitted hot rows stay resident in
+HBM across steps (hits are never pulled OR pushed), misses flow through
+the staging tail exactly like the uncached per-step path, and evicted
+dirty rows are written back through the same pusher/journal machinery.
+The bitwise contract is unchanged: the id remap per step is injective
+and the update math depends only on id equality structure, while miss
+pulls and write-backs observe the same read-your-writes patching — so
+single-worker runs stay bit-identical to the uncached tier at any push
+depth.
+
 Metrics: ``ps/prefetch_hit``/``ps/prefetch_miss`` (was batch N+1 already
 converted+pulled when the loop asked?), ``ps/patched_rows``,
 ``ps/repulls`` — plus ``ps/pull_ms``/``ps/push_ms``/``ps/bytes_*`` from
-the table layer.
+the table layer and ``ps/cache_*`` from the hot cache.
 """
 from __future__ import annotations
 
@@ -73,14 +85,17 @@ class PsTableBinding:
 
 
 class _Entry:
-    """One table's pulled state for one batch."""
-    __slots__ = ("uids", "n", "cache", "version")
+    """One table's pulled state for one batch. In hot-cache mode `uids`
+    are the step's MISS uids (hits never leave the slab) and `plan` is
+    the HotRowCache.CachePlan that owns the slab slot assignment."""
+    __slots__ = ("uids", "n", "cache", "version", "plan")
 
-    def __init__(self, uids, n, cache, version):
+    def __init__(self, uids, n, cache, version, plan=None):
         self.uids = uids      # ascending unique global ids, [n] int64
         self.n = n
         self.cache = cache    # [cache_rows, lanes] u16 device array
         self.version = version  # pusher.applied_seq snapshot before pull
+        self.plan = plan
 
 
 class _Prepared:
@@ -203,7 +218,8 @@ class PsEmbeddingTier:
     """
 
     def __init__(self, program, bindings: Sequence[PsTableBinding],
-                 pull_ahead: int = 1, push_depth: int = 0):
+                 pull_ahead: int = 1, push_depth: int = 0,
+                 hot_rows: Optional[int] = None):
         if pull_ahead < 0 or push_depth < 0:
             raise ValueError(f"pull_ahead/push_depth must be >= 0, got "
                              f"{pull_ahead}/{push_depth}")
@@ -213,6 +229,9 @@ class PsEmbeddingTier:
             raise ValueError("PsEmbeddingTier: no table bindings")
         self.pull_ahead = int(pull_ahead)
         self.push_depth = int(push_depth)
+        if hot_rows is None:
+            hot_rows = int(os.environ.get("PDTPU_PS_HOT_ROWS", "0"))
+        self.hot_rows = max(0, int(hot_rows))
         block = program.global_block()
         self._cache_shape: Dict[str, tuple] = {}
         self._id_dtype: Dict[str, object] = {}
@@ -224,11 +243,38 @@ class PsEmbeddingTier:
                     f"cache param {b.param!r} has {lanes} lanes but table "
                     f"{b.table.name!r} has {b.table.lanes}")
             self._cache_shape[b.param] = (rows, lanes)
+        # device-resident hot-row cache (ps/hot_cache.py): the cache param
+        # becomes a persistent [hot_rows + step_rows] slab instead of a
+        # per-step scratch pull target
+        self._hot: Dict[str, object] = {}
+        if self.hot_rows:
+            from .hot_cache import HotRowCache
+            for b in self.bindings:
+                rows_cap, lanes = self._cache_shape[b.param]
+                step_rows = rows_cap - self.hot_rows
+                if step_rows < 1:
+                    raise ValueError(
+                        f"hot_rows={self.hot_rows} leaves no staging rows "
+                        f"in cache param {b.param!r} ({rows_cap} rows); "
+                        "rebuild the program with a [hot_rows + per-step "
+                        "rows] cache param")
+                self._hot[b.param] = HotRowCache(
+                    self.hot_rows, step_rows, lanes=lanes,
+                    vocab=b.table.spec.vocab, name=b.table.name)
         # patch window: every pull can be behind by at most the prefetch
-        # depth plus the in-flight pushes (+ slack for the re-pull path)
-        window = self.pull_ahead + self.push_depth + 2
+        # depth plus the in-flight pushes (+ slack for the re-pull path);
+        # hot-cache mode submits up to three batches per step (eviction
+        # write-back, staging push, flush) instead of one, so the window
+        # widens accordingly — overflow is still safe (repull fallback)
+        window = ((self.pull_ahead + self.push_depth + 2)
+                  * (3 if self.hot_rows else 1))
         self._pushers = {b.param: _Pusher(b.table, push_depth, window)
                          for b in self.bindings}
+        for b in self.bindings:
+            # checkpoint flush hook: Checkpointer.save() calls it before
+            # taking the journal mark + dumping shards, so slab-dirty rows
+            # and queued pushes are on the shards the mark covers
+            b.table.set_flush_hook(lambda p=b.param: self._flush_param(p))
         reg = get_registry()
         self._c_hit = reg.counter("ps/prefetch_hit")
         self._c_miss = reg.counter("ps/prefetch_miss")
@@ -300,13 +346,16 @@ class PsEmbeddingTier:
 
     # ----------------------------------------------------------- pull path
     def _pull_cache(self, binding: PsTableBinding, uids: np.ndarray,
-                    version: int):
-        """Pull rows for `uids`, pad to the cache shape, land on device."""
+                    version: int, cap: Optional[int] = None):
+        """Pull rows for `uids`, pad to the cache shape (or `cap` rows —
+        the hot path's miss buffer), land on device."""
         import jax
         import jax.numpy as jnp
 
         fault_point("ps.pull")
         rows_cap, lanes = self._cache_shape[binding.param]
+        if cap is not None:
+            rows_cap = int(cap)
         if uids.shape[0] > rows_cap:
             raise ValueError(
                 f"batch touches {uids.shape[0]} unique rows of table "
@@ -332,14 +381,32 @@ class PsEmbeddingTier:
                     if arrs else np.zeros((0,), np.int64))
             uids, inv = np.unique(flat.astype(np.int64),
                                   return_inverse=True)
+            hot = self._hot.get(b.param)
+            if hot is None:
+                loc_all = inv
+            else:
+                # hot path: ids map to SLAB rows (resident slot for hits
+                # and admitted misses, staging tail for bypass) and only
+                # the miss rows are pulled. The remap stays injective per
+                # step, so uniq_merge's equality structure — and every
+                # float op — matches the uncached run bit-for-bit.
+                # Occurrence counts feed the lookup-weighted hit metrics.
+                plan = hot.plan(uids, np.bincount(inv))
+                loc_all = plan.slots[inv]
             off = 0
             for f, a in zip(b.id_feeds, arrs):
-                loc = inv[off:off + a.size].reshape(a.shape)
+                loc = loc_all[off:off + a.size].reshape(a.shape)
                 out[f] = loc.astype(a.dtype if a.dtype.kind in "iu"
                                     else np.int64)
                 off += a.size
             version = self._pushers[b.param].applied_seq
-            entries[b.param] = self._pull_cache(b, uids, version)
+            if hot is None:
+                entries[b.param] = self._pull_cache(b, uids, version)
+            else:
+                entry = self._pull_cache(b, plan.miss_uids, version,
+                                         cap=hot.step_rows)
+                entry.plan = plan
+                entries[b.param] = entry
         feed = _default_convert(self.program.global_block())(out)
         return _Prepared(feed, entries)
 
@@ -396,7 +463,8 @@ class PsEmbeddingTier:
             self._c_repulls.inc()
             pusher.flush()
             fresh = self._pull_cache(binding, entry.uids,
-                                     pusher.applied_seq)
+                                     pusher.applied_seq,
+                                     cap=int(entry.cache.shape[0]))
             return fresh.cache
         cache = entry.cache
         n = entry.n
@@ -424,6 +492,25 @@ class PsEmbeddingTier:
             self._c_patched.inc(k)
         return cache
 
+    def _dispatch_hot(self, binding: PsTableBinding, hot, entry: _Entry):
+        """Slab maintenance for one step, in plan order: write back the
+        plan's eviction victims (gathered BEFORE their slots are
+        overwritten), read-your-writes-patch the pulled miss rows, and
+        scatter them into their slab slots. Returns the slab to run on."""
+        plan = entry.plan
+        hot.ensure_slab()
+        if plan.evict_uids.size:
+            # always write evicted rows back — for a clean row the push
+            # rewrites identical bytes (idempotent); for a dirty one this
+            # IS the write-back that makes eviction lossless
+            rows = hot.take_rows(plan.evict_slots)
+            self._pushers[binding.param].submit(plan.evict_uids, rows)
+            hot.note_writeback(int(plan.evict_uids.size))
+        if entry.n:
+            patched = self._patched_cache(binding, entry)
+            hot.insert_rows(plan.miss_slots, patched)
+        return hot.slab
+
     def run_step(self, exe, prepared: _Prepared, fetch_list=None,
                  scope=None, **run_kw):
         """One training step: swap caches in, run, push updated rows."""
@@ -432,19 +519,35 @@ class PsEmbeddingTier:
         sc = scope if scope is not None else _scope()
         for b in self.bindings:
             entry = prepared.entries[b.param]
-            sc.set_var(b.param, self._patched_cache(b, entry))
+            hot = self._hot.get(b.param)
+            if hot is None:
+                sc.set_var(b.param, self._patched_cache(b, entry))
+            else:
+                sc.set_var(b.param, self._dispatch_hot(b, hot, entry))
         out = exe.run(self.program, feed=prepared.feed,
                       fetch_list=fetch_list, scope=sc, **run_kw)
         for b in self.bindings:
             entry = prepared.entries[b.param]
-            # hand the pusher the full fixed-shape cache: the patcher can
-            # then gather from it without a per-n recompile, and the
-            # device→host sync + [:n] slice happen in the pusher; the
-            # buffer is never re-fed to the program (set_var replaces it
-            # before the next run), so it cannot be donated out from
-            # under the flusher
+            hot = self._hot.get(b.param)
             new_cache = sc.find_var(b.param)
-            self._pushers[b.param].submit(entry.uids, new_cache)
+            if hot is None:
+                # hand the pusher the full fixed-shape cache: the patcher
+                # can then gather from it without a per-n recompile, and
+                # the device→host sync + [:n] slice happen in the pusher;
+                # the buffer is never re-fed to the program (set_var
+                # replaces it before the next run), so it cannot be
+                # donated out from under the flusher
+                self._pushers[b.param].submit(entry.uids, new_cache)
+                continue
+            # hot path: the program's output IS the next step's slab;
+            # only rows that leave it cross back to the shards — the
+            # staging (bypass) rows now, resident rows on eviction/flush
+            hot.slab = new_cache
+            plan = entry.plan
+            if plan.bypass_uids.size:
+                rows = hot.take_rows(plan.bypass_slots)
+                self._pushers[b.param].submit(plan.bypass_uids, rows)
+            hot.commit(plan)
         return out
 
     def train(self, exe, reader, fetch_list=None, scope=None,
@@ -460,14 +563,35 @@ class PsEmbeddingTier:
         self.flush()
 
     # ------------------------------------------------------------ lifecycle
+    def _flush_param(self, param: str) -> None:
+        """Make the shards authoritative for one table: push every row
+        whose newest bytes live only in the hot slab (dirty residents +
+        planned-but-undispatched eviction victims), then drain the
+        pusher. This is also the table's checkpoint flush hook, so
+        ``Checkpointer.save(ps_tables=...)`` dumps shard bytes that the
+        ``@ps_mark@`` journal mark really covers."""
+        hot = self._hot.get(param)
+        pusher = self._pushers[param]
+        if hot is not None and hot.slab is not None:
+            fuids, fslots = hot.flush_rows()
+            if fuids.size:
+                rows = hot.take_rows(fslots)
+                pusher.submit(fuids, rows)
+                hot.note_writeback(int(fuids.size))
+        pusher.flush()
+
     def flush(self):
         """Drain every pusher — after this the shards hold every update
-        (checkpoint save and the exactness tests call this)."""
-        for p in self._pushers.values():
-            p.flush()
+        (checkpoint save and the exactness tests call this). In hot-cache
+        mode, dirty resident rows are written back first."""
+        for b in self.bindings:
+            self._flush_param(b.param)
 
     def stats(self) -> dict:
-        return {b.param: b.table.stats() for b in self.bindings}
+        out = {b.param: b.table.stats() for b in self.bindings}
+        for p, hot in self._hot.items():
+            out[p]["hot_cache"] = hot.stats()
+        return out
 
     def close(self):
         if self._loader is not None:
@@ -476,6 +600,7 @@ class PsEmbeddingTier:
         for p in self._pushers.values():
             p.close()
         for b in self.bindings:
+            b.table.set_flush_hook(None)
             b.table.close()
 
     def __enter__(self):

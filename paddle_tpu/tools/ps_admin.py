@@ -23,12 +23,22 @@ Commands:
 
 * ``ping``        — one-shot liveness per shard (fresh connection each);
 * ``meta``        — which tables each shard hosts and their row ranges;
-* ``stats``       — per-shard pull/push byte counters;
+* ``stats``       — per-shard pull/push byte counters, plus the worker's
+  hot-row-cache block (hit rate, resident/dirty rows, write-back bytes)
+  when one is in play;
 * ``dump-health`` — the ShardMonitor view as one JSON document: runs a
   single synchronous sweep and prints ``status`` (ok/degraded/failing),
   per-shard up flags, and the endpoint list — what the in-process
   ``/healthz`` check ``ps/shards`` reports, minus the wedge timer
-  (a one-shot CLI has no down-since history).
+  (a one-shot CLI has no down-since history). Includes the same
+  ``hot_cache`` block as ``stats``.
+
+The hot-row cache lives in the WORKER process, not on the shards, so
+its ``ps/cache_*`` series come from the worker's introspection plane:
+pass ``--worker http://host:port`` (the ``PDTPU_INTROSPECT_PORT``
+server; ``/metrics.json`` is fetched) — or, with no ``--worker``, from
+this process's own registry, which is only meaningful for in-process
+callers (tests, notebooks driving the tier directly).
 
 Exit code 0 when every shard answered, 1 otherwise (plus 2 for usage
 errors, argparse's convention).
@@ -58,6 +68,38 @@ def _endpoints(arg: str) -> list:
     return out
 
 
+# counter / gauge suffixes of the ps/cache_* series (hot_cache.py)
+_CACHE_KEYS = ("hits", "misses", "lookup_hits", "lookup_misses",
+               "admitted", "evictions", "bypass", "writeback_bytes",
+               "resident_rows", "dirty_rows", "capacity")
+
+
+def cache_fields(worker: str = "", timeout: float = 2.0):
+    """The hot-row-cache block for ``stats``/``dump-health``: the
+    ``ps/cache_*`` registry series plus derived ratios, read from a
+    worker's ``/metrics.json`` (``worker`` is the introspection base
+    URL) or from this process's registry when ``worker`` is empty.
+    Returns None when no hot cache has ever registered (capacity 0)."""
+    if worker:
+        import urllib.request
+        url = worker.rstrip("/") + "/metrics.json"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            snap = json.load(resp)
+    else:
+        from ..observability.registry import get_registry
+        snap = get_registry().snapshot(deep=True)
+    if not float(snap.get("ps/cache_capacity", 0) or 0):
+        return None
+    out = {k: snap.get(f"ps/cache_{k}", 0) for k in _CACHE_KEYS}
+    total = out["hits"] + out["misses"]
+    out["hit_rate"] = (out["hits"] / total) if total else None
+    ltotal = out["lookup_hits"] + out["lookup_misses"]
+    out["lookup_hit_rate"] = (out["lookup_hits"] / ltotal) if ltotal else None
+    out["dirty_fraction"] = (out["dirty_rows"] / out["capacity"]
+                             if out["capacity"] else None)
+    return out
+
+
 def _ask(endpoint: str, op: str, timeout: float):
     """(ok, payload-or-error) for one shard, single attempt."""
     from ..ps.transport import SocketClient
@@ -83,16 +125,27 @@ def main(argv=None) -> int:
                          "PADDLE_PSERVER_ENDPOINTS)")
     ap.add_argument("--timeout", type=float, default=2.0,
                     help="per-shard socket timeout, seconds (default 2)")
+    ap.add_argument("--worker", default="",
+                    help="worker introspection base URL (http://host:port)"
+                         " for the hot-row-cache fields; default: this "
+                         "process's registry")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (dump-health always is)")
     args = ap.parse_args(argv)
     eps = _endpoints(args.endpoints)
+
+    def _cache():
+        try:
+            return cache_fields(args.worker, args.timeout)
+        except Exception as e:  # unreachable worker != unhealthy shards
+            return {"error": f"{type(e).__name__}: {e}"}
 
     if args.cmd == "dump-health":
         from ..ps.health import ShardMonitor
         mon = ShardMonitor.for_endpoints(eps)
         mon.poll_now()
         doc = mon.status()
+        doc["hot_cache"] = _cache()
         print(json.dumps(doc, indent=None if args.json else 2,
                          sort_keys=True))
         return 0 if all(s["up"] for s in doc["shards"]) else 1
@@ -105,8 +158,13 @@ def main(argv=None) -> int:
         all_up &= ok
         rows.append({"shard": i, "endpoint": ep, "up": ok,
                      ("error" if not ok else op): payload})
+    cache = _cache() if op == "stats" else None
     if args.json:
-        print(json.dumps(rows, sort_keys=True))
+        if op == "stats":
+            print(json.dumps({"shards": rows, "hot_cache": cache},
+                             sort_keys=True))
+        else:
+            print(json.dumps(rows, sort_keys=True))
     else:
         for r in rows:
             state = "up" if r["up"] else f"DOWN ({r['error']})"
@@ -114,6 +172,8 @@ def main(argv=None) -> int:
             if r["up"] and op != "ping":
                 line += " " + json.dumps(r[op], sort_keys=True)
             print(line)
+        if cache is not None:
+            print("hot cache: " + json.dumps(cache, sort_keys=True))
     return 0 if all_up else 1
 
 
