@@ -59,6 +59,25 @@ def _end_section(extras, name):
     gc.collect()
 
 
+def _telemetry_out(section, kind, doc):
+    """Sidecar parity with serving_bench's --trace-out/--metrics-out:
+    the observability-bearing sections drop their merged fleet trace and
+    federated metrics snapshot as JSON files next to the bench output.
+    PDTPU_BENCH_TELEMETRY_DIR overrides the default tmpdir location.
+    Returns the written path (None when there is nothing to write)."""
+    if doc is None:
+        return None
+    import tempfile
+
+    d = (os.environ.get("PDTPU_BENCH_TELEMETRY_DIR")
+         or os.path.join(tempfile.gettempdir(), "pdtpu_bench_telemetry"))
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{section}_{kind}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
 # Sections that have OOMed on real chips (BENCH_r05: ring_attn's
 # RESOURCE_EXHAUSTED cascaded into dygraph and nmt_big even with
 # in-process isolation — the XLA allocator does not return a dead
@@ -759,7 +778,7 @@ def bench_ps_embedding(on_tpu):
     reg = get_registry()
 
     def run_arm(pull_ahead, push_depth, arm_vocab=vocab, arm_feeds=feeds,
-                warmup=3, hot_rows=0):
+                warmup=3, hot_rows=0, scrape_hz=0.0):
         hit0 = reg.counter("ps/prefetch_hit").value
         miss0 = reg.counter("ps/prefetch_miss").value
         # socket transport on purpose: pull/push cost (serialize + TCP +
@@ -771,6 +790,19 @@ def bench_ps_embedding(on_tpu):
         table = ShardedTable(
             "fm_t", spec, [SocketClient(s.endpoint) for s in servers],
             push_clients=[SocketClient(s.endpoint) for s in servers])
+        # ISSUE 13's off-the-hot-path claim: federation rides a daemon
+        # thread plus the shards' `metrics` op, never the step itself —
+        # scrape the trainer registry AND every shard socket at
+        # `scrape_hz` while this arm trains, then A/B step time
+        scraper, fed_doc = None, None
+        if scrape_hz:
+            from paddle_tpu.observability.federate import (FederatedScraper,
+                                                           ScrapeTarget)
+            scraper = FederatedScraper(
+                [ScrapeTarget.local(name="trainer", role="trainer")]
+                + [ScrapeTarget.ps(s.endpoint, shard=i)
+                   for i, s in enumerate(servers)],
+                interval_s=1.0 / scrape_hz).start()
         # hot_rows > 0 grows the cache param into the persistent slab
         # ([hot_rows + per-step rows]) the HotRowCache manages
         main, startup, _, loss, _ = deepfm.build_train_program(
@@ -800,10 +832,15 @@ def bench_ps_embedding(on_tpu):
                       if t0 is not None and n_timed else None)
                 stats = tier.stats()["fm_t"]
             finally:
+                if scraper is not None:
+                    # grab the last background sweep (or force one)
+                    # while the shard sockets are still up
+                    fed_doc = scraper.last() or scraper.scrape_once()
+                    scraper.stop()
                 tier.close()
                 for s in servers:
                     s.stop()
-        return {
+        res = {
             "rate": round(batch / dt, 1) if dt else None,
             "step_ms": round(dt * 1e3, 2) if dt else None,
             "losses": losses,
@@ -816,6 +853,9 @@ def bench_ps_embedding(on_tpu):
                 for s in stats["shards"]],
             "hot_cache": stats.get("hot_cache"),
         }
+        if fed_doc is not None:
+            res["federated"] = fed_doc
+        return res
 
     off = run_arm(0, 0)            # inline pulls, synchronous push
     on0 = run_arm(2, 0)            # prefetch on, staleness 0
@@ -825,6 +865,34 @@ def bench_ps_embedding(on_tpu):
                if off["rate"] and on1["rate"] else None)
     speedup_s0 = (round(on0["rate"] / off["rate"], 3)
                   if off["rate"] and on0["rate"] else None)
+
+    # ISSUE 13: the same full-overlap arm with a 1 Hz FederatedScraper
+    # polling trainer + shards in the background — federation must be
+    # provably off the hot path (<1% step-time delta). Clear the tracer
+    # first so the trace sidecar covers exactly this arm.
+    from paddle_tpu.observability.tracer import get_tracer
+    from paddle_tpu.tools.timeline import merge_fleet_traces
+    get_tracer().clear()
+    obs = run_arm(2, 1, scrape_hz=1.0)
+    fed_doc = obs.pop("federated", None)
+    scrape_overhead = (round(obs["step_ms"] / on1["step_ms"] - 1.0, 4)
+                       if obs["step_ms"] and on1["step_ms"] else None)
+    merged_trace = merge_fleet_traces([get_tracer().export_chrome_trace()],
+                                      ["trainer"])
+    federation = {
+        "scrape_hz": 1.0,
+        "step_ms_unscraped": on1["step_ms"],
+        "step_ms_scraped": obs["step_ms"],
+        "step_time_delta_frac": scrape_overhead,
+        "off_hot_path": (scrape_overhead is not None
+                         and scrape_overhead < 0.01),
+        "targets_ok": (fed_doc or {}).get("ok"),
+        "signals": (fed_doc or {}).get("signals"),
+        "trace_sidecar": _telemetry_out("ps_embedding", "trace",
+                                        merged_trace),
+        "metrics_sidecar": _telemetry_out("ps_embedding", "metrics",
+                                          fed_doc),
+    }
 
     # aggregate table 2x the single-host packed bench size, across shards
     big_vocab = 2 * (33_554_432 if on_tpu else 10_000)
@@ -871,6 +939,8 @@ def bench_ps_embedding(on_tpu):
         "repulls": reg.counter("ps/repulls").value,
         "pull_ms_p50": reg.histogram("ps/pull_ms").percentile(50),
         "push_ms_p50": reg.histogram("ps/push_ms").percentile(50),
+        # ISSUE 13: 1 Hz federation A/B + trace/metrics sidecars
+        "federation": federation,
         "big_table": big,
     }
     return out
@@ -1388,6 +1458,7 @@ def bench_serving_fleet(on_tpu):
     buckets = (1, 2, 4, 8)
     dirs = [tempfile.mkdtemp(prefix=f"fleet_bench_v{i}_") for i in (1, 2)]
     dps = tempfile.mkdtemp(prefix="fleet_bench_ps_")
+    dobs = tempfile.mkdtemp(prefix="fleet_bench_obs_")
     try:
         # -- (a) scale-out: one served replica vs a 3-replica fleet
         pred = sb.build_predictor(model_dir=dirs[0], in_dim=in_dim,
@@ -1449,8 +1520,13 @@ def bench_serving_fleet(on_tpu):
 
         # -- (c) PS-backed vs local-table CTR arm
         out["ps_vs_local"] = _bench_ps_serving_arm(dps, on_tpu)
+
+        # -- (d) cross-process observability (ISSUE 13 acceptance cell):
+        # router -> subprocess worker -> subprocess pservers, one merged
+        # trace spanning all three process kinds + one federated scrape
+        out["observability"] = _bench_fleet_observability_arm(dobs, on_tpu)
     finally:
-        for d in dirs + [dps]:
+        for d in dirs + [dps, dobs]:
             shutil.rmtree(d, ignore_errors=True)
     return out
 
@@ -1551,6 +1627,165 @@ def _bench_ps_serving_arm(workdir, on_tpu):
         }
     finally:
         table.close()
+
+
+def _bench_fleet_observability_arm(workdir, on_tpu):
+    """The ISSUE-13 acceptance cell at bench scale: requests routed
+    through a FleetRouter to a SUBPROCESS worker whose PsLookupPredictor
+    pulls rows from two SUBPROCESS pservers — three distinct process
+    kinds on one request path. Measures (1) how many traces span >=3
+    processes in the merged chrome trace (one trace_id, flow arrows) and
+    (2) that a single federated scrape carries the pull-latency
+    percentiles and serving queue depth labeled per shard/replica. Both
+    artifacts are written as sidecars (`_telemetry_out`)."""
+    import subprocess
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers
+    from paddle_tpu.initializer import RowPackInitializer
+    from paddle_tpu.observability.federate import (FederatedScraper,
+                                                   ScrapeTarget)
+    from paddle_tpu.observability.tracer import get_tracer
+    from paddle_tpu.param_attr import ParamAttr
+    from paddle_tpu.ps import RangeSpec, SocketClient
+    from paddle_tpu.serving.fleet.registry import ModelRegistry
+    from paddle_tpu.serving.fleet.replica import ProcessReplica
+    from paddle_tpu.serving.fleet.router import FleetRouter
+    from paddle_tpu.tools.timeline import merge_fleet_traces
+
+    V, D, MULT, F, CAP = (65536, 8, 2, 16, 1024) if on_tpu \
+        else (4096, 8, 2, 8, 256)
+    n_req = 24
+
+    # cache-sized model dir: the worker holds CAP rows of `tb`, every
+    # miss is a live pull from the pservers (that socket hop is the
+    # cross-process edge under test)
+    d_model = os.path.join(workdir, "obs_model")
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        ids = layers.data("ids", [F], dtype="int64")
+        emb = layers.embedding(
+            ids, [CAP, D * MULT], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb",
+                                 initializer=RowPackInitializer(
+                                     D, D * MULT, -1.0, 1.0)))
+        emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+        r = layers.reshape(emb, [-1, F * D])
+        out_v = layers.fc(r, 16, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d_model, ["ids"], [out_v], exe,
+                                      main_p)
+
+    # two real pserver subprocesses (zero-initialized rows are fine —
+    # the arm measures the observability plane, not the predictions)
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "ps_server_runner.py")
+    spec = RangeSpec.even(V, 2)
+    procs, eps = [], []
+    router = rep = None
+    try:
+        for i in range(2):
+            lo, hi = spec.bounds(i)
+            p = subprocess.Popen(
+                [sys.executable, runner, "--port", "0",
+                 "--table", f"tb:{lo}:{hi}"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)
+            ep = p.stdout.readline().strip()
+            if not ep:
+                raise RuntimeError("pserver runner died at boot")
+            procs.append(p)
+            eps.append(ep)
+
+        mv = ModelRegistry().register("obs", d_model)
+        rep = ProcessReplica(
+            "obs-replica", mv, buckets=(1, 2, 4, 8),
+            extra_args=["--ps-endpoints", ",".join(eps),
+                        "--ps-table", f"tb=tb:{V}",
+                        "--ps-id-feeds", "ids",
+                        "--ps-cache-rows", str(2 * CAP)],
+            server_kwargs={"max_batch_delay_ms": 1.0})
+        router = FleetRouter([rep])
+        # scope the coordinator trace to this arm (earlier fleet arms
+        # share the process tracer)
+        get_tracer().clear()
+        rng = np.random.RandomState(11)
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            router.infer(
+                {"ids": rng.randint(0, V, size=(8, F)).astype(np.int64)})
+        wall = time.perf_counter() - t0
+
+        # -- (1) merge the three processes' chrome traces
+        traces = [("router", get_tracer().export_chrome_trace()),
+                  ("replica", rep.trace_export())]
+        for i, ep in enumerate(eps):
+            c = SocketClient(ep, retries=0)
+            try:
+                traces.append((f"pserver{i}", c.trace_export()))
+            finally:
+                c.close()
+        merged = merge_fleet_traces([t for _, t in traces],
+                                    [n for n, _ in traces])
+        procs_per_trace = {}
+        for name, tr in traces:
+            for ev in tr.get("traceEvents", []):
+                tid = (ev.get("args") or {}).get("trace_id")
+                if tid and ev.get("ph") in ("B", "X", "i"):
+                    procs_per_trace.setdefault(tid, set()).add(name)
+        spans3 = [len(v) for v in procs_per_trace.values() if len(v) >= 3]
+        flows = sum(1 for ev in merged["traceEvents"]
+                    if ev.get("ph") in ("s", "f"))
+
+        # -- (2) one federated scrape over all four processes
+        fed = FederatedScraper(
+            [ScrapeTarget.local(name="router", role="coordinator"),
+             ScrapeTarget.call(rep.metrics, name="obs-replica",
+                               role="replica-process")]
+            + [ScrapeTarget.ps(ep, shard=i)
+               for i, ep in enumerate(eps)]).scrape_once()
+        pull_p99, queue_depth = {}, {}
+        for t in fed["targets"]:
+            for s in t["series"]:
+                if (s["name"] == "ps/shard_pull_ms"
+                        and s.get("type") == "summary"):
+                    sh = (s.get("labels") or {}).get("shard", "?")
+                    pull_p99[f"shard={sh}"] = round(
+                        (s.get("summary") or {}).get("p99", 0.0), 2)
+                elif s["name"] == "serving/queue_depth":
+                    queue_depth[t["process"]] = s.get("value")
+
+        return {
+            "requests": n_req,
+            "rps": round(n_req / wall, 1),
+            "processes_traced": [n for n, _ in traces],
+            # the acceptance numbers: traces whose spans land in >=3
+            # distinct processes, and the flow arrows linking them
+            "cross_process_traces": len(spans3),
+            "max_processes_one_trace": max(spans3, default=0),
+            "flow_events": flows,
+            "federated_ok": fed["ok"],
+            "pull_p99_ms_by_shard": pull_p99,
+            "queue_depth_by_process": queue_depth,
+            "autoscale_signals": fed.get("signals"),
+            "trace_sidecar": _telemetry_out("serving_fleet", "trace",
+                                            merged),
+            "metrics_sidecar": _telemetry_out("serving_fleet", "metrics",
+                                              fed),
+        }
+    finally:
+        if router is not None:
+            router.close()
+        if rep is not None:
+            try:
+                rep.stop()
+            except Exception:
+                pass
+        for p in procs:
+            p.kill()
+            p.wait()
 
 
 def main():

@@ -54,6 +54,11 @@ Quick start::
     obs.get_registry().dump_json("metrics.json") # registry export
     obs.get_tracer().export_chrome_trace("host_trace.json")
 """
+from . import context  # noqa: F401
+from . import federate  # noqa: F401
+from .context import TraceContext  # noqa: F401
+from .federate import (FederatedScraper, ScrapeTarget,  # noqa: F401
+                       get_scraper, install_scraper)
 from .flight import (FlightRecorder, get_flight_recorder,  # noqa: F401
                      is_oom, register_dump_section,
                      unregister_dump_section)
@@ -64,16 +69,20 @@ from .http import (IntrospectionServer, maybe_serve_from_env,  # noqa: F401
 from .memory import (device_memory_stats,  # noqa: F401
                      per_device_state_bytes, record_state_memory)
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
-                       get_registry)
+                       get_registry, render_prometheus)
 from .steps import StepProfiler, get_step_profiler  # noqa: F401
-from .tracer import Tracer, get_tracer, trace_span  # noqa: F401
+from .tracer import (Tracer, get_tracer, server_span,  # noqa: F401
+                     start_trace, trace_span)
 from .watchdog import (RecompileWarning, RecompileWatchdog,  # noqa: F401
                        diff_signatures, get_watchdog)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "get_registry",
+    "render_prometheus",
+    "TraceContext", "context",
+    "FederatedScraper", "ScrapeTarget", "install_scraper", "get_scraper",
     "device_memory_stats", "per_device_state_bytes", "record_state_memory",
-    "Tracer", "get_tracer", "trace_span",
+    "Tracer", "get_tracer", "trace_span", "start_trace", "server_span",
     "RecompileWarning", "RecompileWatchdog", "diff_signatures",
     "get_watchdog",
     "FlightRecorder", "get_flight_recorder", "is_oom",

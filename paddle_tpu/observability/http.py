@@ -7,12 +7,17 @@ is the stdlib-only equivalent: a daemon-threaded `ThreadingHTTPServer`
 (no new dependencies) exposing the process registry, step records, and
 flight-recorder contents:
 
-    GET /metrics        Prometheus text exposition (Registry.prometheus_text)
-    GET /metrics.json   deep registry snapshot as JSON
-    GET /healthz        named health checks, ok/degraded/failing aggregation
-                        (200 for ok/degraded, 503 for failing)
-    GET /debug/steps    recent StepProfiler records (?n=50 to limit)
-    GET /debug/flight   flight-recorder ring + last post-mortem dump
+    GET /metrics         Prometheus text exposition (Registry.prometheus_text)
+    GET /metrics.json    deep registry snapshot as JSON
+    GET /metrics/series  structured series list (Registry.series) — what
+                         the federation scraper consumes
+    GET /fleet           one federated scrape of every process (requires
+                         an installed federate.FederatedScraper; 404
+                         otherwise, 503 when any target is unreachable)
+    GET /healthz         named health checks, ok/degraded/failing
+                         aggregation (200 for ok/degraded, 503 for failing)
+    GET /debug/steps     recent StepProfiler records (?n=50 to limit)
+    GET /debug/flight    flight-recorder ring + last post-mortem dump
 
 Start explicitly with ``obs.serve_introspection(port)`` (0 = ephemeral)
 or implicitly by setting ``PDTPU_INTROSPECT_PORT`` — the Executor and
@@ -36,6 +41,7 @@ import threading
 import urllib.parse
 from typing import Callable, Dict, Optional, Tuple
 
+from . import federate
 from .flight import get_flight_recorder
 from .registry import get_registry
 from .steps import get_step_profiler
@@ -114,10 +120,27 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         path = parsed.path.rstrip("/") or "/"
         try:
             if path == "/metrics":
-                self._send(200, get_registry().prometheus_text(deep=True),
+                text = get_registry().prometheus_text(deep=True)
+                scraper = federate.get_scraper()
+                if scraper is not None and scraper.last() is not None:
+                    # coordinator /metrics carries the fleet too — each
+                    # federated series is distinct via its process label
+                    text += scraper.prometheus_text()
+                self._send(200, text,
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/metrics.json":
                 self._send_json(200, get_registry().snapshot(deep=True))
+            elif path == "/metrics/series":
+                self._send_json(200, get_registry().series(deep=True))
+            elif path == "/fleet":
+                scraper = federate.get_scraper()
+                if scraper is None:
+                    self._send(404, "no FederatedScraper installed "
+                                    "(observability.federate."
+                                    "install_scraper)\n", "text/plain")
+                else:
+                    doc = scraper.scrape_once()
+                    self._send_json(200 if doc["ok"] else 503, doc)
             elif path == "/healthz":
                 overall, detail = run_health_checks()
                 code = 200 if overall in ("ok", "degraded") else 503
@@ -136,7 +159,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send_json(200, get_flight_recorder().contents())
             elif path == "/":
                 self._send(200, "paddle_tpu introspection: /metrics "
-                                "/metrics.json /healthz /debug/steps "
+                                "/metrics.json /metrics/series /fleet "
+                                "/healthz /debug/steps "
                                 "/debug/flight\n", "text/plain")
             else:
                 self._send(404, f"no such endpoint: {path}\n", "text/plain")

@@ -30,7 +30,8 @@ import threading
 import weakref
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "Registry", "get_registry"]
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "get_registry",
+           "render_prometheus"]
 
 
 def _label_key(labels: dict) -> tuple:
@@ -300,54 +301,55 @@ class Registry:
             json.dump(snap, f, indent=2, sort_keys=True)
         return snap
 
+    def series(self, deep: bool = True) -> List[dict]:
+        """Structured export: one dict per series, JSON- and
+        PS-transport-safe (plain str/int/float/None values only), so a
+        federation scraper — HTTP ``/metrics/series`` or the pserver
+        ``metrics`` transport op — gets labels as DATA instead of
+        parsing them back out of flat ``name{k="v"}`` snapshot keys.
+
+        Shapes::
+
+            {"name", "type": "counter"|"gauge", "labels": {...}, "value"}
+            {"name", "type": "summary", "labels": {...},
+             "summary": {count, sum, mean, min, max, p50, p95, p99}}
+
+        Same merge semantics as `snapshot`/`prometheus_text`: duplicate
+        keys across attached children sum (counters/gauges) or merge at
+        the sample level (histograms)."""
+        cs, gs, hs = self._collect(deep)
+        out: List[dict] = []
+        merged_c: Dict[tuple, int] = {}
+        for key, c in cs:
+            merged_c[key] = merged_c.get(key, 0) + c.value
+        for (name, items), v in sorted(merged_c.items()):
+            out.append({"name": name, "type": "counter",
+                        "labels": dict(items), "value": v})
+        merged_g: Dict[tuple, float] = {}
+        for key, g in gs:
+            merged_g[key] = merged_g.get(key, 0.0) + g.value
+        for (name, items), v in sorted(merged_g.items()):
+            out.append({"name": name, "type": "gauge",
+                        "labels": dict(items), "value": v})
+        merged_h: Dict[tuple, list] = {}
+        for key, h in hs:
+            merged_h.setdefault(key, []).append(h._state())
+        for (name, items), states in sorted(merged_h.items()):
+            summ = _merge_hist_states(states)
+            summ["sum"] = sum(st[1] for st in states)
+            out.append({"name": name, "type": "summary",
+                        "labels": dict(items), "summary": summ})
+        return out
+
     def prometheus_text(self, deep: bool = True) -> str:
         """Prometheus text exposition format. Histograms render as
         summaries (quantile labels + _count/_sum). Metric/label names
         are sanitized to the spec charsets and label values escaped
         (backslash, double quote, newline), so hostile values like a
         feed signature ``x:f32[8,128]`` cannot produce an unscrapeable
-        page."""
-        sanitize = _prom_metric_name
-        labelstr = _prom_labelstr
-
-        cs, gs, hs = self._collect(deep)
-        lines: List[str] = []
-        merged_c: Dict[tuple, int] = {}
-        for key, c in cs:
-            merged_c[key] = merged_c.get(key, 0) + c.value
-        typed = set()
-        for (name, items), v in sorted(merged_c.items()):
-            pname = sanitize(name)
-            if pname not in typed:
-                typed.add(pname)
-                lines.append(f"# TYPE {pname} counter")
-            lines.append(f"{pname}{labelstr(items)} {v}")
-        merged_g: Dict[tuple, float] = {}
-        for key, g in gs:
-            merged_g[key] = merged_g.get(key, 0.0) + g.value
-        for (name, items), v in sorted(merged_g.items()):
-            pname = sanitize(name)
-            if pname not in typed:
-                typed.add(pname)
-                lines.append(f"# TYPE {pname} gauge")
-            lines.append(f"{pname}{labelstr(items)} {v}")
-        merged_h: Dict[tuple, list] = {}
-        for key, h in hs:
-            merged_h.setdefault(key, []).append(h._state())
-        for (name, items), states in sorted(merged_h.items()):
-            pname = sanitize(name)
-            if pname not in typed:
-                typed.add(pname)
-                lines.append(f"# TYPE {pname} summary")
-            summ = _merge_hist_states(states)
-            for q, k in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-                if summ[k] is not None:
-                    lines.append(f"{pname}{labelstr(items, [('quantile', q)])}"
-                                 f" {summ[k]}")
-            lines.append(f"{pname}_count{labelstr(items)} {summ['count']}")
-            lines.append(f"{pname}_sum{labelstr(items)} "
-                         f"{sum(st[1] for st in states)}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        page. Implemented as `render_prometheus(self.series(deep))` so
+        local and federated output share one renderer by construction."""
+        return render_prometheus(self.series(deep))
 
     def report(self, deep: bool = False) -> str:
         """Human-readable text table of the snapshot."""
@@ -374,6 +376,49 @@ class Registry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+
+
+def render_prometheus(series: List[dict], extra_labels=()) -> str:
+    """Render a `Registry.series()`-shaped list in the exposition text
+    format: ``# TYPE`` line once per metric name, counters then gauges
+    then summaries, each group sorted by (name, labels). `extra_labels`
+    ((key, value) pairs) are appended to every sample's label set — the
+    federation exporter passes ``process``/``role``/``shard`` here —
+    and go through the SAME name sanitization and value escaping as
+    local labels, so federated output cannot diverge from local output.
+    """
+    extra = tuple(extra_labels)
+    groups: Dict[str, list] = {"counter": [], "gauge": [], "summary": []}
+    for s in series:
+        t = s.get("type")
+        if t not in groups:
+            continue
+        items = _label_key(s.get("labels") or {})
+        groups[t].append(((s["name"], items), s))
+    lines: List[str] = []
+    typed = set()
+    for kind in ("counter", "gauge", "summary"):
+        for (name, items), s in sorted(groups[kind], key=lambda kv: kv[0]):
+            pname = _prom_metric_name(name)
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} {kind}")
+            if kind == "summary":
+                summ = s.get("summary") or {}
+                for q, k in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    if summ.get(k) is not None:
+                        lines.append(
+                            f"{pname}"
+                            f"{_prom_labelstr(items, extra + (('quantile', q),))}"
+                            f" {summ[k]}")
+                lines.append(f"{pname}_count{_prom_labelstr(items, extra)} "
+                             f"{summ.get('count', 0)}")
+                lines.append(f"{pname}_sum{_prom_labelstr(items, extra)} "
+                             f"{summ.get('sum', 0.0)}")
+            else:
+                lines.append(f"{pname}{_prom_labelstr(items, extra)} "
+                             f"{s.get('value', 0)}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 _default = Registry()

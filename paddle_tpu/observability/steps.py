@@ -47,6 +47,7 @@ from typing import Deque, Dict, Optional
 
 from .flight import get_flight_recorder
 from .registry import Registry, get_registry
+from .tracer import get_tracer
 
 __all__ = ["StepProfiler", "get_step_profiler"]
 
@@ -193,6 +194,13 @@ class StepProfiler:
             get_flight_recorder().note_event("warning", msg,
                                              reason=reason,
                                              step=rec["step"])
+            # instant event too: a merged fleet timeline shows WHERE the
+            # straggler detector fired, not just that a counter moved
+            iargs = {"reason": reason, "step": rec["step"],
+                     "wall_ms": rec["wall_ms"]}
+            if anomaly[3] is not None:
+                iargs["deviation"] = round(anomaly[3], 1)
+            get_tracer().instant(f"steps/{reason}", iargs)
         get_flight_recorder().note_step(rec)
         return rec
 

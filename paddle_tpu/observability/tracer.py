@@ -27,6 +27,16 @@ every B gets its E even when the body raises. Overhead per span is one
 ``perf_counter`` call and one lock-protected list append at each end;
 when the tracer is disabled (``get_tracer().enabled = False``) a span is
 a no-op.
+
+Distributed traces: when a `context.TraceContext` is active on the
+thread, `trace_span` derives a child context for its duration and stamps
+``trace_id``/``span_id``/``parent_id`` into the span's args — the keys
+``tools/timeline.py --fleet`` uses to stitch per-process traces into one
+timeline. With no active context the span records exactly as before
+(zero id-generation cost on untraced hot paths). `start_trace` roots a
+new trace (used by the fleet router per routed request and the PS tier
+per training step); `server_span` adopts an incoming RPC ``"trace"``
+header on the serving side.
 """
 from __future__ import annotations
 
@@ -37,7 +47,10 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["Tracer", "get_tracer", "trace_span"]
+from . import context as _ctx
+
+__all__ = ["Tracer", "get_tracer", "trace_span", "start_trace",
+           "server_span"]
 
 # one process-wide timebase so spans from every thread share a clock;
 # chrome trace wants microseconds
@@ -60,6 +73,9 @@ class Tracer:
         self.max_events = int(max_events)
         self.dropped = 0
         self.enabled = True
+        # shows as the track title in merged fleet timelines; worker /
+        # pserver entrypoints set their role here
+        self.process_name = "paddle_tpu host"
 
     # -- recording ---------------------------------------------------------
     def _emit(self, ev: dict) -> None:
@@ -103,7 +119,7 @@ class Tracer:
         pid = os.getpid()
         meta: List[dict] = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-             "args": {"name": "paddle_tpu host"}}]
+             "args": {"name": self.process_name}}]
         for tid, tname in sorted(names.items()):
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": tname}})
@@ -146,34 +162,95 @@ class trace_span:
     Keyword arguments become chrome-trace `args` (visible on click in the
     trace viewer). Spans nest naturally per thread; the end event is
     emitted even when the body raises.
+
+    When a distributed `TraceContext` is active on the thread, the span
+    becomes a child span of it: a derived context is activated for the
+    span's duration and its ids are stamped into the args.
     """
 
-    __slots__ = ("name", "args", "_entered")
+    __slots__ = ("name", "args", "_entered", "_ctx_token")
 
     def __init__(self, name: str, **args):
         self.name = name
         self.args = args or None
         self._entered = False
+        self._ctx_token = None
+
+    def _span_ctx(self):
+        """The context this span should record under, or None. Overridden
+        by the rooting/adopting subclasses."""
+        parent = _ctx.current()
+        return parent.child() if parent is not None else None
 
     def __enter__(self):
         t = _tracer
         if t.enabled:
+            ctx = self._span_ctx()
+            args = self.args
+            if ctx is not None:
+                self._ctx_token = _ctx._activate(ctx)
+                args = dict(args) if args else {}
+                args.update(ctx.args())
             self._entered = True
-            t.begin(self.name, self.args)
+            t.begin(self.name, args)
         return self
 
     def __exit__(self, *exc):
         if self._entered:
             self._entered = False
             _tracer.end(self.name)
+        if self._ctx_token is not None:
+            _ctx._restore(self._ctx_token)
+            self._ctx_token = None
         return False
 
     def __call__(self, fn):
         name, args = self.name, self.args or {}
+        cls = type(self)
 
         @functools.wraps(fn)
         def wrapper(*a, **kw):
-            with trace_span(name, **args):
+            with cls(name, **args):
                 return fn(*a, **kw)
 
         return wrapper
+
+
+class start_trace(trace_span):
+    """Root span of a new distributed trace: activates a fresh
+    `TraceContext` (new trace_id, no parent) for the span's duration, so
+    everything beneath it — nested spans, RPCs to pservers and fleet
+    workers, their server-side spans — shares one trace_id. If a trace
+    is already active this degrades to a plain child `trace_span`
+    (nested roots don't fork the trace)."""
+
+    __slots__ = ()
+
+    def _span_ctx(self):
+        parent = _ctx.current()
+        return parent.child() if parent is not None else _ctx.new_trace()
+
+
+class server_span(trace_span):
+    """Server-side RPC span: adopts the ``"trace"`` header dict from an
+    incoming frame (see `context.from_wire`), parenting this process's
+    span to the client's RPC span. With no/malformed header it records
+    as a plain local span.
+
+    ::
+
+        with server_span(f"ps/{op}", msg.get("trace"), op=op):
+            out = dispatch(op, msg)
+    """
+
+    __slots__ = ("_wire",)
+
+    def __init__(self, name: str, wire, **args):
+        super().__init__(name, **args)
+        self._wire = wire
+
+    def _span_ctx(self):
+        ctx = _ctx.from_wire(self._wire)
+        if ctx is None:
+            return super()._span_ctx()
+        return ctx

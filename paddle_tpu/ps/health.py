@@ -84,6 +84,10 @@ class ShardMonitor:
         reg = get_registry()
         self._gauges = [reg.gauge("ps/shard_up", shard=str(i))
                         for i in range(len(self._pingers))]
+        # the autoscaler-facing aggregate: the federation scraper reads
+        # the per-shard gauges, but a single-process consumer (or an
+        # alert rule) wants the count directly
+        self._g_down = reg.gauge("ps/shards_down")
 
     @classmethod
     def for_endpoints(cls, endpoints: Sequence[str],
@@ -108,6 +112,7 @@ class ShardMonitor:
                 elif self._down_since[i] is None:
                     self._down_since[i] = now
                 self._gauges[i].set(1.0 if up else 0.0)
+            self._g_down.set(sum(1 for up in results if not up))
             self._polled = True
         return results
 

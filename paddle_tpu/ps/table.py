@@ -52,6 +52,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..observability import get_registry
+from ..observability import context as _trace_ctx
 from .shard import EmbeddingShard, RangeSpec, make_shards
 from .transport import InProcessClient, ShardClient, TransportError
 
@@ -155,6 +156,11 @@ class ShardedTable:
         reg = get_registry()
         self._h_pull = reg.histogram("ps/pull_ms")
         self._h_push = reg.histogram("ps/push_ms")
+        # per-shard pull time, observed INSIDE the fan-out thunk so each
+        # sample is one shard's RPC (not the whole fan-out): the
+        # federation layer's per-shard p99 straggler signal (ROADMAP 5)
+        self._h_shard_pull = [reg.histogram("ps/shard_pull_ms", shard=str(i))
+                              for i in range(spec.num_shards)]
         self._c_pulled = reg.counter("ps/bytes_pulled")
         self._c_pushed = reg.counter("ps/bytes_pushed")
         self._g_journal = reg.gauge("ps/journal_bytes", table=self.name)
@@ -250,15 +256,26 @@ class ShardedTable:
                     raise
                 hook(i, e)
 
+    def _shard_pull(self, i: int, ids_chunk: np.ndarray, ctx):
+        """One shard's pull, on whatever thread the fan-out picked:
+        re-activate the caller's trace context (thread-locals don't
+        follow pool jobs) and time the shard individually."""
+        with _trace_ctx.use(ctx):
+            t0 = time.perf_counter()
+            out = self.clients[i].pull(self.name, ids_chunk)
+            self._h_shard_pull[i].observe((time.perf_counter() - t0) * 1e3)
+            return out
+
     def pull(self, sorted_uids: np.ndarray) -> np.ndarray:
         """Packed rows ``[k, lanes] uint16`` for ascending unique ids."""
         t0 = time.perf_counter()
+        ctx = _trace_ctx.current()
         ids, chunks = self._chunks(sorted_uids)
         if not chunks:
             out = np.zeros((0, self.lanes), dtype=np.uint16)
         else:
-            jobs = [(i, (lambda i=i, sl=sl: self.clients[i].pull(
-                self.name, ids[sl]))) for i, sl in chunks]
+            jobs = [(i, (lambda i=i, sl=sl: self._shard_pull(
+                i, ids[sl], ctx))) for i, sl in chunks]
             parts = self._run_recovering(jobs)
             out = (parts[0][1] if len(parts) == 1
                    else np.concatenate([r for _, r in parts], axis=0))
@@ -270,6 +287,10 @@ class ShardedTable:
         self._c_pulled.inc(nb)
         self._h_pull.observe((time.perf_counter() - t0) * 1e3)
         return out
+
+    def _shard_push(self, i: int, ids_chunk, rows_chunk, ctx):
+        with _trace_ctx.use(ctx):
+            self.push_clients[i].push(self.name, ids_chunk, rows_chunk)
 
     def push(self, sorted_uids: np.ndarray, rows: np.ndarray) -> None:
         """Scatter-set whole rows at ascending unique ids."""
@@ -283,8 +304,9 @@ class ShardedTable:
         # journal BEFORE the remote send: if the shard dies mid-push the
         # batch is already replayable
         self._journal_append(ids, rows, chunks)
-        jobs = [(i, (lambda i=i, sl=sl: self.push_clients[i].push(
-            self.name, ids[sl], rows[sl]))) for i, sl in chunks]
+        ctx = _trace_ctx.current()
+        jobs = [(i, (lambda i=i, sl=sl: self._shard_push(
+            i, ids[sl], rows[sl], ctx))) for i, sl in chunks]
         self._run_recovering(jobs)
         nb = rows.nbytes
         with self._acct:

@@ -513,7 +513,19 @@ class PsEmbeddingTier:
 
     def run_step(self, exe, prepared: _Prepared, fetch_list=None,
                  scope=None, **run_kw):
-        """One training step: swap caches in, run, push updated rows."""
+        """One training step: swap caches in, run, push updated rows.
+        The step is the root of a distributed trace: every shard pull
+        and async push it causes carries this step's trace_id over the
+        wire, so the merged fleet timeline shows one step spanning
+        worker and pserver processes."""
+        from ..observability.tracer import start_trace
+
+        with start_trace("ps/train_step"):
+            return self._run_step(exe, prepared, fetch_list, scope,
+                                  **run_kw)
+
+    def _run_step(self, exe, prepared: _Prepared, fetch_list=None,
+                  scope=None, **run_kw):
         from ..core.scope import _scope  # thread-local default scope
 
         sc = scope if scope is not None else _scope()

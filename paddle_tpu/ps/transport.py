@@ -54,6 +54,17 @@ Chaos: the server probes ``fault_point("ps.rpc")`` on every request
 connection with no reply, ``reset`` closes with an RST (``SO_LINGER 0``),
 ``delay_ms`` models a slow shard, ``crash`` is a real pserver death — so
 every client-visible failure mode is deterministically injectable.
+
+Distributed tracing: when a `observability.context.TraceContext` is
+active on the calling thread, each RPC attempt carries a ``"trace"``
+dict in the JSON header (``{"trace_id", "span_id"}`` — re-sent frames
+add ``"retry": n`` and a FRESH span_id under the SAME trace_id), the
+client records a ``ps/rpc/<op>`` span, and the server opens a
+``ps/<op>`` span parented to the client's — so one training step's pulls
+show up as one trace across worker and pserver processes. The server
+additionally answers ``metrics`` (the registry's structured
+`series()`) and ``trace_export`` (its chrome trace) ops, which is how a
+JAX-free pserver with no HTTP port gets federated.
 """
 from __future__ import annotations
 
@@ -70,7 +81,9 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..faults import InjectedNetworkFault, fault_point
+from ..observability import context as _trace_ctx
 from ..observability.registry import get_registry
+from ..observability.tracer import get_tracer, server_span
 from .shard import EmbeddingShard
 
 __all__ = ["TransportError", "ShardRestartedError", "ShardClient",
@@ -365,8 +378,27 @@ class SocketClient(ShardClient):
         msg = {"op": op, **kw}
         timeout, retries, backoff_ms = self._cfg()
         attempt = 0
+        tracer = get_tracer()
         with self._lock:
             while True:
+                # per-ATTEMPT trace header: same trace_id across a retry
+                # but a fresh span_id + retry tag, so a torn-frame re-send
+                # is visibly a second RPC in the same trace
+                span = None
+                ctx = _trace_ctx.current()
+                if ctx is not None:
+                    rctx = ctx.child()
+                    wire = rctx.to_wire()
+                    if attempt:
+                        wire["retry"] = attempt
+                    msg["trace"] = wire
+                    if tracer.enabled:
+                        sargs = dict(rctx.args(), rpc="client", op=op,
+                                     endpoint=self.endpoint)
+                        if attempt:
+                            sargs["retry"] = attempt
+                        span = f"ps/rpc/{op}"
+                        tracer.begin(span, sargs)
                 try:
                     sock = self._ensure_sock(timeout)
                     _send_msg(sock, msg)
@@ -387,6 +419,9 @@ class SocketClient(ShardClient):
                     time.sleep(min(backoff_ms * (2 ** attempt), 5000.0)
                                / 1e3)
                     attempt += 1
+                finally:
+                    if span is not None:
+                        tracer.end(span)
             inst = rep.get("inst")
             if isinstance(inst, str):
                 if self._inst is None:
@@ -425,6 +460,16 @@ class SocketClient(ShardClient):
 
     def stats(self):
         return self._call("stats")
+
+    def metrics(self):
+        """The server process's `Registry.series()` — how a pserver
+        (no HTTP port, JAX-free) joins metrics federation."""
+        return self._call("metrics")
+
+    def trace_export(self):
+        """The server process's chrome trace (``{"traceEvents": ...}``)
+        — what `tools/timeline.py --fleet` merges by trace_id."""
+        return self._call("trace_export")
 
     def ping(self):
         return bool(self._call("ping"))
@@ -509,10 +554,17 @@ class _Handler(socketserver.BaseRequestHandler):
                     threading.Thread(target=srv.stop,
                                      daemon=True).start()
                 return
+            wire = msg.get("trace")
+            sargs = {"rpc": "server", "op": str(op)}
+            if isinstance(wire, dict) and wire.get("retry"):
+                sargs["retry"] = wire["retry"]
+            t0 = time.perf_counter()
             try:
-                rep = {"out": srv.dispatch(op, msg)}
+                with server_span(f"ps/{op}", wire, **sargs):
+                    rep = {"out": srv.dispatch(op, msg)}
             except Exception as e:  # report, keep the connection alive
                 rep = {"err": f"{type(e).__name__}: {e}"}
+            srv._account(op, (time.perf_counter() - t0) * 1e3)
             rep["inst"] = srv.instance_id
             try:
                 _send_msg(sock, rep)
@@ -568,6 +620,14 @@ class ShardServer:
         with self._conn_lock:
             self._conns.pop(sock, None)
 
+    def _account(self, op, ms: float) -> None:
+        """Server-side per-op request counter + handling-time histogram:
+        the federation scraper reads these over the `metrics` op, which
+        is how per-SHARD serve time reaches the autoscaler surface."""
+        reg = get_registry()
+        reg.counter("ps/server_requests", op=str(op)).inc()
+        reg.histogram("ps/server_ms", op=str(op)).observe(ms)
+
     def dispatch(self, op: str, msg: dict):
         if op == "ping":
             return True
@@ -575,6 +635,10 @@ class ShardServer:
             return self.local.meta()
         if op == "stats":
             return self.local.stats()
+        if op == "metrics":
+            return get_registry().series(deep=True)
+        if op == "trace_export":
+            return get_tracer().export_chrome_trace()
         name = msg.get("name")
         if op in ("pull", "push") and self.delay_ms:
             time.sleep(self.delay_ms / 1e3)

@@ -40,6 +40,8 @@ import numpy as np
 from ..batcher import DEFAULT_BUCKETS, ServingError
 from ..metrics import Metrics
 from ..server import InferenceServer, QueueFullError, ServerClosedError
+from ...observability import context as _trace_ctx
+from ...observability.tracer import get_tracer
 from ...ps.transport import TransportError, _recv_msg, _send_msg
 from .registry import ModelVersion
 
@@ -128,6 +130,21 @@ class ThreadReplica:
 
     def infer(self, feed, timeout_ms=None):
         return self.submit(feed, timeout_ms=timeout_ms).result()
+
+    # -- observability ------------------------------------------------------
+    def metrics(self) -> list:
+        """This replica's serving metrics as a structured series list
+        (`Registry.series` shape) — the federation scrape surface."""
+        with self._lock:
+            srv = self._server
+        if srv is None:
+            return []
+        return srv.metrics.series(deep=True)
+
+    def trace_export(self) -> dict:
+        """Chrome-trace events from this replica's process — which for a
+        thread replica is the host process tracer."""
+        return get_tracer().export_chrome_trace()
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -298,11 +315,26 @@ class ProcessReplica:
             raise ReplicaDeadError(
                 f"replica {self.name}: worker exited "
                 f"rc={self._proc.returncode}")
+        msg = {"op": op, **kw}
+        # propagate the caller's trace into the worker process: fresh
+        # client span, trace dict in the frame header (same carrier the
+        # PS wire protocol uses)
+        span = None
+        tracer = get_tracer()
+        ctx = _trace_ctx.current()
+        if ctx is not None:
+            rctx = ctx.child()
+            msg["trace"] = rctx.to_wire()
+            if tracer.enabled:
+                span = f"fleet/rpc/{op}"
+                tracer.begin(span, dict(rctx.args(), rpc="client", op=op,
+                                        endpoint=f"127.0.0.1:{self._port}",
+                                        replica=self.name))
         s = self._conn()
         try:
             s.settimeout(timeout if timeout is not None
                          else self._rpc_timeout)
-            _send_msg(s, {"op": op, **kw})
+            _send_msg(s, msg)
             reply = _recv_msg(s)
         except TransportError:
             s.close()
@@ -311,6 +343,9 @@ class ProcessReplica:
             s.close()
             raise TransportError(f"{op}: {e}", transient=True,
                                  endpoint=f"127.0.0.1:{self._port}") from e
+        finally:
+            if span is not None:
+                tracer.end(span)
         self._idle.put(s)
         if isinstance(reply, dict) and reply.get("err"):
             raise _map_worker_error(reply)
@@ -322,12 +357,13 @@ class ProcessReplica:
         with self._olock:
             return self._outstanding
 
-    def _infer_rpc(self, feed, timeout_ms):
+    def _infer_rpc(self, feed, timeout_ms, ctx=None):
         feed = {k: np.asarray(v) for k, v in feed.items()}
         sock_timeout = (self._rpc_timeout if timeout_ms is None
                         else self._rpc_timeout + timeout_ms / 1e3)
-        reply = self._rpc("infer", feed=feed, timeout_ms=timeout_ms,
-                          timeout=sock_timeout)
+        with _trace_ctx.use(ctx):
+            reply = self._rpc("infer", feed=feed, timeout_ms=timeout_ms,
+                              timeout=sock_timeout)
         return [np.asarray(o) for o in reply["out"]]
 
     def submit(self, feed: Dict[str, np.ndarray],
@@ -338,7 +374,9 @@ class ProcessReplica:
                 f"rc={self._proc.returncode}")
         with self._olock:
             self._outstanding += 1
-        fut = self._pool.submit(self._infer_rpc, dict(feed), timeout_ms)
+        # the RPC runs on a pool thread; carry the submitter's trace over
+        fut = self._pool.submit(self._infer_rpc, dict(feed), timeout_ms,
+                                _trace_ctx.current())
 
         def done(_):
             with self._olock:
@@ -349,6 +387,17 @@ class ProcessReplica:
 
     def infer(self, feed, timeout_ms=None):
         return self.submit(feed, timeout_ms=timeout_ms).result()
+
+    # -- observability ------------------------------------------------------
+    def metrics(self) -> list:
+        """The worker process's full registry as a structured series
+        list (serving + executor + PS-client metrics live there)."""
+        return self._rpc("metrics", timeout=10.0)["series"]
+
+    def trace_export(self) -> dict:
+        """Chrome-trace events recorded inside the worker process —
+        merged across processes by ``tools/timeline.py --fleet``."""
+        return self._rpc("trace_export", timeout=30.0)["trace"]
 
     # -- lifecycle ----------------------------------------------------------
     @property

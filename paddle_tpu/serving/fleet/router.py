@@ -39,6 +39,8 @@ import numpy as np
 from ..batcher import ServingError
 from ..metrics import Metrics
 from ..server import QueueFullError, ServerClosedError
+from ...observability import context as _trace_ctx
+from ...observability.tracer import trace_span
 from ...ps.transport import TransportError
 from .replica import ReplicaDeadError
 
@@ -207,6 +209,11 @@ class FleetRouter:
         outer: Future = Future()
         attempted: set = set()
         self.metrics.counter("fleet/requests").inc()
+        # every routed request is one distributed trace: adopt the
+        # caller's context or root a fresh one here — try_next may run
+        # on a callback thread (failover), so the root is re-activated
+        # explicitly at every attempt
+        root = _trace_ctx.current() or _trace_ctx.new_trace()
 
         def try_next(last_error: Optional[Exception]) -> None:
             replica = self._pick(attempted)
@@ -216,7 +223,10 @@ class FleetRouter:
                 return
             attempted.add(replica.name)
             try:
-                inner = replica.submit(feed, timeout_ms=timeout_ms)
+                with _trace_ctx.use(root), \
+                        trace_span("fleet/route", replica=replica.name,
+                                   attempt=len(attempted)):
+                    inner = replica.submit(feed, timeout_ms=timeout_ms)
             except _FAILOVER_ERRORS as e:
                 self._suspect(replica.name)
                 self.metrics.counter("fleet/retries").inc()

@@ -12,8 +12,21 @@ Ops: ``infer`` (feed arrays → output arrays; user errors travel back as
 ``{"err", "kind"}`` and are re-raised client-side), ``health`` (the
 server's /healthz view + state), ``swap`` (warm the new version in this
 process, atomic flip, drain the old server — the in-process half of
-zero-downtime rollout), ``ping``, ``stop`` (drain, reply with the drain
-report, exit).
+zero-downtime rollout), ``ping``, ``metrics`` (this process's registry
+as a structured series list — the federation scrape surface),
+``trace_export`` (this process's chrome-trace events, merged across the
+fleet by ``tools/timeline.py --fleet``), ``stop`` (drain, reply with
+the drain report, exit).
+
+Every frame may carry a ``trace`` header dict; the handler opens a
+server-side span parented to the sender's span, so one routed request
+is one trace across router → worker → pserver.
+
+PS-backed serving: ``--ps-endpoints host:p,host:p --ps-table
+PARAM=TABLE:VOCAB[:LANES] --ps-id-feeds ids`` wraps the predictor in a
+`PsLookupPredictor` whose embedding rows live on pserver shards — the
+subprocess equivalent of handing `PsLookupBinding`s to a ThreadReplica
+factory (``--ps-cache-rows`` sizes the device-resident hot-row cache).
 
 Thread-per-connection: concurrent parent connections land in the same
 InferenceServer queue, so dynamic batching still merges them.
@@ -30,7 +43,40 @@ import threading
 import numpy as np
 
 
+def _handle_op(op, msg, replica, stop_evt, conn):
+    """Dispatch one op; returns the reply dict, or None when the op
+    already sent its reply (stop)."""
+    from ...observability.registry import get_registry
+    from ...observability.tracer import get_tracer
+    from ...ps.transport import _send_msg
+    if op == "ping":
+        return {"ok": True, "pid": os.getpid()}
+    if op == "infer":
+        feed = {k: np.asarray(v)
+                for k, v in (msg.get("feed") or {}).items()}
+        outs = replica.infer(feed, timeout_ms=msg.get("timeout_ms"))
+        return {"out": [np.asarray(o) for o in outs]}
+    if op == "health":
+        return replica.health()
+    if op == "metrics":
+        return {"series": get_registry().series(deep=True)}
+    if op == "trace_export":
+        return {"trace": get_tracer().export_chrome_trace()}
+    if op == "swap":
+        from .registry import ModelVersion
+        mv = ModelVersion(msg["version"], msg["model_dir"],
+                          msg.get("precision"), {})
+        return replica.swap(mv)
+    if op == "stop":
+        report = replica.stop()
+        _send_msg(conn, {"ok": True, "report": report})
+        stop_evt.set()
+        return None
+    return {"err": f"unknown op {op!r}", "kind": "ValueError"}
+
+
 def _serve_conn(conn, replica, stop_evt):
+    from ...observability.tracer import server_span
     from ...ps.transport import TransportError, _recv_msg, _send_msg
     try:
         while not stop_evt.is_set():
@@ -39,29 +85,15 @@ def _serve_conn(conn, replica, stop_evt):
             except TransportError:
                 return  # peer went away / torn frame: drop the connection
             op = msg.get("op") if isinstance(msg, dict) else None
+            wire = msg.get("trace") if isinstance(msg, dict) else None
             try:
-                if op == "ping":
-                    reply = {"ok": True, "pid": os.getpid()}
-                elif op == "infer":
-                    feed = {k: np.asarray(v)
-                            for k, v in (msg.get("feed") or {}).items()}
-                    outs = replica.infer(feed,
-                                         timeout_ms=msg.get("timeout_ms"))
-                    reply = {"out": [np.asarray(o) for o in outs]}
-                elif op == "health":
-                    reply = replica.health()
-                elif op == "swap":
-                    from .registry import ModelVersion
-                    mv = ModelVersion(msg["version"], msg["model_dir"],
-                                      msg.get("precision"), {})
-                    reply = replica.swap(mv)
-                elif op == "stop":
-                    report = replica.stop()
-                    _send_msg(conn, {"ok": True, "report": report})
-                    stop_evt.set()
-                    return
-                else:
-                    reply = {"err": f"unknown op {op!r}", "kind": "ValueError"}
+                # server half of the RPC span pair: adopts the parent's
+                # trace_id so a routed request is one trace end to end
+                with server_span(f"serve/{op}", wire, rpc="server",
+                                 op=str(op)):
+                    reply = _handle_op(op, msg, replica, stop_evt, conn)
+                if reply is None:
+                    return  # stop already replied
             except Exception as e:
                 reply = {"err": str(e)[:500], "kind": type(e).__name__}
             _send_msg(conn, reply)
@@ -72,6 +104,51 @@ def _serve_conn(conn, replica, stop_evt):
             conn.close()
         except OSError:
             pass
+
+
+def _ps_predictor_factory(args):
+    """Build a `predictor_factory` closing over the --ps-* flags: base
+    predictor wrapped in a PsLookupPredictor over socket shard clients.
+    Table spec grammar: ``PARAM=TABLE:VOCAB[:LANES]`` (repeatable)."""
+    from ...inference import Config, create_predictor
+    from ...inference.ps_lookup import PsLookupBinding, PsLookupPredictor
+    from ...ps.shard import RangeSpec
+    from ...ps.table import ShardedTable
+    from ...ps.transport import SocketClient
+
+    endpoints = [e.strip() for e in args.ps_endpoints.split(",")
+                 if e.strip()]
+    if not endpoints:
+        raise SystemExit("--ps-endpoints: no endpoints given")
+    specs = []
+    for spec in args.ps_table:
+        try:
+            param, rest = spec.split("=", 1)
+            parts = rest.split(":")
+            table, vocab = parts[0], int(parts[1])
+            lanes = int(parts[2]) if len(parts) > 2 else 128
+        except (ValueError, IndexError):
+            raise SystemExit(
+                f"--ps-table {spec!r}: want PARAM=TABLE:VOCAB[:LANES]")
+        specs.append((param, table, vocab, lanes))
+    id_feeds = [f.strip() for f in (args.ps_id_feeds or "ids").split(",")
+                if f.strip()]
+
+    def factory(model):
+        base = create_predictor(Config(model.model_dir),
+                                precision=model.precision)
+        bindings = []
+        for param, table, vocab, lanes in specs:
+            # each table gets its own client set: one connection per
+            # shard per table keeps the fan-outs independent
+            clients = [SocketClient(ep) for ep in endpoints]
+            st = ShardedTable(table, RangeSpec.even(vocab, len(endpoints)),
+                              clients, lanes=lanes)
+            bindings.append(PsLookupBinding(param, st, id_feeds))
+        return PsLookupPredictor(base, bindings,
+                                 cache_rows_per_table=args.ps_cache_rows)
+
+    return factory
 
 
 def main(argv=None) -> int:
@@ -86,19 +163,38 @@ def main(argv=None) -> int:
     ap.add_argument("--max-batch-delay-ms", type=float, default=2.0)
     ap.add_argument("--num-workers", type=int, default=1)
     ap.add_argument("--no-warm", action="store_true")
+    ap.add_argument("--ps-endpoints", default=None,
+                    help="host:port,host:port — pserver shards backing "
+                         "the model's embedding tables")
+    ap.add_argument("--ps-table", action="append", default=[],
+                    help="PARAM=TABLE:VOCAB[:LANES] (repeatable)")
+    ap.add_argument("--ps-id-feeds", default=None,
+                    help="comma-separated id feed names (default: ids)")
+    ap.add_argument("--ps-cache-rows", type=int, default=None,
+                    help="device-resident hot-row cache size per table")
     args = ap.parse_args(argv)
 
+    from ...observability.tracer import get_tracer
+    from ..metrics import Metrics
     from .registry import ModelVersion
     from .replica import ThreadReplica
+
+    # this process IS the replica: its serving metrics belong in the
+    # process registry (the `metrics` op scrapes it), and its trace
+    # events need a role-identifying process name for the fleet merge
+    get_tracer().process_name = f"fleet-worker:{os.getpid()}"
+    factory = _ps_predictor_factory(args) if args.ps_endpoints else None
 
     model = ModelVersion(args.version, args.model_dir, args.precision, {})
     replica = ThreadReplica(
         f"worker-{os.getpid()}", model,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         warm=not args.no_warm,
+        predictor_factory=factory,
         server_kwargs={"max_queue_size": args.max_queue_size,
                        "max_batch_delay_ms": args.max_batch_delay_ms,
-                       "num_workers": args.num_workers})
+                       "num_workers": args.num_workers,
+                       "metrics": Metrics()})
 
     lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

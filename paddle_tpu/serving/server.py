@@ -31,9 +31,11 @@ import numpy as np
 from .batcher import (DEFAULT_BUCKETS, DynamicBatcher, ServingError,
                       item_signature)
 from .metrics import Metrics
+from ..observability import context as _trace_ctx
 from ..observability.http import (maybe_serve_from_env,
                                   register_health_check,
                                   unregister_health_check)
+from ..observability.tracer import trace_span
 
 __all__ = ["InferenceServer", "QueueFullError", "Request", "ServerClosedError",
            "ServingError"]
@@ -57,16 +59,21 @@ class Request:
     batch dim of `n` rows; `future` resolves to the per-request output
     slices (list of np arrays, one per fetch)."""
 
-    __slots__ = ("feed", "n", "sig", "future", "deadline", "enqueued_at")
+    __slots__ = ("feed", "n", "sig", "future", "deadline", "enqueued_at",
+                 "ctx")
 
     def __init__(self, feed: Dict[str, np.ndarray], n: int, sig: tuple,
-                 deadline: Optional[float], enqueued_at: float):
+                 deadline: Optional[float], enqueued_at: float,
+                 ctx=None):
         self.feed = feed
         self.n = n
         self.sig = sig
         self.future: Future = Future()
         self.deadline = deadline
         self.enqueued_at = enqueued_at
+        # trace context captured at submit() so the dispatch (and any PS
+        # pulls under it) joins the submitter's distributed trace
+        self.ctx = ctx
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -334,7 +341,8 @@ class InferenceServer:
         timeout = (self.default_timeout if timeout_ms is None
                    else float(timeout_ms) / 1e3)
         req = Request(feed, n, item_signature(feed),
-                      None if timeout is None else now + timeout, now)
+                      None if timeout is None else now + timeout, now,
+                      ctx=_trace_ctx.current())
         with self._cond:
             if self._closed:
                 raise ServerClosedError("server is stopped")
@@ -417,8 +425,17 @@ class InferenceServer:
             with self._cond:
                 self._inflight.update(live)
             t0 = time.monotonic()
+            # adopt one request's trace for the batch dispatch — a batch
+            # serves many requests but a span tree needs one parent; the
+            # group-opener's context wins, and every PS pull under the
+            # dispatch inherits it across the socket
+            ctx = next((r.ctx for r in live if r.ctx is not None), None)
             try:
-                batcher.dispatch(live)
+                with _trace_ctx.use(ctx), \
+                        trace_span("serving/dispatch",
+                                   batch=sum(r.n for r in live),
+                                   requests=len(live)):
+                    batcher.dispatch(live)
             finally:
                 with self._cond:
                     self._inflight.difference_update(live)

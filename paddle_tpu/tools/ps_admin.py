@@ -31,7 +31,14 @@ Commands:
   per-shard up flags, and the endpoint list — what the in-process
   ``/healthz`` check ``ps/shards`` reports, minus the wedge timer
   (a one-shot CLI has no down-since history). Includes the same
-  ``hot_cache`` block as ``stats``.
+  ``hot_cache`` block as ``stats``;
+* ``fleet``       — ONE federated scrape of the whole system: every
+  pserver endpoint (transport ``metrics`` op) plus every worker/replica
+  introspection server given via ``--workers http://h:p,...``
+  (``/metrics/series``). Prints a per-process table (role, reachability,
+  scrape latency, series count, queue depth / pull p99 where present)
+  and the derived ``autoscale/*`` signals; ``--json`` prints the full
+  ``/fleet`` document. Exit 1 when ANY scrape failed.
 
 The hot-row cache lives in the WORKER process, not on the shards, so
 its ``ps/cache_*`` series come from the worker's introspection plane:
@@ -100,6 +107,52 @@ def cache_fields(worker: str = "", timeout: float = 2.0):
     return out
 
 
+def _series_get(series, name, field="value"):
+    """First series named `name`: its value (counter/gauge) or the
+    given summary field; None when the process has no such series."""
+    for s in series:
+        if s.get("name") != name:
+            continue
+        if s.get("type") == "summary":
+            return (s.get("summary") or {}).get(field)
+        return s.get("value")
+    return None
+
+
+def fleet_scrape(endpoints, workers, timeout: float = 2.0) -> dict:
+    """One federated sweep over pserver endpoints + worker introspection
+    URLs; returns the ``/fleet`` document (see observability.federate)."""
+    from ..observability.federate import FederatedScraper, ScrapeTarget
+
+    targets = [ScrapeTarget.ps(ep, shard=i) for i, ep in
+               enumerate(endpoints)]
+    targets += [ScrapeTarget.http(url) for url in workers]
+    return FederatedScraper(targets, timeout=timeout).scrape_once()
+
+
+def format_fleet(doc: dict) -> str:
+    """The per-process table + signal block for ``fleet``."""
+    lines = [f"{'process':<28}{'role':<10}{'shard':>6}{'state':>8}"
+             f"{'scrape_ms':>11}{'series':>8}{'queue':>7}"
+             f"{'pull_p99_ms':>12}"]
+    for r in doc["targets"]:
+        q = _series_get(r["series"], "serving/queue_depth")
+        p99 = _series_get(r["series"], "ps/shard_pull_ms", field="p99")
+        lines.append(
+            f"{r['process']:<28}{r['role']:<10}"
+            f"{'-' if r['shard'] is None else r['shard']:>6}"
+            f"{'up' if r['ok'] else 'DOWN':>8}"
+            f"{r['scrape_ms']:>11.1f}{len(r['series']):>8}"
+            f"{'-' if q is None else int(q):>7}"
+            f"{'-' if p99 is None else round(p99, 2):>12}")
+        if not r["ok"]:
+            lines.append(f"    error: {r['error']}")
+    sig = doc.get("signals") or {}
+    lines.append("")
+    lines.append("autoscaler signals: " + json.dumps(sig, sort_keys=True))
+    return "\n".join(lines)
+
+
 def _ask(endpoint: str, op: str, timeout: float):
     """(ok, payload-or-error) for one shard, single attempt."""
     from ..ps.transport import SocketClient
@@ -119,7 +172,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ps_admin",
         description="inspect a running PS shard fleet")
-    ap.add_argument("cmd", choices=["ping", "stats", "meta", "dump-health"])
+    ap.add_argument("cmd", choices=["ping", "stats", "meta", "dump-health",
+                                    "fleet"])
     ap.add_argument("--endpoints", default="",
                     help="comma-separated host:port list (default: "
                          "PADDLE_PSERVER_ENDPOINTS)")
@@ -129,9 +183,29 @@ def main(argv=None) -> int:
                     help="worker introspection base URL (http://host:port)"
                          " for the hot-row-cache fields; default: this "
                          "process's registry")
+    ap.add_argument("--workers", default="",
+                    help="fleet: comma-separated worker/replica "
+                         "introspection base URLs to scrape alongside "
+                         "the pserver endpoints")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (dump-health always is)")
     args = ap.parse_args(argv)
+
+    if args.cmd == "fleet":
+        workers = [w.strip() for w in args.workers.split(",") if w.strip()]
+        try:
+            eps = _endpoints(args.endpoints)
+        except SystemExit:
+            if not workers:  # a fleet needs SOMETHING to scrape
+                raise
+            eps = []
+        doc = fleet_scrape(eps, workers, timeout=args.timeout)
+        if args.json:
+            print(json.dumps(doc, sort_keys=True, default=str))
+        else:
+            print(format_fleet(doc))
+        return 0 if doc["ok"] else 1
+
     eps = _endpoints(args.endpoints)
 
     def _cache():

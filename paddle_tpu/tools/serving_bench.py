@@ -28,6 +28,12 @@ cache-hit/compile-time metrics) and ``--trace-out t.json`` writes the
 host tracer's chrome-trace of the run, so BENCH rounds carry cache and
 compile telemetry alongside the throughput numbers for free
 (``python -m paddle_tpu.tools.timeline t.json --summary`` to read it).
+In fleet runs (``--replicas > 1``) the sidecars widen to the whole
+fleet: ``--metrics-out`` gains a ``bench/fleet_federated`` block (one
+federated scrape across coordinator + every replica, with the derived
+``autoscale/*`` signals) and ``--trace-out`` becomes the MERGED
+cross-process timeline — per-replica traces clock-aligned against the
+coordinator with client→server flow arrows (merge_fleet_traces).
 """
 from __future__ import annotations
 
@@ -162,11 +168,18 @@ def bench_served(predictor, rows: List[np.ndarray], concurrency: int = 32,
 def bench_fleet(model_dir: str, rows: List[np.ndarray], replicas: int = 3,
                 concurrency: int = 32, buckets=(1, 2, 4, 8, 16, 32),
                 batch_delay_ms: float = 2.0, mode: str = "thread",
-                env=None) -> dict:
+                env=None, collect_telemetry: bool = False) -> dict:
     """Closed-loop drive of a ServingFleet: `concurrency` client threads
     racing the request list through the router (least-outstanding). The
     multi-replica analog of bench_served — same latency accounting, so
-    the 1-vs-N rows compare directly."""
+    the 1-vs-N rows compare directly.
+
+    ``collect_telemetry`` additionally performs, before the fleet is
+    torn down, (a) one federated metrics scrape across this process and
+    every replica and (b) a per-process trace export — what
+    ``--metrics-out``/``--trace-out`` write in fleet runs (the trace
+    sidecar is then the MERGED timeline, clock-aligned, with flow
+    arrows; see tools.timeline.merge_fleet_traces)."""
     from paddle_tpu.serving import fleet as fleet_mod
 
     reg = fleet_mod.ModelRegistry()
@@ -205,6 +218,9 @@ def bench_fleet(model_dir: str, rows: List[np.ndarray], replicas: int = 3,
             t.join()
         wall = time.monotonic() - t0
         stats = fl.stats()
+        federated, traces = None, None
+        if collect_telemetry:
+            federated, traces = _collect_fleet_telemetry(fl)
     out = _summarize(f"fleet(n={replicas},c={concurrency})",
                      len(rows) - errors[0], wall,
                      [x for x in lats if x > 0])
@@ -212,7 +228,37 @@ def bench_fleet(model_dir: str, rows: List[np.ndarray], replicas: int = 3,
     out["replicas"] = replicas
     out["fleet"] = {"mode": stats["mode"],
                     "metrics": stats["router"]["metrics"]}
+    if federated is not None:
+        out["fleet"]["federated"] = federated
+    if traces is not None:
+        out["fleet"]["traces"] = traces
     return out
+
+
+def _collect_fleet_telemetry(fl):
+    """(federated /fleet doc, [(name, chrome-trace), ...]) for a live
+    fleet: coordinator + every replica, per-target failures recorded in
+    the doc rather than raised."""
+    from paddle_tpu.observability import get_tracer
+    from paddle_tpu.observability.federate import (FederatedScraper,
+                                                   ScrapeTarget)
+
+    targets = [ScrapeTarget.local()]
+    for r in fl.replicas:
+        targets.append(ScrapeTarget.call(
+            r.metrics, name=r.name, role=f"replica-{r.kind}"))
+    doc = FederatedScraper(targets).scrape_once()
+    traces = [("coordinator", get_tracer().export_chrome_trace())]
+    for r in fl.replicas:
+        # a thread replica's trace IS the coordinator trace; exporting
+        # it again would duplicate every event on a second track
+        if r.kind != "process":
+            continue
+        try:
+            traces.append((r.name, r.trace_export()))
+        except Exception:
+            pass  # a dead replica has no trace to contribute
+    return doc, traces
 
 
 def _summarize(mode: str, n: int, wall: float, lats: List[float]) -> dict:
@@ -310,7 +356,9 @@ def main(argv=None) -> int:
         flt = bench_fleet(model_dir, rows, replicas=args.replicas,
                           concurrency=args.concurrency, buckets=buckets,
                           batch_delay_ms=args.batch_delay_ms,
-                          mode=args.fleet_mode)
+                          mode=args.fleet_mode,
+                          collect_telemetry=bool(args.metrics_out
+                                                 or args.trace_out))
         print(percentile_row(flt))
     print()
     bs = served["metrics"].get("serving/batch_rows") or {}
@@ -332,13 +380,26 @@ def main(argv=None) -> int:
             snap["bench/introspection"] = scrape
         if seq is not None:
             snap["bench/sequential"] = seq
+        if flt is not None and flt["fleet"].get("federated"):
+            # the whole fleet's series, per process, + autoscale signals
+            snap["bench/fleet_federated"] = flt["fleet"]["federated"]
         with open(args.metrics_out, "w") as f:
             json.dump(snap, f, indent=2, sort_keys=True)
         print(f"wrote registry snapshot to {args.metrics_out}")
     if args.trace_out:
         from paddle_tpu.observability import get_tracer
 
-        trace = get_tracer().export_chrome_trace(args.trace_out)
+        fleet_traces = (flt["fleet"].get("traces")
+                        if flt is not None else None)
+        if fleet_traces and len(fleet_traces) > 1:
+            from paddle_tpu.tools.timeline import merge_fleet_traces
+
+            trace = merge_fleet_traces([t for _, t in fleet_traces],
+                                       [n for n, _ in fleet_traces])
+            with open(args.trace_out, "w") as f:
+                json.dump(trace, f)
+        else:
+            trace = get_tracer().export_chrome_trace(args.trace_out)
         print(f"wrote {args.trace_out} "
               f"({len(trace['traceEvents'])} events) — load in "
               f"chrome://tracing or ui.perfetto.dev")

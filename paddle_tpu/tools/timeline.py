@@ -23,6 +23,11 @@ chrome://tracing or https://ui.perfetto.dev:
     # per-span totals (count / total / avg / max ms), sorted like the
     # reference profiler summary
     python -m paddle_tpu.tools.timeline host.json --summary
+
+    # one fleet, many processes: align per-process clocks from RPC span
+    # pairs and draw client->server flow arrows (see merge_fleet_traces)
+    python -m paddle_tpu.tools.timeline --fleet \\
+        coordinator.json worker0.json pserver0.json --out fleet.json
 """
 from __future__ import annotations
 
@@ -33,8 +38,8 @@ import os
 from typing import Dict, List, Optional
 
 __all__ = ["find_xplanes", "xplane_to_chrome_trace", "load_trace",
-           "merge_traces", "summarize", "format_summary",
-           "format_flight", "main"]
+           "merge_traces", "merge_fleet_traces", "summarize",
+           "format_summary", "format_flight", "main"]
 
 
 def find_xplanes(logdir: str) -> List[str]:
@@ -119,6 +124,168 @@ def merge_traces(traces: List[dict],
             if pid not in renamed:
                 out.append({"name": "process_name", "ph": "M", "pid": pid,
                             "tid": 0, "args": {"name": f"{src} (pid {old})"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# -- fleet merge -------------------------------------------------------------
+
+def _spans(trace: dict, index: int) -> List[dict]:
+    """Pair B/E events per (pid, tid) into spans: {name, ts, dur, args,
+    pid, tid, trace: index}. Stray E events are dropped; an unclosed B
+    becomes a zero-duration span (a process that died mid-span still
+    shows where it was)."""
+    stacks: Dict[tuple, list] = {}
+    spans: List[dict] = []
+    events = [ev for ev in trace.get("traceEvents", [])
+              if ev.get("ph") in ("B", "E")]
+    events.sort(key=lambda ev: ev.get("ts", 0))
+    for ev in events:
+        key = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(key, [])
+        if ev.get("ph") == "B":
+            stack.append(ev)
+        elif stack:
+            b = stack.pop()
+            spans.append({"name": b.get("name", "?"),
+                          "ts": float(b.get("ts", 0)),
+                          "dur": float(ev.get("ts", 0)) - float(
+                              b.get("ts", 0)),
+                          "args": b.get("args") or {},
+                          "pid": b.get("pid"), "tid": b.get("tid"),
+                          "trace": index})
+    for stack in stacks.values():
+        for b in stack:
+            spans.append({"name": b.get("name", "?"),
+                          "ts": float(b.get("ts", 0)), "dur": 0.0,
+                          "args": b.get("args") or {},
+                          "pid": b.get("pid"), "tid": b.get("tid"),
+                          "trace": index})
+    return spans
+
+
+def _rpc_pairs(all_spans: List[dict]) -> List[tuple]:
+    """(client_span, server_span) pairs: a server-side RPC span
+    (args.rpc == "server") whose parent_id is a client RPC span's
+    span_id in the same distributed trace_id."""
+    clients: Dict[tuple, dict] = {}
+    for s in all_spans:
+        a = s["args"]
+        if a.get("rpc") == "client" and a.get("span_id"):
+            clients[(a.get("trace_id"), a["span_id"])] = s
+    pairs = []
+    for s in all_spans:
+        a = s["args"]
+        if a.get("rpc") != "server" or not a.get("parent_id"):
+            continue
+        c = clients.get((a.get("trace_id"), a["parent_id"]))
+        if c is not None and c["trace"] != s["trace"]:
+            pairs.append((c, s))
+    return pairs
+
+
+def _clock_offsets(n_traces: int, pairs: List[tuple]) -> List[float]:
+    """Per-trace clock offset (µs) from RPC send/recv pairs, NTP-style:
+    a server span is causally inside its client span, so for each pair
+    theta = ((s0 - c0) + (s1 - c1)) / 2 estimates the server clock's
+    lead over the client clock (symmetric-delay assumption). Offsets are
+    averaged per trace-pair edge and chained by BFS from the reference
+    trace (index 0); unreachable traces keep offset 0."""
+    edges: Dict[tuple, list] = {}
+    for c, s in pairs:
+        c0, c1 = c["ts"], c["ts"] + c["dur"]
+        s0, s1 = s["ts"], s["ts"] + s["dur"]
+        theta = ((s0 - c0) + (s1 - c1)) / 2.0
+        edges.setdefault((c["trace"], s["trace"]), []).append(theta)
+    adj: Dict[int, list] = {}
+    for (i, j), thetas in edges.items():
+        mean = sum(thetas) / len(thetas)
+        adj.setdefault(i, []).append((j, mean))
+        adj.setdefault(j, []).append((i, -mean))
+    offsets = [0.0] * n_traces
+    seen = {0}
+    frontier = [0]
+    while frontier:
+        nxt = []
+        for i in frontier:
+            for j, theta in adj.get(i, []):
+                if j in seen:
+                    continue
+                seen.add(j)
+                offsets[j] = offsets[i] + theta
+                nxt.append(j)
+        frontier = nxt
+    return offsets
+
+
+def merge_fleet_traces(traces: List[dict],
+                       names: Optional[List[str]] = None) -> dict:
+    """Merge per-process chrome traces from one fleet into a single
+    aligned timeline.
+
+    Each process's tracer timestamps are relative to its own
+    ``perf_counter`` start, so raw merging scatters one request's spans
+    across the whole time axis. This merge (1) estimates each trace's
+    clock offset against the first trace from matched client/server RPC
+    span pairs (same trace_id, server parent_id == client span_id) and
+    shifts its events onto the common clock, (2) remaps pids so every
+    process gets its own track (named by its tracer ``process_name``),
+    and (3) draws chrome-trace flow arrows (s/f events, cat "rpc") from
+    each client RPC span to the server span it caused — in the viewer a
+    routed request reads as one connected path through router, replica,
+    and pserver tracks."""
+    all_spans: List[dict] = []
+    for i, t in enumerate(traces):
+        all_spans.extend(_spans(t, i))
+    pairs = _rpc_pairs(all_spans)
+    offsets = _clock_offsets(len(traces), pairs)
+
+    out: List[dict] = []
+    next_pid = [0]
+    pid_maps: List[Dict[object, int]] = []
+    for i, trace in enumerate(traces):
+        src = names[i] if names and i < len(names) else f"proc{i}"
+        pid_map: Dict[object, int] = {}
+        pid_maps.append(pid_map)
+
+        def mapped(old):
+            if old not in pid_map:
+                pid_map[old] = next_pid[0]
+                next_pid[0] += 1
+            return pid_map[old]
+
+        renamed = set()
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            pid = mapped(ev.get("pid", 0))
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) - offsets[i]
+            if (ev.get("ph") == "M" and ev.get("name") == "process_name"
+                    and pid not in renamed):
+                renamed.add(pid)
+                old_name = (ev.get("args") or {}).get("name", "")
+                ev["args"] = {"name": f"{src}: {old_name}".rstrip(": ")}
+            out.append(ev)
+        for old, pid in pid_map.items():
+            if pid not in renamed:
+                out.append({"name": "process_name", "ph": "M", "pid": pid,
+                            "tid": 0, "args": {"name": f"{src} (pid "
+                                                       f"{old})"}})
+    # flow arrows client -> server, one per RPC pair; the id is the
+    # client RPC span_id (unique per attempt, so retries get their own
+    # arrows). ts is nudged inside the span so the viewer binds the
+    # arrow to the enclosing slice.
+    for c, s in pairs:
+        fid = str(c["args"]["span_id"])
+        out.append({"name": "rpc", "cat": "rpc", "ph": "s", "id": fid,
+                    "pid": pid_maps[c["trace"]].get(c["pid"], 0),
+                    "tid": c["tid"],
+                    "ts": c["ts"] - offsets[c["trace"]] + 0.01})
+        out.append({"name": "rpc", "cat": "rpc", "ph": "f", "bp": "e",
+                    "id": fid,
+                    "pid": pid_maps[s["trace"]].get(s["pid"], 0),
+                    "tid": s["tid"],
+                    "ts": s["ts"] - offsets[s["trace"]] + 0.01})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
@@ -232,6 +399,11 @@ def main(argv: Optional[List[str]] = None):
                          "(default timeline.json unless --summary only)")
     ap.add_argument("--summary", action="store_true",
                     help="print per-span totals sorted by total time")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat the inputs as per-process traces of ONE "
+                         "fleet: align clocks via RPC span pairs, give "
+                         "each process its own named track, draw flow "
+                         "arrows from client to server RPC spans")
     ap.add_argument("--flight",
                     help="render a flight-recorder dump JSON "
                          "(observability.flight / PDTPU_FLIGHT_DIR) as a "
@@ -254,7 +426,11 @@ def main(argv: Optional[List[str]] = None):
         traces.append(xplane_to_chrome_trace(find_xplanes(args.logdir)))
         names.append(os.path.basename(args.logdir.rstrip("/")) or "xplane")
 
-    merged = traces[0] if len(traces) == 1 else merge_traces(traces, names)
+    if args.fleet:
+        merged = merge_fleet_traces(traces, names)
+    else:
+        merged = (traces[0] if len(traces) == 1
+                  else merge_traces(traces, names))
     out_path = args.out
     if out_path is None and not args.summary:
         out_path = "timeline.json"
