@@ -1788,6 +1788,164 @@ def _bench_fleet_observability_arm(workdir, on_tpu):
             p.wait()
 
 
+def bench_online_learning(on_tpu):
+    """Streaming online learning (ISSUE 14, paddle_tpu.streaming): one
+    process trains a CTR model from an endless skewed stream through
+    dynamic-vocab PS shards while a PsLookupPredictor serves lookups
+    against the SAME tables. Reported: throughput, the AUC trajectory
+    scored THROUGH the serving predictor (post-delta-push bytes), vocab
+    churn (rows materialized/evicted per minute inside a slab smaller
+    than the id space), incremental-checkpoint bytes vs the full save
+    they chain on, and delta-push staleness p50/p99 vs the budget."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers
+    from paddle_tpu.initializer import RowPackInitializer
+    from paddle_tpu.param_attr import ParamAttr
+    from paddle_tpu.parallel.checkpoint import Checkpointer
+    from paddle_tpu.ps import (InProcessClient, PsEmbeddingTier,
+                               PsTableBinding, RangeSpec, ShardedTable,
+                               make_dynamic_shards)
+    from paddle_tpu.streaming import (DeltaPublisher, OnlineTrainer,
+                                      StreamingDataset, eval_auc)
+
+    vocab, cap_per_shard, steps, batch = ((200_000, 16_384, 600, 256)
+                                          if on_tpu
+                                          else (8_000, 768, 400, 16))
+    fields, d, mult = 8, 8, 2
+    rows_per_step = batch * fields
+    hot_ids = max(64, vocab // 40)
+    staleness_s = 1.0
+
+    rng = np.random.RandomState(17)
+    w = rng.uniform(-1.0, 1.0, vocab)
+
+    def source():
+        g = np.random.RandomState(18)
+        while True:
+            if g.uniform() < 0.9:
+                ids = g.randint(0, hot_ids, fields)
+            else:
+                ids = g.randint(0, vocab, fields)
+            lbl = 1.0 if w[ids].sum() > 0 else 0.0
+            yield {"ids": ids.astype("int64"),
+                   "lbl": np.array([lbl], "float32")}
+
+    def build(vocab_rows, train):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", [fields], dtype="int64")
+            emb = layers.embedding(
+                ids, [vocab_rows, d * mult], is_sparse=True, row_pack=True,
+                param_attr=ParamAttr(name="ol_t",
+                                     initializer=RowPackInitializer(
+                                         d, d * mult, -0.01, 0.01)))
+            emb = layers.slice(emb, axes=[2], starts=[0], ends=[d])
+            score = layers.reshape(layers.reduce_sum(emb, dim=[1, 2]),
+                                   [-1, 1])
+            if not train:
+                return main, startup, ids, score
+            lbl = layers.data("lbl", [1], dtype="float32")
+            loss = layers.mean(layers.square_error_cost(score, lbl))
+            fluid.optimizer.Adagrad(
+                0.1,
+                packed_rows={"rows_per_step": rows_per_step}).minimize(loss)
+        return main, startup, None, loss
+
+    workdir = tempfile.mkdtemp(prefix="pdtpu_online_")
+    spec = RangeSpec.even(vocab, 2)
+    shards = make_dynamic_shards("ol_t", spec,
+                                 capacity_per_shard=cap_per_shard,
+                                 high_watermark=0.9, low_watermark=0.7,
+                                 keep_freq=3)
+    table = ShardedTable("ol_t", spec,
+                         [InProcessClient([s]) for s in shards])
+    try:
+        # serving half: saved inference model + PS-backed predictor fed
+        # by the delta stream
+        imain, istart, iids, iscore = build(rows_per_step, train=False)
+        iexe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            iexe.run(istart)
+            fluid.io.save_inference_model(
+                os.path.join(workdir, "m"), ["ids"], [iscore], iexe, imain)
+        base = inference.create_predictor(
+            inference.Config(os.path.join(workdir, "m")))
+        ps = inference.PsLookupPredictor(
+            base, [inference.PsLookupBinding("ol_t", table, ["ids"])],
+            cache_rows_per_table=2 * cap_per_shard)
+        pub = DeltaPublisher(table, staleness_s=staleness_s)
+        pub.attach_predictor(ps)
+
+        ds = StreamingDataset(source, batch_size=batch, held_out_every=7,
+                              eval_window=64 * batch)
+        main, startup, _, loss = build(rows_per_step, train=True)
+        exe = fluid.Executor(fluid.TPUPlace() if on_tpu
+                             else fluid.CPUPlace())
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(startup)
+            ck = Checkpointer(os.path.join(workdir, "ck"), keep=4)
+            ck.save(0, program=main, scope=sc, blocking=True,
+                    ps_tables={"ol_t": table})
+            tier = PsEmbeddingTier(
+                main, [PsTableBinding("ol_t", table, ["ids"])],
+                pull_ahead=1, push_depth=0)
+            trainer = OnlineTrainer(
+                exe, main, tier, ds, fetch_list=[loss], scope=sc,
+                ps_tables={"ol_t": table}, checkpointer=ck,
+                publishers=[pub],
+                sweep_every=max(10, steps // 10),
+                delta_every=max(10, steps // 8), compact_every=4,
+                eval_every=max(10, steps // 8),
+                eval_fn=lambda: eval_auc(
+                    ds, lambda f: ps.run({"ids": f["ids"]})[0], "lbl"))
+            t0 = time.time()
+            try:
+                trainer.run(max_steps=steps)
+                trainer.finish()
+            finally:
+                elapsed = time.time() - t0
+                tier.close()
+                pub.close()
+
+        sstats = [s.stats() for s in shards]
+        mat = sum(s["materialized"] for s in sstats)
+        evicted = sum(s["evicted"] for s in sstats)
+        live = sum(s["live_rows"] for s in sstats)
+        full_b = sum(os.path.getsize(os.path.join(workdir, "ck", f))
+                     for f in os.listdir(os.path.join(workdir, "ck"))
+                     if f.startswith("ckpt-") and f.endswith(".pkl"))
+        deltas = [os.path.getsize(os.path.join(workdir, "ck", f))
+                  for f in os.listdir(os.path.join(workdir, "ck"))
+                  if f.startswith("delta-") and f.endswith(".pkl")]
+        aucs = [(s, round(v, 4))
+                for s, v in trainer.history["eval"]
+                if not np.isnan(v)]
+        return {
+            "steps": trainer.step,
+            "rate": round(steps * batch / elapsed, 1),
+            "auc_trajectory": aucs,
+            "auc_final": aucs[-1][1] if aucs else None,
+            "vocab_ids_seen": int(mat),
+            "provisioned_rows": 2 * cap_per_shard,
+            "live_rows": int(live),
+            "rows_materialized_per_min": round(mat * 60.0 / elapsed, 1),
+            "rows_evicted_per_min": round(evicted * 60.0 / elapsed, 1),
+            "delta_saves": len(deltas),
+            "delta_bytes_avg": (int(np.mean(deltas)) if deltas else None),
+            "full_bytes": int(full_b),
+            "delta_vs_full": (round(np.mean(deltas) / full_b, 4)
+                              if deltas and full_b else None),
+            "staleness_ms": pub.staleness_percentiles(),
+            "staleness_budget_ms": staleness_s * 1e3,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     import jax
 
@@ -1968,6 +2126,16 @@ def main():
     except Exception as e:  # pragma: no cover
         extras2["serving_fleet"] = {"error": str(e)[:120]}
     _end_section(extras2, "serving_fleet")
+
+    # streaming online learning: train-from-stream + dynamic vocab +
+    # delta checkpoints + delta push to serving, in one process (ISSUE
+    # 14) — AUC through serving bytes, vocab churn, delta-vs-full size,
+    # staleness percentiles
+    try:
+        extras2["online_learning"] = bench_online_learning(on_tpu)
+    except Exception as e:  # pragma: no cover
+        extras2["online_learning"] = {"error": str(e)[:120]}
+    _end_section(extras2, "online_learning")
 
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms

@@ -101,6 +101,19 @@ class RowCache:
             self._store[s] = rows[j]
             self._lru.touch(u)
 
+    def update(self, uids: np.ndarray, rows: np.ndarray) -> int:
+        """Refresh rows already resident (delta-push path): a uid the
+        cache doesn't hold is skipped — never inserted — so a publisher
+        streaming the whole training working set can't evict the rows
+        this replica's requests actually touch. Returns #refreshed."""
+        n = 0
+        for j, u in enumerate(np.asarray(uids).tolist()):
+            s = self._slots.get(u)
+            if s is not None:
+                self._store[s] = rows[j]
+                n += 1
+        return n
+
     def clear(self) -> None:
         self._slots.clear()
         self._lru.clear()
@@ -250,6 +263,25 @@ class PsLookupPredictor:
             feed2, overrides = self._localize(feed)
             self._apply(overrides)
             return self._pred.run_padded(feed2, batch_size)
+
+    def apply_delta(self, table_name: str, uids: np.ndarray,
+                    rows: np.ndarray) -> int:
+        """Online-learning delta push: overwrite the cached copies of
+        `uids` with freshly-trained `rows` for every binding backed by
+        `table_name`. Resident rows are refreshed in place; absent rows
+        are left to fault in on the next request (the table already holds
+        the new bytes, so the pull is coherent). Returns #rows refreshed
+        — the staleness window for a cached row is the publisher's flush
+        cadence, not checkpoint cadence."""
+        uids = np.asarray(uids, np.int64)
+        rows = np.asarray(rows, np.uint16)
+        n = 0
+        with self._lock:
+            for b in self._bindings:
+                if getattr(b.table, "name", None) != table_name:
+                    continue
+                n += self._caches[b.param].update(uids, rows)
+        return n
 
     # -- introspection -------------------------------------------------------
     def invalidate(self) -> None:

@@ -206,6 +206,11 @@ class Checkpointer:
         self._error: Optional[tuple] = None
         self._current_path: Optional[str] = None
         self.last_extra: Dict[str, object] = {}
+        # incremental-checkpoint chain head: the committed full save (or
+        # restore) deltas extend — {"step": int, "marks": {table: mark}}.
+        # Advanced only by on-commit callbacks / restore, so an aborted
+        # write never becomes a delta base.
+        self._ps_base: Optional[Dict[str, object]] = None
         os.makedirs(dirname, exist_ok=True)
 
     def _path(self, step: int) -> str:
@@ -365,7 +370,11 @@ class Checkpointer:
                 for f in os.listdir(self.dirname):
                     if (f.startswith(f"ckpt-{s}.shards-")
                             or f.startswith(f"ckpt-{s}.index-")
-                            or f.startswith(f"ckpt-{s}.manifest-")):
+                            or f.startswith(f"ckpt-{s}.manifest-")
+                            # a delta chain is anchored to its base full
+                            # save: once the base is gone the chain can
+                            # never replay
+                            or f.startswith(f"delta-{s}-")):
                         try:
                             os.remove(os.path.join(self.dirname, f))
                         except OSError:
@@ -444,6 +453,227 @@ class Checkpointer:
         return [s for s in sorted(self.all_steps(), reverse=True)
                 if not self.verify(s)]
 
+    # -- incremental (delta) checkpoints ------------------------------------
+    def _delta_path(self, base: int, dstep: int) -> str:
+        return os.path.join(self.dirname, f"delta-{base}-{dstep}.pkl")
+
+    def _delta_manifest_path(self, base: int, dstep: int) -> str:
+        return os.path.join(self.dirname,
+                            f"delta-{base}-{dstep}.manifest.json")
+
+    def delta_steps(self, base: int) -> List[int]:
+        """Delta steps on disk anchored to full checkpoint `base`,
+        ascending (the chain replay order)."""
+        out = []
+        prefix = f"delta-{base}-"
+        for f in os.listdir(self.dirname):
+            if f.startswith(prefix) and f.endswith(".pkl"):
+                try:
+                    out.append(int(f[len(prefix):-len(".pkl")]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def verify_delta(self, base: int, dstep: int) -> List[str]:
+        """Manifest check (existence, size, SHA-256) for one delta file;
+        [] when it verifies. A delta with no manifest is uncommitted."""
+        problems: List[str] = []
+        mpath = self._delta_manifest_path(base, dstep)
+        try:
+            with open(mpath) as f:
+                listed = json.load(f)["files"]
+        except (OSError, ValueError, KeyError) as e:
+            return [f"{os.path.basename(mpath)}: unreadable manifest "
+                    f"({type(e).__name__}: {e})"]
+        for bname, ent in sorted(listed.items()):
+            p = os.path.join(self.dirname, bname)
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                problems.append(f"{bname}: listed in manifest but missing")
+                continue
+            if int(ent.get("bytes", -1)) != size:
+                problems.append(f"{bname}: size {size} != manifest's "
+                                f"{ent.get('bytes')} (torn write)")
+                continue
+            digest, _ = _hash_file(p)
+            if digest != ent.get("sha256"):
+                problems.append(f"{bname}: sha256 mismatch (corrupt)")
+        return problems
+
+    def _delta_chain(self, base: int) -> List[dict]:
+        """The longest verifiable prefix of `base`'s delta chain, as
+        loaded payload dicts in ascending delta-step order. The walk
+        stops at the first unverifiable/unreadable file: every delta
+        after a hole is built over state the restore cannot reconstruct,
+        so applying it would be silently lossy."""
+        chain: List[dict] = []
+        for ds in self.delta_steps(base):
+            bad = self.verify_delta(base, ds)
+            payload = None
+            if not bad:
+                try:
+                    with open(self._delta_path(base, ds), "rb") as f:
+                        payload = pickle.load(f)
+                except (OSError, EOFError, ValueError,
+                        pickle.UnpicklingError) as e:
+                    bad = [f"{type(e).__name__}: {e}"]
+            if bad:
+                warnings.warn(
+                    f"delta checkpoint {base}->{ds} in {self.dirname!r} "
+                    f"failed verification ({'; '.join(bad)}); stopping the "
+                    "delta replay chain here", RuntimeWarning)
+                _FALLBACK.inc()
+                break
+            chain.append(payload)
+        return chain
+
+    @staticmethod
+    def _apply_delta_chain(chain: List[dict], tname: str,
+                           rows: np.ndarray, mark: int):
+        """Replay one table's entries from an already-verified chain onto
+        the dense `rows` array, in delta order then seq order (scatter-SET
+        of absolute rows ⇒ ordered replay is bitwise-exact). Stops at a
+        mark discontinuity (a delta whose ``since_mark`` doesn't extend
+        the state we hold). Returns (rows, final_mark, deltas_applied)."""
+        applied = 0
+        for payload in chain:
+            blob = (payload.get("tables") or {}).get(tname)
+            if blob is None:
+                continue
+            if int(blob["since_mark"]) != int(mark):
+                break
+            off = 0
+            ids = np.asarray(blob["ids"], np.int64)
+            drows = np.asarray(blob["rows"], np.uint16)
+            for c in np.asarray(blob["counts"], np.int64).tolist():
+                rows[ids[off:off + c]] = drows[off:off + c]
+                off += c
+            mark = int(blob["mark"])
+            applied += 1
+        return rows, mark, applied
+
+    def save_delta(self, step: int, ps_tables: Dict[str, object],
+                   extra: Optional[Dict[str, object]] = None,
+                   blocking: bool = False) -> None:
+        """Incremental PS checkpoint: persist only the rows touched since
+        the chain head — the journal entries past the last full save's
+        (or previous delta's) mark — as ``delta-<base>-<step>.pkl`` plus
+        a SHA-256 manifest, committed tmp→fsync→rename like everything
+        else. Orders of magnitude smaller than a full dump for a big
+        table, so it can run every few seconds on an online trainer.
+
+        Riding the PR 10 journal machinery: each table's flush hook runs
+        first (device-dirty rows + queued async pushes land in the
+        journal), the snapshot is the journal slice ``(since_mark,
+        mark]``, and the journal is truncated to `mark` once — and only
+        once — the delta COMMITS, which is what keeps journal memory
+        bounded by delta cadence on an unbounded stream.
+
+        Requires a committed full ``save(ps_tables=...)`` (or a
+        ``restore``) as the chain base; ``save()`` is the compaction
+        point — it rewrites the whole table and starts a fresh chain.
+        Restore replays: newest verified full + its chain in order,
+        bitwise-exact (see ``restore``/``load_ps_table``)."""
+        if not ps_tables:
+            raise ValueError("save_delta: ps_tables is required (a delta "
+                             "checkpoint IS the PS-table increment)")
+        self.wait()  # one write in flight at a time; surfaces prior errors
+        base = self._ps_base
+        if base is None:
+            raise RuntimeError(
+                "save_delta: no committed full checkpoint to anchor the "
+                "delta chain — call save(ps_tables=...) (or restore) first")
+        base_step = int(base["step"])
+        marks: Dict[str, int] = dict(base["marks"])  # type: ignore[arg-type]
+        tables_blob: Dict[str, dict] = {}
+        on_commit = []
+        for tname, table in ps_tables.items():
+            hook = getattr(table, "flush_hook", None)
+            if hook is not None:
+                hook()
+            since = int(marks.get(tname, 0))
+            mark = int(table.journal_mark())
+            entries = [e for e in table.journal_entries_since(since)
+                       if e[0] <= mark]
+            lanes = int(table.lanes)
+            if entries:
+                ids = np.concatenate([e[1] for e in entries])
+                rows = np.concatenate([e[2] for e in entries], axis=0)
+            else:
+                ids = np.zeros((0,), np.int64)
+                rows = np.zeros((0, lanes), np.uint16)
+            tables_blob[tname] = {
+                "since_mark": since, "mark": mark,
+                "seqs": np.asarray([e[0] for e in entries], np.int64),
+                "counts": np.asarray([e[1].shape[0] for e in entries],
+                                     np.int64),
+                "ids": ids, "rows": rows, "lanes": lanes,
+                "vocab": int(table.spec.vocab),
+            }
+            marks[tname] = mark
+            on_commit.append(lambda t=table, m=mark: t.journal_truncate(m))
+        on_commit.append(lambda s=base_step, m=dict(marks):
+                         self._set_ps_base(s, m))
+        vals = {k: np.asarray(v) for k, v in (extra or {}).items()}
+        self._thread = threading.Thread(
+            target=self._write_delta,
+            args=(base_step, int(step), tables_blob, vals, on_commit),
+            daemon=True)
+        self._thread.start()
+        if blocking:
+            self.wait()
+
+    def _write_delta(self, base_step: int, step: int, tables_blob: dict,
+                     vals: dict, on_commit=()):
+        """Writer-thread entry for a delta (same retry/commit contract as
+        `_write`: manifest last, on_commit only after it is durable)."""
+        retries = int(os.environ.get("PDTPU_CKPT_RETRIES", "3"))
+        backoff_ms = float(os.environ.get("PDTPU_CKPT_RETRY_BACKOFF_MS",
+                                          "100"))
+        attempt = 0
+        while True:
+            try:
+                payload = {"base_step": base_step, "step": step,
+                           "tables": tables_blob, "extra": vals}
+                path = self._delta_path(base_step, step)
+                self._current_path = path
+                manifest: Dict[str, dict] = {}
+                digest, size = _write_bytes(
+                    path + ".tmp", pickle.dumps(payload, protocol=4))
+                manifest[os.path.basename(path)] = {"sha256": digest,
+                                                    "bytes": size}
+                fault_point("ckpt.delta_write", path=path + ".tmp")
+                os.replace(path + ".tmp", path)
+                mpath = self._delta_manifest_path(base_step, step)
+                self._current_path = mpath
+                blob = json.dumps({"step": step, "base_step": base_step,
+                                   "files": manifest},
+                                  sort_keys=True).encode("utf-8")
+                _write_bytes(mpath + ".tmp", blob)
+                os.replace(mpath + ".tmp", mpath)
+                for cb in on_commit:
+                    try:
+                        cb()
+                    except Exception:
+                        pass  # commit stands; truncation is best-effort
+                return
+            except OSError as e:
+                path = getattr(e, "filename", None) or self._current_path
+                if attempt >= retries:
+                    self._error = (e, step, path, attempt)
+                    return
+                _RETRIES.inc()
+                time.sleep(min(backoff_ms * (2 ** attempt), 5000.0) / 1e3)
+                attempt += 1
+            except BaseException as e:
+                self._error = (e, step, self._current_path, attempt)
+                return
+
+    def _set_ps_base(self, step: int, marks: Dict[str, int]) -> None:
+        self._ps_base = {"step": int(step),
+                         "marks": {k: int(v) for k, v in marks.items()}}
+
     def load_ps_table(self, tname: str):
         """Shard-recovery read path: ``(full_rows, journal_mark, step)``
         for PS table `tname` from the newest checkpoint that passes
@@ -475,7 +705,13 @@ class Checkpointer:
                         raise RuntimeError(f"no {psn!r} shards")
                     mark = int(np.asarray(
                         bundle.get(f"@ps_mark@{tname}", 0)).reshape(()))
-                    return assembled[psn], mark, st
+                    # replay the verified delta chain: a shard recovered
+                    # mid-stream gets full ∘ deltas, and the returned mark
+                    # is the last delta's so the client replays only the
+                    # journal tail past it
+                    rows, mark, _ = self._apply_delta_chain(
+                        self._delta_chain(st), tname, assembled[psn], mark)
+                    return rows, mark, st
                 except (RuntimeError, OSError, EOFError, ValueError,
                         pickle.UnpicklingError) as e:
                     bad = [f"{type(e).__name__}: {e}"]
@@ -517,6 +753,7 @@ class Checkpointer:
         shards = list(shards)
         ps_names = []
         on_commit = []
+        ps_marks_now: Dict[str, int] = {}
         for tname, table in (ps_tables or {}).items():
             psn = f"{tname}@ps"
             ps_names.append(psn)
@@ -534,6 +771,7 @@ class Checkpointer:
                 # journaled (replay is idempotent either way)
                 mark = int(table.journal_mark())
                 vals[f"@ps_mark@{tname}"] = np.asarray(mark, np.int64)
+                ps_marks_now[tname] = mark
                 on_commit.append(
                     lambda t=table, m=mark: t.journal_truncate(m))
             for i in range(spec.num_shards):
@@ -541,6 +779,11 @@ class Checkpointer:
                 shards.append((psn, ((lo, hi), (0, lanes)),
                                (spec.vocab, lanes), "uint16",
                                table.dump_shard(i)))
+        if ps_names:
+            # a committed full save is the new delta-chain head (the
+            # compaction point): subsequent save_delta() calls extend it
+            on_commit.append(lambda s=int(step), m=dict(ps_marks_now):
+                             self._set_ps_base(s, m))
         rank = jax.process_index()
         if ps_names:
             # restore-side coverage check: which PS tables this
@@ -766,6 +1009,22 @@ class Checkpointer:
                     "next older checkpoint", RuntimeWarning)
                 continue
             to_set, rng_key, extra, assembled, ps_marks = loaded
+            # incremental checkpoints: replay this full save's verified
+            # delta chain onto the assembled PS tables BEFORE any state
+            # mutates — the restored bytes are full ∘ deltas, bitwise
+            # identical to the table at the last committed save_delta
+            chain = self._delta_chain(st) if ps_tables else []
+            final_marks: Dict[str, int] = {}
+            for tname in (ps_tables or {}):
+                rows, fmark, _ = self._apply_delta_chain(
+                    chain, tname, assembled[f"{tname}@ps"],
+                    int(ps_marks.get(tname, 0)))
+                assembled[f"{tname}@ps"] = rows
+                final_marks[tname] = fmark
+            for payload in chain:
+                extra.update({k: v for k, v in
+                              (payload.get("extra") or {}).items()
+                              if k.startswith("@dataio@")})
             for n, arr in to_set.items():
                 scope.set_var(n, arr)
             if rng_key is not None:
@@ -775,8 +1034,13 @@ class Checkpointer:
                 if hasattr(table, "journal_reset"):
                     # the live journal (possibly from another process
                     # lifetime) no longer describes deltas over what was
-                    # just loaded; re-anchor it at this checkpoint's mark
-                    table.journal_reset(int(ps_marks.get(tname, 0)))
+                    # just loaded; re-anchor it at the restored mark —
+                    # the last applied delta's, else the full save's
+                    table.journal_reset(int(final_marks.get(tname, 0)))
+            if ps_tables:
+                # restore re-anchors the delta chain: new deltas extend
+                # from exactly the state just loaded
+                self._set_ps_base(st, final_marks)
             self.last_extra = extra
             return st
         if failures:

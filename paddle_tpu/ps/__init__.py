@@ -15,6 +15,10 @@ repo's packed row-major tables:
   per-shard byte accounting, plus the client-side push journal and
   ``recover_shard`` (lossless rebuild of a restarted shard from the
   newest verified checkpoint + journal replay);
+* :mod:`.dynamic` — ``DynamicEmbeddingShard``: the online-learning
+  variant — rows materialize on first pull (init-on-pull) into a bounded
+  slab and cold ids are swept out by TTL + watermark LFU eviction, so
+  the vocab is no longer provisioned up front;
 * :mod:`.health` — ``ShardMonitor``: periodic shard pings driving
   ``ps/shard_up`` gauges and the ``ps/shards`` /healthz check;
 * :mod:`.tier` — ``PsEmbeddingTier``: the worker-side training driver
@@ -29,6 +33,8 @@ semantics (retry env knobs, journal durability contract, recovery
 walkthrough) are documented in docs/migration.md "Distributed
 embeddings → Failure semantics".
 """
+from .dynamic import (DynamicEmbeddingShard,  # noqa: F401
+                      make_dynamic_shards, zero_init_rows)
 from .health import ShardMonitor  # noqa: F401
 from .hot_cache import HotRowCache  # noqa: F401
 from .shard import EmbeddingShard, RangeSpec, make_shards  # noqa: F401
@@ -45,4 +51,5 @@ __all__ = [
     "TransportError", "ShardRestartedError", "connect", "probe",
     "ShardedTable", "ShardMonitor", "PsTableBinding", "PsEmbeddingTier",
     "HotRowCache", "SlotMap", "LruOrder", "FreqSketch",
+    "DynamicEmbeddingShard", "make_dynamic_shards", "zero_init_rows",
 ]

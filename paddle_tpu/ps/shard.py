@@ -192,11 +192,17 @@ class EmbeddingShard:
 
     def stats(self) -> dict:
         with self._lock:
+            # live_rows/capacity mirror the dynamic shard's vocab fields
+            # (a dense shard is always at 100% occupancy by construction)
+            # so health/vocab tooling reads every shard kind uniformly
             return {"name": self.name, "lo": self.lo, "hi": self.hi,
                     "rows": self.hi - self.lo,
                     "bytes_pulled": self.bytes_pulled,
                     "bytes_pushed": self.bytes_pushed,
-                    "n_pulls": self.n_pulls, "n_pushes": self.n_pushes}
+                    "n_pulls": self.n_pulls, "n_pushes": self.n_pushes,
+                    "dynamic": False,
+                    "live_rows": self.hi - self.lo,
+                    "capacity": self.hi - self.lo}
 
 
 def make_shards(name: str, spec: RangeSpec,

@@ -140,6 +140,10 @@ class ShardedTable:
         # highest seq ever evicted from shard i's journal by the size cap
         self._evicted_upto = [0] * spec.num_shards
         self._rw = _RWLock()
+        # delta-push taps: called (sorted_uids, rows) AFTER a push has
+        # been applied on the shards — the streaming DeltaPublisher rides
+        # this to stream touched rows to serving replicas
+        self._push_listeners: List[Callable] = []
         self._recovery: Optional[Callable[[int, BaseException], None]] = None
         # armed by the tier: Checkpointer.save() calls it before taking
         # the journal mark / dumping shards, so device-resident dirty rows
@@ -315,6 +319,28 @@ class ShardedTable:
                     (sl.stop - sl.start) * self.lanes * 2)
         self._c_pushed.inc(nb)
         self._h_push.observe((time.perf_counter() - t0) * 1e3)
+        # notify AFTER the remote apply: a listener that forwards these
+        # bytes to a serving cache never races ahead of the shard state.
+        # Listener arrays are read-only by contract (not re-copied here).
+        for fn in self._push_listeners:
+            try:
+                fn(ids, rows)
+            except Exception:
+                get_registry().counter("stream/listener_errors",
+                                       table=self.name).inc()
+
+    def add_push_listener(self, fn: Callable) -> None:
+        """Register `fn(sorted_uids, rows)` to observe every applied push
+        (the train->serve delta stream tap). A listener must not mutate
+        its arguments and must not block — it runs on whatever thread
+        issued the push (trainer or async flusher)."""
+        self._push_listeners.append(fn)
+
+    def remove_push_listener(self, fn: Callable) -> None:
+        try:
+            self._push_listeners.remove(fn)
+        except ValueError:
+            pass
 
     # ------------------------------------------------------ journal/recovery
     def _journal_append(self, ids: np.ndarray, rows: np.ndarray, chunks):
@@ -377,6 +403,40 @@ class ShardedTable:
         with self._jlock:
             return self._journal_nbytes
 
+    def journal_entries_since(self, mark: int) -> List[tuple]:
+        """Every journaled push past `mark` as ``[(seq, ids, rows)]`` in
+        ascending seq order — the payload of an incremental checkpoint
+        (``Checkpointer.save_delta``). Per-shard slices of one original
+        push (same seq) are re-merged in shard order, so each returned
+        entry has ascending ids and replays as one valid ``push``.
+        Raises when the journal cap evicted entries the range needs: a
+        delta built over a hole would restore silently stale rows."""
+        mark = int(mark)
+        with self._jlock:
+            for i, ev in enumerate(self._evicted_upto):
+                if ev > mark:
+                    raise RuntimeError(
+                        f"ShardedTable {self.name!r}: cannot build a delta "
+                        f"since mark {mark}: shard {i}'s journal evicted "
+                        f"entries up to seq {ev} (PDTPU_PS_JOURNAL_MAX_MB "
+                        "cap) — save deltas/checkpoints more often or "
+                        "raise the cap")
+            by_seq: Dict[int, list] = {}
+            for i, sh in enumerate(self._journal):
+                for seq, ids, rows in sh:
+                    if seq > mark:
+                        by_seq.setdefault(seq, []).append((i, ids, rows))
+        out = []
+        for seq in sorted(by_seq):
+            parts = sorted(by_seq[seq], key=lambda p: p[0])
+            if len(parts) == 1:
+                out.append((seq, parts[0][1], parts[0][2]))
+            else:
+                out.append((seq,
+                            np.concatenate([p[1] for p in parts]),
+                            np.concatenate([p[2] for p in parts], axis=0)))
+        return out
+
     def set_flush_hook(self, hook: Optional[Callable[[], None]]) -> None:
         """Install (or clear) the make-shards-authoritative callback the
         Checkpointer invokes before snapshotting this table. The tier
@@ -431,6 +491,14 @@ class ShardedTable:
             return len(replay)
         finally:
             self._rw.release_write()
+
+    def sweep(self) -> int:
+        """Fan a dynamic-vocab eviction pass out to every shard; returns
+        total rows evicted (0 when every shard is static)."""
+        parts = self._run_shared(
+            [(i, (lambda i=i: self.clients[i].sweep(self.name)))
+             for i in range(self.spec.num_shards)])
+        return int(sum(n for _, n in parts))
 
     # -------------------------------------------------------- full-table io
     def dump_shard(self, i: int) -> np.ndarray:

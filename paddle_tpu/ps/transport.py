@@ -271,6 +271,12 @@ class ShardClient:
     def ping(self) -> bool:
         raise NotImplementedError
 
+    def sweep(self, name: str) -> int:
+        """Run one dynamic-vocab eviction pass on table `name`; returns
+        rows evicted (0 for a static shard — sweeping is a no-op there,
+        not an error, so a mixed static/dynamic fleet sweeps uniformly)."""
+        raise NotImplementedError
+
     def reset_instance_expectation(self) -> None:
         """Forget the remembered server instance id: the next reply's id
         is adopted without raising ShardRestartedError. Recovery calls
@@ -318,6 +324,11 @@ class InProcessClient(ShardClient):
 
     def stats(self):
         return {n: s.stats() for n, s in self._shards.items()}
+
+    def sweep(self, name):
+        sh = self._get(name)
+        fn = getattr(sh, "sweep", None)
+        return int(fn()) if fn is not None else 0
 
     def ping(self):
         return True
@@ -460,6 +471,9 @@ class SocketClient(ShardClient):
 
     def stats(self):
         return self._call("stats")
+
+    def sweep(self, name):
+        return int(self._call("sweep", name=name))
 
     def metrics(self):
         """The server process's `Registry.series()` — how a pserver
@@ -652,6 +666,8 @@ class ShardServer:
         if op == "load":
             self.local.load(name, msg["rows"])
             return True
+        if op == "sweep":
+            return self.local.sweep(name)
         raise ValueError(f"unknown ps op {op!r}")
 
     def serve_in_thread(self) -> "ShardServer":

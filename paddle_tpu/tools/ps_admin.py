@@ -25,13 +25,18 @@ Commands:
 * ``meta``        — which tables each shard hosts and their row ranges;
 * ``stats``       — per-shard pull/push byte counters, plus the worker's
   hot-row-cache block (hit rate, resident/dirty rows, write-back bytes)
-  when one is in play;
+  when one is in play, plus a ``vocab`` block when any shard is a
+  dynamic-vocab one (live vs provisioned rows, materialized/evicted
+  totals and the eviction rate, oldest-row age — the online-learning
+  occupancy picture);
 * ``dump-health`` — the ShardMonitor view as one JSON document: runs a
   single synchronous sweep and prints ``status`` (ok/degraded/failing),
   per-shard up flags, and the endpoint list — what the in-process
   ``/healthz`` check ``ps/shards`` reports, minus the wedge timer
   (a one-shot CLI has no down-since history). Includes the same
-  ``hot_cache`` block as ``stats``;
+  ``hot_cache`` block as ``stats``, and a dynamic-vocab shard sitting
+  within 5% of its row cap escalates ``status`` to ``degraded`` (the
+  next sweep will be evicting WARM ids — grow the capacity);
 * ``fleet``       — ONE federated scrape of the whole system: every
   pserver endpoint (transport ``metrics`` op) plus every worker/replica
   introspection server given via ``--workers http://h:p,...``
@@ -105,6 +110,51 @@ def cache_fields(worker: str = "", timeout: float = 2.0):
     out["dirty_fraction"] = (out["dirty_rows"] / out["capacity"]
                              if out["capacity"] else None)
     return out
+
+
+# a dynamic shard at >= 95% of its slab is one hot batch away from
+# evicting warm rows; surface it before quality degrades silently
+_VOCAB_CAP_WARN = 0.95
+
+
+def vocab_fields(payloads):
+    """The dynamic-vocab block for ``stats``/``dump-health``, aggregated
+    from per-endpoint ``stats`` payloads (``[(endpoint, {table:
+    shard.stats()})]``). Returns None when no shard is dynamic;
+    otherwise per-table occupancy totals plus ``near_cap`` — the shards
+    within ``1 - _VOCAB_CAP_WARN`` of their row cap."""
+    tables: dict = {}
+    near_cap = []
+    for i, (ep, payload) in enumerate(payloads):
+        if not isinstance(payload, dict):
+            continue
+        for tname, st in payload.items():
+            if not isinstance(st, dict) or not st.get("dynamic"):
+                continue
+            t = tables.setdefault(tname, {
+                "live_rows": 0, "provisioned_rows": 0, "materialized": 0,
+                "evicted": 0, "pinned": 0, "oldest_row_age_s": 0.0})
+            live = int(st.get("live_rows", 0))
+            cap = int(st.get("capacity", 0))
+            t["live_rows"] += live
+            t["provisioned_rows"] += cap
+            t["materialized"] += int(st.get("materialized", 0))
+            t["evicted"] += int(st.get("evicted", 0))
+            t["pinned"] += int(st.get("pinned", 0))
+            t["oldest_row_age_s"] = max(t["oldest_row_age_s"],
+                                        float(st.get("oldest_age_s") or 0))
+            if cap and live >= _VOCAB_CAP_WARN * cap:
+                near_cap.append({"shard": i, "endpoint": ep,
+                                 "table": tname, "live_rows": live,
+                                 "capacity": cap})
+    if not tables:
+        return None
+    for t in tables.values():
+        t["utilization"] = (t["live_rows"] / t["provisioned_rows"]
+                            if t["provisioned_rows"] else None)
+        t["eviction_rate"] = (t["evicted"] / t["materialized"]
+                              if t["materialized"] else None)
+    return {"tables": tables, "near_cap": near_cap}
 
 
 def _series_get(series, name, field="value"):
@@ -220,6 +270,26 @@ def main(argv=None) -> int:
         mon.poll_now()
         doc = mon.status()
         doc["hot_cache"] = _cache()
+        payloads = []
+        for ep in eps:
+            ok, payload = _ask(ep, "stats", args.timeout)
+            payloads.append((ep, payload if ok else None))
+        doc["vocab"] = vocab_fields(payloads)
+        near = (doc["vocab"] or {}).get("near_cap") or []
+        if near:
+            flagged = {n["shard"] for n in near}
+            for s in doc["shards"]:
+                s["near_cap"] = s["shard"] in flagged
+            if doc["status"] == "ok":
+                # up but one hot batch from evicting warm ids: degraded,
+                # not failing — the fleet serves, capacity needs growing
+                doc["status"] = "degraded"
+                doc["detail"] = (
+                    f"{len(near)} dynamic shard(s) within "
+                    f"{round((1 - _VOCAB_CAP_WARN) * 100)}% of row cap: "
+                    + ", ".join(f"{n['endpoint']}/{n['table']} "
+                                f"{n['live_rows']}/{n['capacity']}"
+                                for n in near))
         print(json.dumps(doc, indent=None if args.json else 2,
                          sort_keys=True))
         return 0 if all(s["up"] for s in doc["shards"]) else 1
@@ -233,10 +303,14 @@ def main(argv=None) -> int:
         rows.append({"shard": i, "endpoint": ep, "up": ok,
                      ("error" if not ok else op): payload})
     cache = _cache() if op == "stats" else None
+    vocab = None
+    if op == "stats":
+        vocab = vocab_fields([(r["endpoint"], r.get("stats"))
+                              for r in rows if r["up"]])
     if args.json:
         if op == "stats":
-            print(json.dumps({"shards": rows, "hot_cache": cache},
-                             sort_keys=True))
+            print(json.dumps({"shards": rows, "hot_cache": cache,
+                              "vocab": vocab}, sort_keys=True))
         else:
             print(json.dumps(rows, sort_keys=True))
     else:
@@ -248,6 +322,8 @@ def main(argv=None) -> int:
             print(line)
         if cache is not None:
             print("hot cache: " + json.dumps(cache, sort_keys=True))
+        if vocab is not None:
+            print("vocab: " + json.dumps(vocab, sort_keys=True))
     return 0 if all_up else 1
 
 

@@ -1,0 +1,976 @@
+"""Streaming online learning (paddle_tpu.streaming + ps.dynamic + the
+incremental-checkpoint path in parallel.checkpoint).
+
+The four pillars under test, mapped to the reference's online-CTR stack:
+
+* unbounded ingestion — ``StreamingDataset`` (QueueDataset over a pipe)
+  feeds the tier forever, with a held-out eval window peeled off the
+  same stream;
+* dynamic vocab — ``DynamicEmbeddingShard`` (pslib online mode):
+  init-on-pull materialization, TTL/frequency sweeps, growth past the
+  provisioned row count inside a fixed slab;
+* incremental checkpoints — ``Checkpointer.save_delta`` persists only
+  the rows touched since the chain head (the push journal), restore is
+  newest full + ordered delta replay, bitwise-exact;
+* delta push — ``DeltaPublisher`` streams freshly-trained rows to a
+  live ``PsLookupPredictor`` at bounded staleness.
+
+The flagship cells: ``test_online_smoke_auc_improves_and_serving_is_fresh``
+(train and serve the same table in one process, ~30 s) and the SIGKILL
+variant where the recovery base is full ∘ delta instead of a full save.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.initializer import RowPackInitializer
+from paddle_tpu.observability.registry import get_registry
+from paddle_tpu.param_attr import ParamAttr
+from paddle_tpu.parallel.checkpoint import Checkpointer
+from paddle_tpu.ps import (DynamicEmbeddingShard, EmbeddingShard,
+                           InProcessClient, PsEmbeddingTier, PsTableBinding,
+                           RangeSpec, ShardServer, ShardedTable, SocketClient,
+                           make_dynamic_shards, make_shards)
+from paddle_tpu.streaming import (DeltaPublisher, OnlineTrainer,
+                                  StreamingDataset, auc)
+from paddle_tpu.streaming.dataset import parse_multislot_line
+from paddle_tpu.streaming.trainer import eval_auc
+
+import test_ps_embedding as tpe
+import test_ps_faults as tpf
+
+V, D, B, F = tpe.V, tpe.D, tpe.B, tpe.F
+MULT, CAP, LANES = tpe.MULT, tpe.CAP, tpe.LANES
+
+
+# ===================================================== dynamic vocab shards
+
+def test_dynamic_init_on_pull_is_deterministic():
+    """A never-seen id pulls the deterministic init row and materializes
+    exactly once; a repeat pull re-reads the same slot."""
+    sh = DynamicEmbeddingShard("tb", 0, 1000, capacity=4)
+    ids = np.array([7, 500], np.int64)
+    np.testing.assert_array_equal(sh.pull(ids),
+                                  np.zeros((2, LANES), np.uint16))
+    st = sh.stats()
+    assert st["dynamic"] and st["live_rows"] == 2 and st["materialized"] == 2
+    sh.pull(ids)
+    assert sh.stats()["materialized"] == 2  # no re-materialization
+
+    # custom init: deterministic from the global id, same bytes across
+    # evict/re-touch cycles
+    def init_fn(gids):
+        out = np.zeros((gids.shape[0], LANES), np.uint16)
+        out[:, 0] = gids % 65536
+        return out
+
+    sh2 = DynamicEmbeddingShard("tb", 100, 1000, capacity=4,
+                                init_row_fn=init_fn)
+    got = sh2.pull(np.array([100, 777], np.int64))
+    assert got[0, 0] == 100 and got[1, 0] == 777
+    assert not got[:, 1:].any()
+
+
+def test_evicted_id_reinitializes_never_stale_bytes():
+    """Evicting a row discards its trained bytes AND optimizer state: a
+    later touch yields the init row, not whatever the slab slot held."""
+    init = np.full((1, LANES), 7, np.uint16)
+    sh = DynamicEmbeddingShard(
+        "tb", 0, 100, capacity=2,
+        init_row_fn=lambda g: np.full((g.shape[0], LANES), 7, np.uint16))
+    np.testing.assert_array_equal(sh.pull(np.array([5], np.int64)), init)
+    sh.push(np.array([5], np.int64), tpe._rand_rows(1, seed=49))
+    sh.pull(np.array([6], np.int64))
+    sh.pull(np.array([7], np.int64))   # slab full: evicts coldest (5)
+    assert sh.stats()["evicted"] >= 1
+    np.testing.assert_array_equal(sh.pull(np.array([5], np.int64)), init)
+
+
+def test_vocab_grows_past_provisioned_within_bounded_slab():
+    """1000 distinct ids stream through a 32-row slab: the table keeps
+    growing (materializations) while memory stays fixed."""
+    sh = DynamicEmbeddingShard("tb", 0, 10_000, capacity=32)
+    for k in range(0, 1000, 8):
+        sh.pull(np.arange(k, k + 8, dtype=np.int64))
+    st = sh.stats()
+    assert st["materialized"] == 1000
+    assert st["live_rows"] <= 32
+    assert st["slab_bytes"] == 32 * LANES * 2
+    assert st["evicted"] >= 1000 - 32
+    reg_snap = get_registry().snapshot()
+    assert "ps/materialized_rows" in reg_snap.get("counters", reg_snap.get(
+        "counter", {})) or True  # exported via prometheus below
+    text = get_registry().prometheus_text()
+    assert "ps_materialized_rows" in text and "ps_evicted_rows" in text
+    assert "ps_vocab_rows" in text and "ps_vocab_capacity" in text
+
+
+def test_ttl_sweep_evicts_cold_rows_over_socket_table():
+    """TTL sweep reclaims untouched ids — driven table-level through the
+    socket transport (the `sweep` wire op), with re-touch re-init."""
+    sh = DynamicEmbeddingShard("tb", 0, 200, capacity=8, ttl_s=0.05)
+    srv = ShardServer([sh]).serve_in_thread()
+    try:
+        c = SocketClient(srv.endpoint)
+        table = ShardedTable("tb", RangeSpec(200, [0, 200]), [c])
+        ids = np.arange(4, dtype=np.int64)
+        np.testing.assert_array_equal(table.pull(ids),
+                                      np.zeros((4, LANES), np.uint16))
+        rows = tpe._rand_rows(4, seed=47)
+        table.push(ids, rows)
+        np.testing.assert_array_equal(table.pull(ids), rows)
+        time.sleep(0.1)
+        assert table.sweep() == 4
+        # trained bytes gone; pull re-materializes the init rows
+        np.testing.assert_array_equal(table.pull(ids),
+                                      np.zeros((4, LANES), np.uint16))
+        st = c.stats()["tb"]
+        assert st["dynamic"] and st["evicted"] >= 4
+        table.close()
+    finally:
+        srv.stop()
+
+
+def test_static_table_sweep_is_noop():
+    table = ShardedTable.build_in_process(
+        "tb", RangeSpec.even(V, 2), full_rows=tpe._rand_rows(V))
+    assert table.sweep() == 0
+
+
+def test_watermark_sweep_gives_frequent_ids_a_second_chance():
+    sh = DynamicEmbeddingShard("tb", 0, 1000, capacity=10,
+                               high_watermark=0.5, low_watermark=0.2,
+                               keep_freq=4)
+    hot = np.array([1], np.int64)
+    for _ in range(6):
+        sh.pull(hot)                        # sketch: uid 1 is frequent
+    sh.pull(np.arange(2, 8, dtype=np.int64))  # 6 cold ids; uid 1 now coldest
+    evicted = sh.sweep()
+    assert evicted > 0
+    assert sh._slots.get(1) is not None     # spared by frequency
+    assert sh.stats()["live_rows"] <= 2     # low watermark reached
+
+
+def test_pins_block_eviction_until_unpinned():
+    """The in-flight-push guard: pinned rows survive a TTL sweep with
+    their bytes; a full slab of pins refuses new ids instead of
+    spinning; unpinning re-enables both paths."""
+    sh = DynamicEmbeddingShard("tb", 0, 100, capacity=4, ttl_s=0.0)
+    ids = np.arange(4, dtype=np.int64)
+    rows = tpe._rand_rows(4, seed=48)
+    sh.push(ids, rows)
+    sh.pin(np.array([0, 1], np.int64))
+    assert sh.sweep() == 2                  # ttl 0: all expired, pins spare 2
+    np.testing.assert_array_equal(sh.pull(np.array([0, 1], np.int64)),
+                                  rows[:2])
+    sh.unpin(np.array([0, 1], np.int64))
+    assert sh.sweep() == 2
+
+    sh2 = DynamicEmbeddingShard("tb", 0, 100, capacity=2)
+    sh2.pull(np.array([0, 1], np.int64))
+    sh2.pin(np.array([0, 1], np.int64))
+    with pytest.raises(RuntimeError, match="pinned"):
+        sh2.pull(np.array([2], np.int64))
+    sh2.unpin(np.array([0], np.int64))
+    sh2.pull(np.array([2], np.int64))       # admits by evicting unpinned 0
+    assert sh2.stats()["live_rows"] == 2
+
+
+def test_sweep_excludes_inflight_push_via_mutation_lock():
+    """Eviction can never interleave a push's scatter: sweep takes the
+    same mutation lock. Holding the lock (as push does) blocks a racing
+    sweep until release."""
+    sh = DynamicEmbeddingShard("tb", 0, 100, capacity=8, ttl_s=0.0)
+    sh.push(np.arange(4, dtype=np.int64), tpe._rand_rows(4))
+    done = threading.Event()
+    out = {}
+
+    def _sweep():
+        out["evicted"] = sh.sweep()
+        done.set()
+
+    sh._lock.acquire()
+    try:
+        t = threading.Thread(target=_sweep, daemon=True)
+        t.start()
+        assert not done.wait(0.15)          # blocked behind the push lock
+    finally:
+        sh._lock.release()
+    assert done.wait(5.0)
+    assert out["evicted"] == 4
+
+
+def test_dynamic_dump_load_bitwise_and_size_guard(monkeypatch):
+    sh = DynamicEmbeddingShard("tb", 0, V, capacity=8)
+    ids = np.array([3, 17, 44], np.int64)
+    rows = tpe._rand_rows(3, seed=50)
+    sh.push(ids, rows)
+    dense = sh.dump()
+    assert dense.shape == (V, LANES)
+    np.testing.assert_array_equal(dense[ids], rows)
+
+    sh2 = DynamicEmbeddingShard("tb", 0, V, capacity=8)
+    sh2.load(dense)
+    np.testing.assert_array_equal(sh2.dump(), dense)
+    assert sh2.stats()["live_rows"] == 3    # init-equal rows stay virtual
+
+    sh3 = DynamicEmbeddingShard("tb", 0, V, capacity=2)
+    with pytest.raises(ValueError, match="capacity"):
+        sh3.load(dense)                     # 3 trained rows > 2 slots
+
+    monkeypatch.setenv("PDTPU_PS_DYNAMIC_DUMP_MAX_MB", "0")
+    with pytest.raises(RuntimeError, match="save_delta"):
+        sh.dump()
+
+
+def test_make_dynamic_shards_table_sweep_fans_out():
+    spec = RangeSpec.even(200, 2)
+    shards = make_dynamic_shards("tb", spec, capacity_per_shard=8,
+                                 ttl_s=0.01)
+    table = ShardedTable("tb", spec, [InProcessClient([s]) for s in shards])
+    ids = np.array([0, 50, 120, 199], np.int64)   # both ranges
+    table.push(ids, tpe._rand_rows(4, seed=51))
+    time.sleep(0.05)
+    assert table.sweep() == 4               # fan-out sums both shards
+
+
+# ============================================ incremental (delta) checkpoints
+
+def test_save_delta_validation(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    table = ShardedTable.build_in_process("tb", RangeSpec.even(V, 2),
+                                          full_rows=tpe._rand_rows(V))
+    with pytest.raises(ValueError, match="ps_tables"):
+        ck.save_delta(1, {})
+    with pytest.raises(RuntimeError, match="full checkpoint"):
+        ck.save_delta(1, {"tb": table})
+
+
+def test_delta_chain_restore_is_bitwise_and_truncates_journal(tmp_path):
+    """full@1 → delta@2 → delta@3: each delta persists only the rows
+    pushed since the chain head and truncates the client journal
+    (bounded memory on an unbounded stream); restore and load_ps_table
+    both see full ∘ delta2 ∘ delta3, bitwise, discarding the
+    uncommitted tail."""
+    main, startup = tpe._tiny_program()
+    rows0 = tpe._rand_rows(V, seed=31)
+    table = ShardedTable.build_in_process("tb", RangeSpec.even(V, 2),
+                                          full_rows=rows0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        ck = Checkpointer(str(tmp_path))
+        ck.save(1, program=main, scope=sc, blocking=True,
+                ps_tables={"tb": table})
+        table.push(np.array([0, 7, 25, 49], np.int64),
+                   tpe._rand_rows(4, seed=32))
+        ck.save_delta(2, {"tb": table}, blocking=True)
+        assert table.stats()["journal"]["entries"] == 0  # truncated at commit
+        state2 = table.dump_full()
+        table.push(np.array([3, 25, 30], np.int64),
+                   tpe._rand_rows(3, seed=33))
+        ck.save_delta(3, {"tb": table}, blocking=True)
+        assert table.stats()["journal"]["entries"] == 0
+        state3 = table.dump_full()
+        assert not np.array_equal(state2, state3)
+        # uncommitted tail: restore must roll it back
+        table.push(np.array([1], np.int64), tpe._rand_rows(1, seed=34))
+
+        assert ck.delta_steps(1) == [2, 3]
+        assert ck.verify_delta(1, 2) == [] and ck.verify_delta(1, 3) == []
+        # the incremental claim: a delta ships a fraction of the table
+        dsize = os.path.getsize(ck._delta_path(1, 3))
+        assert dsize < rows0.nbytes / 4
+
+        assert ck.restore(program=main, scope=sc,
+                          ps_tables={"tb": table}) == 1
+        np.testing.assert_array_equal(table.dump_full(), state3)
+        assert table.stats()["journal"]["entries"] == 0
+
+        full, mark, st = ck.load_ps_table("tb")
+        assert st == 1
+        np.testing.assert_array_equal(full, state3)
+
+        # the chain is re-anchored after restore: a further delta extends
+        # it and the recovery read path composes all three
+        table.push(np.array([11, 40], np.int64), tpe._rand_rows(2, seed=35))
+        assert table.journal_mark() > mark
+        ck.save_delta(4, {"tb": table}, blocking=True)
+        state4 = table.dump_full()
+        full2, _, _ = ck.load_ps_table("tb")
+        np.testing.assert_array_equal(full2, state4)
+
+
+def test_delta_chain_stops_at_corruption(tmp_path):
+    """A corrupt delta payload fails its manifest check: restore applies
+    the longest verifiable prefix (full ∘ delta2) instead of crashing
+    or applying garbage."""
+    main, startup = tpe._tiny_program()
+    table = ShardedTable.build_in_process("tb", RangeSpec.even(V, 2),
+                                          full_rows=tpe._rand_rows(V, seed=36))
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        ck = Checkpointer(str(tmp_path))
+        ck.save(1, program=main, scope=sc, blocking=True,
+                ps_tables={"tb": table})
+        table.push(np.array([2, 9], np.int64), tpe._rand_rows(2, seed=37))
+        ck.save_delta(2, {"tb": table}, blocking=True)
+        state2 = table.dump_full()
+        table.push(np.array([30], np.int64), tpe._rand_rows(1, seed=38))
+        ck.save_delta(3, {"tb": table}, blocking=True)
+
+        victim = ck._delta_path(1, 3)
+        raw = bytearray(open(victim, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(raw))
+        assert ck.verify_delta(1, 3) != []
+
+        assert ck.restore(program=main, scope=sc,
+                          ps_tables={"tb": table}) == 1
+        np.testing.assert_array_equal(table.dump_full(), state2)
+
+
+def test_gc_reaps_delta_files_with_their_base(tmp_path):
+    main, startup = tpe._tiny_program()
+    table = ShardedTable.build_in_process("tb", RangeSpec.even(V, 2),
+                                          full_rows=tpe._rand_rows(V, seed=39))
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        ck = Checkpointer(str(tmp_path), keep=1)
+        ck.save(1, program=main, scope=sc, blocking=True,
+                ps_tables={"tb": table})
+        table.push(np.array([4], np.int64), tpe._rand_rows(1, seed=40))
+        ck.save_delta(2, {"tb": table}, blocking=True)
+        old_delta = ck._delta_path(1, 2)
+        assert os.path.exists(old_delta)
+        ck.save(5, program=main, scope=sc, blocking=True,
+                ps_tables={"tb": table})   # keep=1: step-1 bundle GC'd
+        assert not os.path.exists(old_delta)
+
+
+def _run_chaos_with_delta(tmp_path, feeds, delta_step, kill_step):
+    """tpf._run_chaos_training with a mid-run save_delta: the recovery
+    base a reborn shard rebuilds from is full@0 ∘ delta, plus replay of
+    the journal tail past the delta mark (the journal was truncated at
+    the delta commit, so the tail is all that exists)."""
+    spec = RangeSpec.even(V, 2)
+    procs, eps = [], []
+    for i in range(2):
+        lo, hi = spec.bounds(i)
+        p, ep = tpf._launch_pserver([f"tb:{lo}:{hi}"])
+        procs.append(p)
+        eps.append(ep)
+    clients = [SocketClient(ep) for ep in eps]
+    table = ShardedTable("tb", spec, clients)
+    restarter = None
+    try:
+        table.load_full(tpe._init_packed())
+        main, startup, loss = tpe._build_program(CAP)
+        exe = fluid.Executor(fluid.CPUPlace())
+        losses = []
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(startup)
+            ck = Checkpointer(str(tmp_path / "ck"))
+            ck.save(0, program=main, scope=sc, blocking=True,
+                    ps_tables={"tb": table})
+            tier = PsEmbeddingTier(
+                main, [PsTableBinding("tb", table, ["ids"])],
+                pull_ahead=1, push_depth=0)
+            tier.attach_checkpointer(ck)
+            try:
+                step = 0
+                for prep in tier.steps(lambda: iter(feeds)):
+                    if step == delta_step:
+                        ck.save_delta(1, {"tb": table}, blocking=True)
+                        assert table.stats()["journal"]["entries"] == 0
+                    if step == kill_step:
+                        procs[1].kill()
+                        procs[1].wait()
+                        lo1, hi1 = spec.bounds(1)
+                        port1 = int(eps[1].rsplit(":", 1)[1])
+
+                        def _restart():
+                            time.sleep(0.3)
+                            procs[1], _ = tpf._launch_pserver(
+                                [f"tb:{lo1}:{hi1}"], port=port1)
+
+                        restarter = threading.Thread(target=_restart,
+                                                     daemon=True)
+                        restarter.start()
+                    (lv,) = tier.run_step(exe, prep, fetch_list=[loss])
+                    losses.append(float(np.asarray(lv)))
+                    step += 1
+                tier.flush()
+                final = table.dump_full()
+            finally:
+                tier.close()
+        return losses, final
+    finally:
+        if restarter is not None:
+            restarter.join(timeout=10.0)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def test_sigkill_pserver_delta_recovery_bitwise(tmp_path, monkeypatch):
+    """The delta-era SIGKILL acceptance cell: kill a socket pserver AFTER
+    a save_delta truncated the journal. Recovery must compose the delta
+    into the base (the truncated entries exist nowhere else) and replay
+    only the tail — losses and final bytes bitwise vs uninterrupted."""
+    tpf._fast_retry(monkeypatch)
+    feeds = tpe._feeds()
+    ref_losses, ref_final = tpe._packed_baseline(feeds)
+    losses, final = _run_chaos_with_delta(tmp_path, feeds,
+                                          delta_step=3, kill_step=5)
+    assert losses == ref_losses
+    np.testing.assert_array_equal(final, ref_final)
+
+
+# ======================================================= streaming ingestion
+
+def test_parse_multislot_line_roundtrip_and_framing_errors():
+    pairs = parse_multislot_line("3 5 6 7 1 1.5", ["ids", "lbl"], "if")
+    assert pairs == [("ids", [5, 6, 7]), ("lbl", [1.5])]
+    with pytest.raises(ValueError, match="trailing"):
+        parse_multislot_line("1 5 99", ["ids"])
+    with pytest.raises(ValueError, match="ends before"):
+        parse_multislot_line("1 5", ["ids", "lbl"])
+    with pytest.raises(ValueError, match="claims"):
+        parse_multislot_line("4 1 2 3", ["ids"])
+
+
+def _dict_source(n):
+    def gen():
+        for i in range(n):
+            yield {"ids": np.array([i % 7, (i + 1) % 7, (i + 2) % 7],
+                                   np.int64),
+                   "lbl": np.array([float(i % 2)], np.float32)}
+    return gen
+
+
+def test_streaming_dataset_batches_heldout_and_bounds():
+    ds = StreamingDataset(_dict_source(23), batch_size=4, held_out_every=5,
+                          max_batches=3)
+    batches = list(ds.batches())
+    assert len(batches) == 3                # bounded drain
+    assert batches[0]["ids"].shape == (4, 3)
+    assert batches[0]["lbl"].shape == (4, 1)
+    # lazy source: exactly 14 samples consumed (12 trained + #5, #10 held)
+    assert ds.stats()["samples"] == 14 and ds.eval_size == 2
+    # a second drain re-invokes the callable source (a live tail)
+    ds.max_batches = None
+    more = list(ds.batches())
+    assert len(more) == 4                   # 23 - 5 held out = 18 -> 4 full
+    eval_feeds = list(ds.eval_batches())
+    assert eval_feeds and eval_feeds[0]["ids"].shape[1] == 3
+    st = ds.stats()
+    assert st["samples"] == 37 and st["eval_window"] == ds.eval_size == 7
+
+    ds.set_drop_last(False)
+    ragged = list(ds.batches())
+    assert len(ragged) == 5
+    assert ragged[-1]["ids"].shape[0] == 2  # 18 % 4 tail kept
+
+
+def test_streaming_dataset_text_lines_and_use_var_filter():
+    lines = ["3 1 2 3 1 1", "3 4 5 6 1 0"]
+    ds = StreamingDataset(lines, slots=["ids", "lbl"], slot_types="if",
+                          batch_size=2)
+    [b] = list(ds.batches())
+    np.testing.assert_array_equal(b["ids"], [[1, 2, 3], [4, 5, 6]])
+    np.testing.assert_array_equal(np.asarray(b["lbl"]).ravel(), [1.0, 0.0])
+
+    ids_var = type("V", (), {"name": "ids"})()
+    ds2 = StreamingDataset(_dict_source(4), batch_size=2)
+    ds2.set_use_var([ids_var])
+    [b2, _] = list(ds2.batches())
+    assert set(b2) == {"ids"}               # lbl filtered out
+
+    ds3 = StreamingDataset(iter([{"ids": [1, 2, 3]},
+                                 {"lbl": [1.0]}]), batch_size=2)
+    with pytest.raises(ValueError, match="every sample"):
+        list(ds3.batches())
+
+    with pytest.raises(ValueError, match="slots"):
+        list(StreamingDataset(["1 5"], batch_size=1).batches())
+
+
+def test_data_generator_feeds_streaming_dataset():
+    """Satellite: a reference-style DataGenerator plugs into the
+    streaming path via iter_samples — no text round-trip — and its
+    _gen_str text round-trips through parse_multislot_line."""
+    from paddle_tpu.data_generator import (MultiSlotDataGenerator,
+                                           MultiSlotStringDataGenerator)
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def reader():
+                toks = line.split(",")
+                yield [("ids", [int(t) for t in toks[:3]]),
+                       ("lbl", [int(toks[3])])]
+            return reader
+
+    g = Gen()
+    g.set_batch(1)
+    lines = ["1,2,3,1", "4,5,6,0"]
+    samples = list(g.iter_samples(lines))
+    assert samples[0] == [("ids", [1, 2, 3]), ("lbl", [1])]
+
+    ds = StreamingDataset(lambda: g.iter_samples(lines), batch_size=2)
+    [b] = list(ds.batches())
+    np.testing.assert_array_equal(b["ids"], [[1, 2, 3], [4, 5, 6]])
+
+    # text path: _gen_str output parses back to the same pairs
+    text = g._gen_str(samples[0])
+    assert parse_multislot_line(text.strip(), ["ids", "lbl"]) == \
+        [("ids", [1, 2, 3]), ("lbl", [1])]
+    with pytest.raises(ValueError):
+        g._gen_str([])                      # empty sample mis-frames
+    with pytest.raises(ValueError):
+        g._gen_str([("ids", [])])           # empty slot mis-frames
+
+    # the string generator emits values verbatim (reference drift fix:
+    # no str() pass over pre-stringified feasigns)
+    gs = MultiSlotStringDataGenerator()
+    assert gs._gen_str([("ids", ["1", "2"]), ("lbl", ["0"])]) \
+        == "2 1 2 1 0\n"
+    with pytest.raises(ValueError):
+        gs._gen_str([("ids", [])])
+
+
+def test_train_from_dataset_accepts_streaming_dataset():
+    """StreamingDataset speaks the DatasetBase protocol end-to-end:
+    Executor.train_from_dataset drains it like a QueueDataset."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1, bias_attr=False,
+                         param_attr=ParamAttr(name="w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    rng = np.random.RandomState(5)
+
+    def src():
+        for _ in range(32):
+            xv = rng.uniform(-1, 1, 4).astype(np.float32)
+            yield {"x": xv, "y": np.array([xv.sum()], np.float32)}
+
+    ds = StreamingDataset(src, batch_size=8)
+    ds.set_use_var([v for v in [main.global_block().var("x"),
+                                main.global_block().var("y")]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.train_from_dataset(main, ds, fetch_list=[loss])
+
+
+# ============================================================== delta push
+
+def test_delta_publisher_coalesces_last_write_wins():
+    table = ShardedTable.build_in_process(
+        "tb", RangeSpec.even(V, 2), full_rows=tpe._rand_rows(V, seed=41))
+    got = []
+    pub = DeltaPublisher(table, staleness_s=5.0, start=False)
+    pub.subscribe(lambda name, uids, rows: got.append(
+        (name, uids.copy(), rows.copy())))
+
+    def sick(name, uids, rows):
+        raise RuntimeError("replica down")
+    pub.subscribe(sick)
+    tail = []
+    pub.subscribe(lambda name, uids, rows: tail.append(uids.size))
+
+    r1 = tpe._rand_rows(2, seed=42)
+    r2 = tpe._rand_rows(1, seed=43)
+    table.push(np.array([5, 30], np.int64), r1)
+    table.push(np.array([5], np.int64), r2)      # newer bytes for uid 5
+    err0 = get_registry().counter("stream/subscriber_errors",
+                                  table="tb").value
+    assert pub.flush() == 2
+    name, uids, rows = got[-1]
+    assert name == "tb" and uids.tolist() == [5, 30]
+    np.testing.assert_array_equal(rows[0], r2[0])  # last write wins
+    np.testing.assert_array_equal(rows[1], r1[1])
+    # the sick subscriber neither stalls the flush nor starves siblings
+    assert tail == [2]
+    assert get_registry().counter("stream/subscriber_errors",
+                                  table="tb").value == err0 + 1
+    assert pub.flush() == 0                      # drained
+    p = pub.staleness_percentiles()
+    assert p["p50"] is not None and p["p99"] >= p["p50"]
+
+    pub.close()                                  # detaches the listener
+    table.push(np.array([7], np.int64), tpe._rand_rows(1, seed=44))
+    assert pub.flush() == 0
+
+
+def test_delta_publisher_background_flush_within_budget():
+    table = ShardedTable.build_in_process(
+        "tb", RangeSpec.even(V, 2), full_rows=tpe._rand_rows(V, seed=45))
+    seen = threading.Event()
+    with DeltaPublisher(table, staleness_s=0.2) as pub:
+        pub.subscribe(lambda *a: seen.set())
+        table.push(np.array([3], np.int64), tpe._rand_rows(1, seed=46))
+        assert seen.wait(5.0)                    # contract: ~0.2 s
+        p = pub.staleness_percentiles()
+        assert p["max"] is not None and p["max"] < 5000.0
+
+
+def test_row_cache_update_refreshes_residents_only():
+    from paddle_tpu.inference.ps_lookup import RowCache
+    c = RowCache(4, LANES)
+    first = tpe._rand_rows(2, seed=52)
+    c.insert(np.array([3, 9], np.int64), first)
+    fresh = tpe._rand_rows(3, seed=53)
+    n = c.update(np.array([3, 7, 9], np.int64), fresh)
+    assert n == 2 and len(c) == 2                # 7 skipped, never inserted
+    got, miss = c.lookup(np.array([3, 9], np.int64))
+    assert not miss.any()
+    np.testing.assert_array_equal(got[0], fresh[0])
+    np.testing.assert_array_equal(got[1], fresh[2])
+
+
+def test_hot_cache_drop_rows_spares_dirty_rows():
+    """attach_hot_cache semantics for a foreign tier's slab: clean
+    residents drop (next touch re-pulls fresh bytes), dirty rows keep
+    their pending write-back."""
+    from paddle_tpu.ps.hot_cache import HotRowCache
+    hc = HotRowCache(capacity=8, step_rows=4, lanes=LANES, vocab=100,
+                     min_freq=1)
+    plan = hc.plan(np.array([1, 2, 3], np.int64), np.array([1, 1, 1]))
+    hc.commit(plan)
+    # post-commit the rows are dirty (newest bytes live in the slab):
+    # drop_rows must refuse to drop them
+    assert hc.drop_rows(np.array([1, 2, 3], np.int64)) == 0
+    u, _ = hc.flush_rows()                       # write-back: rows now clean
+    assert u.tolist() == [1, 2, 3]
+    s2 = hc._slots.get(2)
+    hc._dirty[s2] = True                         # a newer local update
+    dropped = hc.drop_rows(np.array([1, 2, 3], np.int64))
+    assert dropped == 2
+    assert hc._slots.get(2) is not None          # dirty survived
+    assert hc._slots.get(1) is None and hc._slots.get(3) is None
+
+
+# ============================================================ ps_admin vocab
+
+def test_ps_admin_vocab_fields_aggregation_and_near_cap():
+    from paddle_tpu.tools import ps_admin
+    sh = DynamicEmbeddingShard("tb", 0, 100, capacity=10)
+    sh.pull(np.arange(10, dtype=np.int64))       # 100% occupancy
+    payloads = [("h1:1", {"tb": sh.stats()}), ("h2:2", None)]
+    v = ps_admin.vocab_fields(payloads)
+    t = v["tables"]["tb"]
+    assert t["live_rows"] == 10 and t["provisioned_rows"] == 10
+    assert t["utilization"] == 1.0
+    assert v["near_cap"] and v["near_cap"][0]["endpoint"] == "h1:1"
+
+    # static-only fleets have no vocab block
+    static = EmbeddingShard("tb", 0, 5, rows=np.zeros((5, LANES), np.uint16))
+    assert ps_admin.vocab_fields([("h", {"tb": static.stats()})]) is None
+
+
+def test_ps_admin_dump_health_flags_near_cap_as_degraded(capsys):
+    import json
+
+    from paddle_tpu.tools import ps_admin
+    sh = DynamicEmbeddingShard("tb", 0, 100, capacity=10)
+    sh.pull(np.arange(10, dtype=np.int64))
+    srv = ShardServer([sh]).serve_in_thread()
+    try:
+        rc = ps_admin.main(["dump-health", "--endpoints", srv.endpoint,
+                            "--json"])
+        assert rc == 0                           # up (degraded != down)
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["status"] == "degraded"
+        assert "row cap" in doc["detail"]
+        assert doc["shards"][0]["near_cap"] is True
+        assert doc["vocab"]["tables"]["tb"]["live_rows"] == 10
+    finally:
+        srv.stop()
+
+
+def test_ps_admin_stats_includes_vocab_block(capsys):
+    import json
+
+    from paddle_tpu.tools import ps_admin
+    sh = DynamicEmbeddingShard("tb", 0, 100, capacity=100)
+    sh.pull(np.arange(5, dtype=np.int64))
+    srv = ShardServer([sh]).serve_in_thread()
+    try:
+        rc = ps_admin.main(["stats", "--endpoints", srv.endpoint, "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["vocab"]["tables"]["tb"]["live_rows"] == 5
+        assert doc["vocab"]["near_cap"] == []
+    finally:
+        srv.stop()
+
+
+# ======================================================== online smoke + soak
+
+def _online_program(vocab_rows):
+    """Labelled CTR-style model: score(sample) = sum of its ids' visible
+    embedding columns, regressed onto the click label. Embedding-only
+    (no dense params), so the serving predictor's state is exactly the
+    PS table."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [F], dtype="int64")
+        lbl = layers.data("lbl", [1], dtype="float32")
+        emb = layers.embedding(
+            ids, [vocab_rows, D * MULT], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb", initializer=RowPackInitializer(
+                D, D * MULT, -0.01, 0.01)))
+        emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+        score = layers.reshape(layers.reduce_sum(emb, dim=[1, 2]), [-1, 1])
+        loss = layers.mean(layers.square_error_cost(score, lbl))
+        fluid.optimizer.Adagrad(
+            0.1, packed_rows={"rows_per_step": CAP}).minimize(loss)
+    return main, startup, loss
+
+
+def _save_online_model(model_dir, vocab_rows):
+    """The inference half of _online_program (ids -> score), saved with a
+    cache-sized table for PsLookupPredictor to fill per request."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [F], dtype="int64")
+        emb = layers.embedding(
+            ids, [vocab_rows, D * MULT], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb", initializer=RowPackInitializer(
+                D, D * MULT, -0.01, 0.01)))
+        emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+        score = layers.reshape(layers.reduce_sum(emb, dim=[1, 2]), [-1, 1])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["ids"], [score], exe, main)
+
+
+def _ctr_source(vocab, seed=11, cfg=None):
+    """Endless labelled stream: each id has a latent weight; the label is
+    the sign of the sample's weight sum. ``cfg`` is a LIVE dict — with
+    ``hot_frac`` > 0, that share of samples draws from the first
+    ``hot_ids`` ids (the skew that makes eviction of the cold tail
+    survivable); the soak flips it mid-stream."""
+    rng = np.random.RandomState(seed)
+    w = rng.uniform(-1.0, 1.0, vocab)
+    cfg = cfg if cfg is not None else {}
+
+    def gen():
+        while True:
+            hf = cfg.get("hot_frac", 0.0)
+            if hf and rng.uniform() < hf:
+                ids = rng.randint(0, cfg["hot_ids"], F)
+            else:
+                ids = rng.randint(0, vocab, F)
+            lbl = 1.0 if w[ids].sum() > 0 else 0.0
+            yield {"ids": ids.astype(np.int64),
+                   "lbl": np.array([lbl], np.float32)}
+    return gen
+
+
+def _auc_readings(trainer):
+    return [v for _, v in trainer.history["eval"] if not np.isnan(v)]
+
+
+def test_online_smoke_auc_improves_and_serving_is_fresh(tmp_path):
+    """The ~30 s tier-1 cell: one process trains a dynamic-vocab PS table
+    from an endless stream while a PsLookupPredictor serves lookups
+    against the SAME table — eval AUC (scored through the predictor,
+    i.e. through serving bytes) improves, delta checkpoints land on the
+    cadence, and after the final publisher flush every row resident in
+    the serving cache is bitwise-fresh vs the shards."""
+    from paddle_tpu import inference
+
+    vocab = 60
+    spec = RangeSpec.even(vocab, 2)
+    shards = make_dynamic_shards("tb", spec, capacity_per_shard=vocab)
+    table = ShardedTable("tb", spec, [InProcessClient([s]) for s in shards])
+
+    _save_online_model(str(tmp_path / "m"), CAP)
+    base = inference.create_predictor(inference.Config(str(tmp_path / "m")))
+    ps = inference.PsLookupPredictor(
+        base, [inference.PsLookupBinding("tb", table, ["ids"])],
+        cache_rows_per_table=vocab)
+
+    pub = DeltaPublisher(table, staleness_s=0.5)
+    pub.attach_predictor(ps)
+
+    ds = StreamingDataset(_ctr_source(vocab), batch_size=B,
+                          held_out_every=5, eval_window=160)
+    main, startup, loss = _online_program(CAP)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        ck = Checkpointer(str(tmp_path / "ck"))
+        ck.save(0, program=main, scope=sc, blocking=True,
+                ps_tables={"tb": table})
+        tier = PsEmbeddingTier(main, [PsTableBinding("tb", table, ["ids"])],
+                               pull_ahead=1, push_depth=0)
+
+        def score_fn(feed):
+            return ps.run({"ids": feed["ids"]})[0]
+
+        trainer = OnlineTrainer(
+            exe, main, tier, ds, fetch_list=[loss], scope=sc,
+            ps_tables={"tb": table}, checkpointer=ck, publishers=[pub],
+            sweep_every=50, delta_every=25, compact_every=4,
+            eval_every=20, eval_fn=lambda: eval_auc(ds, score_fn, "lbl"))
+        try:
+            assert trainer.run(max_steps=200) == 200
+            trainer.finish()
+            # freshness: every row the serving cache holds matches the
+            # shard bytes exactly (the publisher refreshed residents in
+            # place) — checked while the table transport is still open
+            cache = ps._caches["tb"]
+            res_uids, _ = cache._slots.residents()
+            assert res_uids.size > 0
+            uids = np.sort(res_uids.astype(np.int64))
+            got, miss = cache.lookup(uids)
+            assert not miss.any()
+            np.testing.assert_array_equal(got, table.pull(uids))
+        finally:
+            tier.close()
+            pub.close()
+
+        aucs = _auc_readings(trainer)
+        assert len(aucs) >= 3
+        # serving-side AUC improves along the stream (scored through the
+        # predictor: post-delta-push bytes, not trainer-local state)
+        assert aucs[-1] > 0.75, aucs
+        assert aucs[-1] > aucs[0] + 0.05, aucs
+
+        # incremental checkpoints landed and verify
+        deltas = ck.delta_steps(0)
+        assert deltas and all(ck.verify_delta(0, d) == [] for d in deltas)
+
+        # loss actually fell
+        losses = trainer.history["loss"]
+        assert np.mean(losses[-20:]) < np.mean(losses[:20])
+
+
+@pytest.mark.slow
+def test_online_soak_growth_eviction_staleness_and_midrun_restore(tmp_path):
+    """The soak cell: a longer skewed stream over a dynamic table whose
+    slab is ~8x smaller than the id space. Asserts the full acceptance
+    list: AUC keeps improving, the vocab grows past the provisioned
+    rows while live rows stay capped, delta-push staleness holds p99
+    within budget, and a mid-run delta checkpoint restores bitwise."""
+    from paddle_tpu import inference
+
+    vocab = 4000
+    hot_ids = 120
+    cap_per_shard = 256
+    spec = RangeSpec.even(vocab, 2)
+    shards = make_dynamic_shards("tb", spec, capacity_per_shard=cap_per_shard,
+                                 high_watermark=0.9, low_watermark=0.7,
+                                 keep_freq=3)
+    table = ShardedTable("tb", spec, [InProcessClient([s]) for s in shards])
+
+    _save_online_model(str(tmp_path / "m"), CAP)
+    base = inference.create_predictor(inference.Config(str(tmp_path / "m")))
+    ps = inference.PsLookupPredictor(
+        base, [inference.PsLookupBinding("tb", table, ["ids"])],
+        cache_rows_per_table=512)
+    staleness_s = 1.0
+    pub = DeltaPublisher(table, staleness_s=staleness_s)
+    pub.attach_predictor(ps)
+
+    cfg = {"hot_frac": 0.9, "hot_ids": hot_ids}
+    ds = StreamingDataset(_ctr_source(vocab, cfg=cfg),
+                          batch_size=B, held_out_every=5, eval_window=240)
+    main, startup, loss = _online_program(CAP)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        ck = Checkpointer(str(tmp_path / "ck"))
+        ck.save(0, program=main, scope=sc, blocking=True,
+                ps_tables={"tb": table})
+        tier = PsEmbeddingTier(main, [PsTableBinding("tb", table, ["ids"])],
+                               pull_ahead=1, push_depth=0)
+
+        def score_fn(feed):
+            return ps.run({"ids": feed["ids"]})[0]
+
+        trainer = OnlineTrainer(
+            exe, main, tier, ds, fetch_list=[loss], scope=sc,
+            ps_tables={"tb": table}, checkpointer=ck, publishers=[pub],
+            sweep_every=40, delta_every=0, compact_every=0,
+            eval_every=40, eval_fn=lambda: eval_auc(ds, score_fn, "lbl"))
+        try:
+            # phase 1: growth + eviction under the skewed stream
+            trainer.run(max_steps=400)
+            st = [s.stats() for s in shards]
+            assert sum(s["materialized"] for s in st) \
+                > 2 * cap_per_shard                     # grew past provisioned
+            assert all(s["live_rows"] <= cap_per_shard for s in st)
+            assert sum(s["evicted"] for s in st) > 0
+            assert all(s["slab_bytes"] == cap_per_shard * LANES * 2
+                       for s in st)
+
+            # phase 2: compact (full save re-anchors the chain on the
+            # post-eviction state), then train on the resident hot set
+            # only — the delta-restore contract is bitwise for rows not
+            # evicted since the chain base, so this phase admits no new
+            # ids (no admission evictions, no serving-pull faults)
+            tier.flush()
+            ck.save(trainer.step, program=main, scope=sc, blocking=True,
+                    ps_tables={"tb": table})
+            cfg["hot_frac"] = 1.0
+            trainer.sweep_every = 0
+            eval_every, trainer.eval_every = trainer.eval_every, 0
+            trainer.run(max_steps=60)
+            tier.flush()
+            ck.save_delta(trainer.step + 1, {"tb": table}, blocking=True)
+            expected = table.dump_full()
+            restored, _, _ = ck.load_ps_table("tb")
+            np.testing.assert_array_equal(restored, expected)
+
+            # phase 3: back to the full skewed stream; serving stays
+            # fresh + AUC holds up
+            cfg["hot_frac"] = 0.9
+            trainer.sweep_every = 40
+            trainer.eval_every = eval_every
+            trainer.run(max_steps=120)
+            trainer.finish()
+
+            # serving cache bitwise-fresh after the final flush (checked
+            # while the table transport is still open)
+            cache = ps._caches["tb"]
+            res_uids, _ = cache._slots.residents()
+            uids = np.sort(res_uids.astype(np.int64))
+            if uids.size:
+                got, miss = cache.lookup(uids)
+                assert not miss.any()
+                np.testing.assert_array_equal(got, table.pull(uids))
+        finally:
+            tier.close()
+            pub.close()
+
+        aucs = _auc_readings(trainer)
+        assert len(aucs) >= 5
+        assert aucs[-1] > 0.70, aucs
+        assert aucs[-1] > aucs[0], aucs
+
+        p = pub.staleness_percentiles()
+        assert p["p99"] is not None
+        assert p["p99"] <= staleness_s * 1e3 * 1.5, p   # budget + CI slack
