@@ -14,9 +14,28 @@ import time
 
 import numpy as np
 
-# v5e bf16 peak; CPU placeholder for non-TPU smoke runs
 def _peak_flops(on_tpu):
-    return 197e12 if on_tpu else 1e12
+    """Chip peak (bf16 on TPU) — shared constant in
+    observability/calibrate.py; every MFU in this file uses it."""
+    from paddle_tpu.observability.calibrate import peak_flops
+    return peak_flops(on_tpu)
+
+
+def _calibration(on_tpu, recalibrate=False):
+    """Shared chip floors (observability/calibrate.py): measured once per
+    machine, disk-cached, read by every section INCLUDING the subprocess
+    children (nmt_big etc. hit the same cache file instead of
+    re-measuring). Replaces the old per-invocation _measure_floors;
+    `bench.py --recalibrate` forces a fresh measurement."""
+    from paddle_tpu.observability import calibrate
+    try:
+        return calibrate.get_calibration(recalibrate=recalibrate)
+    except Exception:  # profiler/trace failures must not kill the bench
+        floors = (calibrate._FALLBACK_TPU if on_tpu
+                  else calibrate._PLACEHOLDER_CPU)
+        return calibrate.Calibration(
+            "unknown", on_tpu, floors[0], floors[1],
+            calibrate.peak_flops(on_tpu), "fallback")
 
 
 def _device_memory_snapshot():
@@ -187,101 +206,14 @@ def _time_steps(exe, prog, feed, loss, iters):
     return (time.time() - t0) / iters
 
 
-def _measure_floors(on_tpu):
-    """Self-measured chip floors for the ResNet roofline metric, run
-    fresh on every bench invocation (VERDICT r3 #1: 'prove it with
-    traces, not prose'). Both microbenches CHAIN the work inside one jit
-    (lax.scan / dependent matmuls) and sync with a host readback of one
-    element: on this tunnel runtime `block_until_ready` acks before device
-    completion and a single dispatch carries ~4 ms of latency, so
-    unchained host-timed micro-numbers are garbage (round 3's '450 GB/s
-    elementwise / 140 GB/s reduction' rates were that artifact — the
-    in-trace kernel times show ~660 GB/s stream and ~760 GB/s for the
-    one-pass BN stats read on the same shapes).
 
-    Rates are read from the xplane trace (per-kernel device durations),
-    NOT host timers: host-timed chains are distorted by ~1 ms/iteration
-    of while-loop runtime overhead under lax.scan, and XLA fuses unrolled
-    elementwise chains into one kernel — both yielded bogus 255-350 GB/s
-    'stream' rates where the trace shows ~660 GB/s for the very kernels
-    involved.
-
-    Returns (matmul_tflops, stream_gbs)."""
-    if not on_tpu:
-        return 1.0, 10.0
-    import collections
-    import glob
-    import gzip
-    import json as _json
-    import tempfile
-
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    a = jax.random.normal(jax.random.PRNGKey(0), (8192, 8192), jnp.bfloat16)
-
-    @jax.jit
-    def mm_chain(a):
-        def body(c, _):
-            return c @ a, None
-        y, _ = lax.scan(body, a, None, length=10)
-        return y
-
-    x = jax.random.normal(jax.random.PRNGKey(1), (256 * 1024 * 1024,),
-                          jnp.bfloat16)
-
-    @jax.jit
-    def add_chain(x):
-        def body(c, _):
-            return c * jnp.bfloat16(1.0001) + jnp.bfloat16(1e-3), None
-        y, _ = lax.scan(body, x, None, length=20)
-        return y
-
-    def leaf_kernel_us(run):
-        """Trace one run; sum device-side LEAF kernel time (drop the
-        `while` loop-overhead span, the jit_* parent spans, and step
-        markers — only actual kernels count)."""
-        tdir = tempfile.mkdtemp(prefix="pdtpu_floors_")
-        with jax.profiler.trace(tdir):
-            run()
-        traces = glob.glob(tdir + "/plugins/profile/*/*.trace.json.gz")
-        if not traces:
-            return 0.0
-        with gzip.open(traces[0]) as f:
-            tr = _json.load(f)
-        dev_pids = {e["pid"] for e in tr["traceEvents"]
-                    if e.get("ph") == "M" and e.get("name") == "process_name"
-                    and "TPU" in e["args"].get("name", "")}
-        total = 0.0
-        for e in tr["traceEvents"]:
-            nm = e.get("name", "")
-            if (e.get("ph") == "X" and e.get("pid") in dev_pids
-                    and nm != "while" and not nm.startswith("jit_")
-                    and not nm.isdigit()):
-                total += e.get("dur", 0.0)
-        return total
-
-    for f in (lambda: mm_chain(a), lambda: add_chain(x)):  # compile
-        np.asarray(jax.device_get(
-            jax.tree_util.tree_leaves(f())[0].ravel()[:1]))
-    mm_us = leaf_kernel_us(
-        lambda: np.asarray(jax.device_get(mm_chain(a)[:1, :1])))
-    add_us = leaf_kernel_us(
-        lambda: np.asarray(jax.device_get(add_chain(x)[:1])))
-    if not mm_us or not add_us:  # trace unavailable: conservative fallback
-        return 60.0, 350.0
-    mm_rate = 10 * 2 * 8192**3 / (mm_us * 1e-6)
-    stream = 20 * 2 * x.size * 2 / (add_us * 1e-6)
-    return mm_rate / 1e12, stream / 1e9
-
-
-def bench_resnet(on_tpu, floors=None):
+def bench_resnet(on_tpu, calib=None):
     """ResNet-50 train-step throughput (BASELINE config 2). Returns
     (imgs_per_sec, mfu, step_ms, roofline dict).
 
     Round-4 roofline (supersedes round 3, whose microbench rates were
-    depressed by tunnel dispatch artifacts — see _measure_floors). Wall
+    depressed by tunnel dispatch artifacts — see
+    observability/calibrate.py:measure_floors). Wall
     step 59.8→~51 ms at batch 128 this round from host-dispatch fixes
     alone (executor._AutoLayoutStep fast path: per-step signature hashing
     + per-leaf Format construction was ~13 ms/step of unhidden Python).
@@ -359,11 +291,13 @@ def bench_resnet(on_tpu, floors=None):
                 rng.randint(0, classes, (batch, 1)).astype("int32")),
         }
         dt = _time_steps(exe, main_prog, feed, loss, 20 if on_tpu else 2)
-        floors = floors or _measure_floors(on_tpu)
+        calib = calib or _calibration(on_tpu)
+        floors = calib.floors
         per_kernel = None
         if on_tpu:
             try:
-                per_kernel = _per_kernel_table(
+                from paddle_tpu.tools.roofline import capture_kernel_table
+                per_kernel = capture_kernel_table(
                     lambda: exe.run(main_prog, feed=feed,
                                     fetch_list=[loss]), floors)
             except Exception as e:  # trace plumbing must not kill the bench
@@ -371,7 +305,6 @@ def bench_resnet(on_tpu, floors=None):
     imgs_per_sec = batch / dt
     # ResNet-50 @224²: ~4.1 GFLOP fwd; fwd+bwd ≈ 3×
     flops_per_img = 3 * 4.1e9 if hw == 224 else 3 * 4.1e9 * (hw / 224) ** 2
-    mfu = imgs_per_sec * flops_per_img / _peak_flops(on_tpu)
 
     # self-measured no-overlap floor (see docstring): conv FLOPs at the
     # chip's measured chained-matmul rate, plus SIX mandatory activation
@@ -394,104 +327,36 @@ def bench_resnet(on_tpu, floors=None):
     # staging already removes ~3 passes' worth vs the structural count.
     floor6_ms = conv_floor_ms + 6 * 2.71 * scale / stream_gbs * 1e3
     floor13_ms = conv_floor_ms + 13 * 2.71 * scale / stream_gbs * 1e3
+    # shared attribution (observability/perf.py): MFU and the max(mm,
+    # stream) roofline fraction from the same code every compiled program
+    # reports through the perf/* gauges. The 6-pass frac above stays the
+    # headline — it models the SUM of non-overlapping conv + activation
+    # passes, a tighter convnet-specific bound than attribute()'s max.
+    from paddle_tpu.observability import perf
+    att = perf.attribute(flops=batch * flops_per_img,
+                         bytes_accessed=6 * 2.71e9 * scale,
+                         seconds=dt, calib=calib)
+    mfu = att["mfu"]
     roofline = {
         "matmul_tflops_meas": round(mm_tflops, 1),
         "stream_gbs_meas": round(stream_gbs, 1),
+        "calibration_source": calib.source,
         "conv_floor_ms": round(conv_floor_ms, 2),
         "floor6_ms": round(floor6_ms, 2),
         "floor13_ms": round(floor13_ms, 2),
         "frac": round(min(1.0, floor6_ms / (dt * 1e3)), 4),
         "frac_vs_structural_13pass": round(
             min(1.0, floor13_ms / (dt * 1e3)), 4),
+        "attribution": {k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in att.items()},
         "per_kernel": per_kernel,
     }
     return (round(imgs_per_sec, 2), round(mfu, 4), round(dt * 1e3, 2),
             roofline)
 
 
-def _per_kernel_table(run_step, floors, steps=2, cutoff_ms=0.5):
-    """Per-kernel device-time accounting from a live trace (VERDICT r4
-    #2): every kernel >= cutoff_ms per step with achieved GB/s (from the
-    HLO cost model's bytes_accessed — includes VMEM-staged re-reads, so
-    utilization can exceed 1.0) and TFLOP/s, plus `util_vs_bound` = the
-    kernel's achieved fraction of whichever measured chip bound (stream
-    or matmul) it is closer to. The tail is summarized in aggregate."""
-    import collections
-    import glob
-    import gzip
-    import json as _json
-    import tempfile
 
-    import jax
-
-    mm_tflops, stream_gbs = floors
-    import shutil
-
-    run_step()  # warm
-    tdir = tempfile.mkdtemp(prefix="pdtpu_kernels_")
-    try:
-        with jax.profiler.trace(tdir):
-            for _ in range(steps):
-                run_step()
-        traces = glob.glob(tdir + "/plugins/profile/*/*.trace.json.gz")
-        if not traces:
-            return {"error": "no trace captured"}
-        with gzip.open(traces[0]) as f:
-            tr = _json.load(f)
-    finally:
-        shutil.rmtree(tdir, ignore_errors=True)
-    pidname = {e["pid"]: e["args"].get("name", "") for e in tr["traceEvents"]
-               if e.get("ph") == "M" and e.get("name") == "process_name"}
-    tidname = {(e["pid"], e.get("tid")): e["args"].get("name", "")
-               for e in tr["traceEvents"]
-               if e.get("ph") == "M" and e.get("name") == "thread_name"}
-    agg = collections.defaultdict(lambda: [0.0, 0, 0.0, 0.0])
-    for e in tr["traceEvents"]:
-        k = (e.get("pid"), e.get("tid"))
-        if (e.get("ph") == "X" and "TPU" in pidname.get(e.get("pid"), "")
-                and tidname.get(k) == "XLA Ops"):
-            a = agg[e["name"]]
-            a[0] += e.get("dur", 0.0)
-            a[1] += 1
-            a[2] += float(e.get("args", {}).get("bytes_accessed", 0) or 0)
-            a[3] += float(e.get("args", {}).get("model_flops", 0) or 0)
-    if not agg:
-        return {"error": "no XLA Ops events in trace"}
-    total_us = sum(a[0] for a in agg.values())
-    rows = []
-    tail_us = tail_by = tail_fl = tail_n = 0
-    for nm, (us, c, by, fl) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-        ms = us / steps / 1e3
-        gbs = by / (us * 1e-6) / 1e9 if us else 0.0
-        tfs = fl / (us * 1e-6) / 1e12 if us else 0.0
-        if ms >= cutoff_ms:
-            rows.append({"kernel": nm, "ms": round(ms, 3),
-                         "calls": c, "gbs": round(gbs, 1),
-                         "tfs": round(tfs, 1),
-                         "util_vs_bound": round(
-                             max(gbs / stream_gbs, tfs / mm_tflops), 3)})
-        else:
-            tail_us += us
-            tail_by += by
-            tail_fl += fl
-            tail_n += 1
-    return {
-        "device_ms_per_step": round(total_us / steps / 1e3, 2),
-        "kernels": rows,
-        "tail": {"n_kernel_names": tail_n,
-                 "ms": round(tail_us / steps / 1e3, 2),
-                 "gbs": round(tail_by / (tail_us * 1e-6) / 1e9, 1)
-                 if tail_us else 0.0,
-                 "tfs": round(tail_fl / (tail_us * 1e-6) / 1e12, 1)
-                 if tail_us else 0.0},
-        "aggregate_gbs": round(
-            sum(a[2] for a in agg.values()) / (total_us * 1e-6) / 1e9, 1),
-        "aggregate_tfs": round(
-            sum(a[3] for a in agg.values()) / (total_us * 1e-6) / 1e12, 1),
-    }
-
-
-def bench_deepfm(on_tpu, floors=None):
+def bench_deepfm(on_tpu, calib=None):
     """DeepFM CTR train-step (BASELINE config 5), round 5: CRITEO-scale
     33.5M-row table with the tables on EXACT Adagrad (VERDICT r4 #1 —
     "a real optimizer, not SGD-by-necessity") via the packed row-major
@@ -692,8 +557,15 @@ def bench_deepfm(on_tpu, floors=None):
     # headline rate is the best path (scan driver — or the hot-cache PS
     # arm — when it wins); the per-step dispatch time stays visible
     best = min(d for d in (dt, dt_scan, dt_hot_arm) if d is not None)
-    mm_tflops, stream_gbs = floors or _measure_floors(on_tpu)
-    achieved_gbs = bytes_total / best / 1e9
+    calib = calib or _calibration(on_tpu)
+    mm_tflops, stream_gbs = calib.floors
+    # shared attribution: with flops≈0 the roofline fraction IS
+    # achieved_gbs/stream_gbs — same number the old hand math produced,
+    # now from the code path every compiled program reports through
+    from paddle_tpu.observability import perf
+    att = perf.attribute(bytes_accessed=bytes_total, seconds=best,
+                         calib=calib)
+    achieved_gbs = att["achieved_gbs"]
     roofline = {
         "vocab": vocab,
         "optimizer": "adagrad (exact, packed row-major state-in-row)",
@@ -701,10 +573,11 @@ def bench_deepfm(on_tpu, floors=None):
         "actual_gb_per_step": round(actual_bytes / 1e9, 3),
         "effective_gbs": round(achieved_gbs, 1),
         "stream_gbs_meas": round(stream_gbs, 1),
+        "calibration_source": calib.source,
         "naive_adagrad_step_ms": naive_ms,
         "speedup_vs_naive": (round(naive_ms / (best * 1e3), 2)
                              if naive_ms else None),
-        "frac": round(min(1.0, achieved_gbs / stream_gbs), 4),
+        "frac": round(min(1.0, att["roofline_fraction"]), 4),
         "per_step_dispatch_ms": round(dt * 1e3, 2),
         "scan_step_ms": round(dt_scan * 1e3, 2) if dt_scan else None,
         "scan_k": scan_k,
@@ -915,6 +788,29 @@ def bench_ps_embedding(on_tpu):
         big["trained_green"] = False
         big["error"] = str(e)[:160]
 
+    # PS-tier roofline (shared calibration + attribution): the host
+    # pull/push row traffic the full-overlap arm moves per step, rated
+    # against the chip's stream floor. The overlap claim in hardware
+    # terms: frac << 1 says the step is NOT bound by moving rows — the
+    # prefetcher/pusher hide the traffic — while frac near 1 would mean
+    # the tier is saturating the only bound that could justify its cost.
+    ps_roofline = None
+    if on1["step_ms"]:
+        from paddle_tpu.observability import perf
+        calib = _calibration(on_tpu)
+        moved = sum(s["pulled"] + s["pushed"]
+                    for s in on1["per_shard_bytes"])
+        per_step = moved / max(len(feeds), 1)
+        att = perf.attribute(bytes_accessed=per_step,
+                             seconds=on1["step_ms"] / 1e3, calib=calib)
+        ps_roofline = {
+            "host_bytes_per_step": int(per_step),
+            "achieved_gbs": round(att["achieved_gbs"], 3),
+            "stream_gbs_meas": round(calib.stream_gbs, 1),
+            "calibration_source": calib.source,
+            "frac": round(att["roofline_fraction"], 4),
+        }
+
     out = {
         "batch": batch, "vocab": vocab, "num_shards": n_shards,
         "cache_rows": cap,
@@ -941,6 +837,7 @@ def bench_ps_embedding(on_tpu):
         "push_ms_p50": reg.histogram("ps/push_ms").percentile(50),
         # ISSUE 13: 1 Hz federation A/B + trace/metrics sidecars
         "federation": federation,
+        "roofline": ps_roofline,
         "big_table": big,
     }
     return out
@@ -1294,11 +1191,20 @@ def bench_nmt(on_tpu):
             dt = time.time() - t0
         total_flops = len(staged) * _nmt_flops_per_batch(cfg, B, Ts, Tt)
         n = len(staged)
+        # shared attribution: MFU and the matmul-floor roofline fraction
+        # from the same code path every compiled program reports through.
+        # This section runs in a subprocess child — the calibration comes
+        # from the shared disk cache the parent wrote, not a re-measure.
+        from paddle_tpu.observability import perf
+        calib = _calibration(on_tpu)
+        att = perf.attribute(flops=total_flops, seconds=dt, calib=calib)
         return {"T": T, "batch": B,
                 "hbm_plan": plan.to_dict(),
                 "tokens_per_sec": round(total_tok / dt, 1),
                 "step_ms": round(dt / n * 1e3, 2),
-                "mfu": round(total_flops / dt / _peak_flops(on_tpu), 4),
+                "mfu": round(att["mfu"], 4),
+                "roofline_frac": round(att["roofline_fraction"], 4),
+                "calibration_source": calib.source,
                 "fill_rate_tgt": round(fill_tgt / (n * B * Tt), 4),
                 "fill_rate_src": round(fill_src / (n * B * Ts), 4)}
 
@@ -1946,11 +1852,17 @@ def bench_online_learning(on_tpu):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
-def main():
+def main(gate_against=None, recalibrate=False):
     import jax
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu" or "tpu" in str(dev).lower()
+
+    # one calibration for the whole invocation (and, via the disk cache,
+    # for the subprocess sections too) — the old flow re-measured floors
+    # here on every run; now a machine measures once and --recalibrate
+    # is the escape hatch
+    calib = _calibration(on_tpu, recalibrate=recalibrate)
 
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
@@ -1995,18 +1907,14 @@ def main():
     tokens_per_sec = batch * seq / dt
     n_params = bert.param_count(cfg)
     flops_per_token = 6 * n_params  # fwd+bwd dense estimate
-    mfu = tokens_per_sec * flops_per_token / _peak_flops(on_tpu)
+    mfu = tokens_per_sec * flops_per_token / calib.peak_flops
 
     # second BASELINE metric: ResNet-50 imgs/s/chip (failures don't take
     # down the primary metric)
-    try:
-        floors = _measure_floors(on_tpu)
-    except Exception:  # profiler/trace failures must not kill the bench
-        floors = (60.0, 350.0)  # conservative fallback rates
     rn_err = None
     rn_roofline = None
     try:
-        rn_ips, rn_mfu, rn_ms, rn_roofline = bench_resnet(on_tpu, floors)
+        rn_ips, rn_mfu, rn_ms, rn_roofline = bench_resnet(on_tpu, calib)
     except Exception as e:  # pragma: no cover
         rn_ips, rn_mfu, rn_ms = None, None, None
         rn_err = str(e)[:120]
@@ -2017,7 +1925,7 @@ def main():
     rate = ms = err = None
     dfm_roofline = None
     try:
-        rate, ms, dfm_roofline = bench_deepfm(on_tpu, floors)
+        rate, ms, dfm_roofline = bench_deepfm(on_tpu, calib)
     except Exception as e:  # pragma: no cover
         err = str(e)[:120]
     extras2["deepfm_rate"] = rate
@@ -2148,7 +2056,11 @@ def main():
                                    if nmt_shapes else None)
     extras2["nmt_big_error"] = err
 
-    print(json.dumps({
+    extras2["nmt_big_roofline_frac"] = (nmt_shapes[0].get("roofline_frac")
+                                        if nmt_shapes else None)
+    extras2["calibration"] = calib.to_dict()
+
+    doc = {
         "metric": "ernie_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s/chip",
@@ -2165,11 +2077,36 @@ def main():
                   "resnet50_roofline_frac": (rn_roofline or {}).get("frac"),
                   "resnet50_roofline": rn_roofline,
                   **extras2},
-    }))
+    }
+    print(json.dumps(doc))
+
+    # regression gate (tools/perf_gate.py): the stated check for every
+    # future BENCH_r0x round. The report goes to stderr so stdout stays
+    # the single JSON line the driver parses; the exit code carries the
+    # verdict (0 pass, 1 regression, 2 unusable baseline).
+    if gate_against:
+        from paddle_tpu.tools.perf_gate import gate, load_doc
+        try:
+            base = load_doc(gate_against)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: {e}", file=sys.stderr)
+            return 2
+        return gate(doc, base, out=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
-        _run_section_child(sys.argv[2])
+    argv = sys.argv[1:]
+    if len(argv) >= 2 and argv[0] == "--section":
+        _run_section_child(argv[1])
     else:
-        main()
+        gate_path = None
+        if "--gate-against" in argv:
+            i = argv.index("--gate-against")
+            if i + 1 >= len(argv):
+                print("bench.py: --gate-against needs a baseline path",
+                      file=sys.stderr)
+                sys.exit(2)
+            gate_path = argv[i + 1]
+        sys.exit(main(gate_against=gate_path,
+                      recalibrate="--recalibrate" in argv))

@@ -637,6 +637,20 @@ class CompiledProgram:
 
         from ..observability.flight import get_flight_recorder
         from ..observability.steps import get_step_profiler
+        if compiling:
+            # perf ledger for the mesh executable: trace-only lower for
+            # XLA's cost numbers (the mesh jit is lazy — there is no AOT
+            # Compiled to ask), analytic IR walk otherwise
+            from ..observability import perf as _perf
+            lowered = None
+            if _perf.trace_cost_enabled():
+                try:
+                    lowered = fn.lower(state, feed_vals, key)
+                except Exception:
+                    lowered = None
+            _perf.get_ledger().register(
+                id(self._program), _sig_digest(feed_sig),
+                executable=lowered, program=program, feed=feed_vals)
         t0 = time.perf_counter()
         with get_flight_recorder().guard(
                 "CompiledProgram._run",

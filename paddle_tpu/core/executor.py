@@ -1250,6 +1250,24 @@ class Executor:
             # steady-state host dispatch time (device work is async on
             # real accelerators; on CPU this is the full step)
             _EXECUTE_MS.observe(dt_ms)
+        if compiling:
+            # perf ledger: one cost entry per (program, signature). The
+            # AUTO-layout AOT executable gives XLA's cost/memory analysis
+            # for free; the plain-jit fallback pays one trace-only lower
+            # (or falls back to the analytic IR walk). Registered before
+            # the profiler record so even the compile dispatch can see it.
+            from ..observability import perf as _perf
+            executable = getattr(fn, "_compiled", None)
+            if executable is None and _perf.trace_cost_enabled():
+                try:
+                    structs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                               for n, v in state.items()}
+                    executable = fn._plain.lower(structs, feed_vals, key)
+                except Exception:
+                    executable = None
+            _perf.get_ledger().register(
+                id(program), _sig_digest(feed_sig), executable=executable,
+                program=program, feed=feed_vals)
         _STEPS.record(dt_ms, program_id=id(program),
                       sig=_sig_digest(feed_sig), compiled=compiling)
 
@@ -1485,6 +1503,28 @@ class Executor:
         key = scope.find_var(_RNG_STATE)
         if key is None:
             key = _make_key(program.random_seed or 0)
+        if compiling:
+            # perf ledger for the scan executable: the cost entry covers
+            # the whole K-step dispatch. The scan jit is lazy, so XLA
+            # numbers come from a trace-only lower (before the call, while
+            # the state buffers are still live / undonated); the analytic
+            # fallback scales one IR-walk step by n.
+            from types import SimpleNamespace as _NS2
+
+            from ..observability import perf as _perf
+            lowered = None
+            if _perf.trace_cost_enabled():
+                try:
+                    lowered = fn.lower(state, stacked, key)
+                except Exception:
+                    lowered = None
+            per_step_feed = {
+                k: _NS2(shape=tuple(v.shape[1:]),
+                        nbytes=int(getattr(v, "nbytes", 0)) // max(n, 1))
+                for k, v in stacked.items()}
+            _perf.get_ledger().register(
+                id(program), _sig_digest(stacked_sig), executable=lowered,
+                program=program, feed=per_step_feed, steps=n)
         t0 = time.perf_counter()
         with _FLIGHT.guard(site,
                            program=f"0x{id(program):x}",

@@ -54,8 +54,11 @@ Quick start::
     obs.get_registry().dump_json("metrics.json") # registry export
     obs.get_tracer().export_chrome_trace("host_trace.json")
 """
+from . import calibrate  # noqa: F401
 from . import context  # noqa: F401
 from . import federate  # noqa: F401
+from . import perf  # noqa: F401
+from .calibrate import Calibration, get_calibration  # noqa: F401
 from .context import TraceContext  # noqa: F401
 from .federate import (FederatedScraper, ScrapeTarget,  # noqa: F401
                        get_scraper, install_scraper)
@@ -68,6 +71,7 @@ from .http import (IntrospectionServer, maybe_serve_from_env,  # noqa: F401
                    unregister_health_check)
 from .memory import (device_memory_stats,  # noqa: F401
                      per_device_state_bytes, record_state_memory)
+from .perf import CostLedger, ProgramCost, attribute, get_ledger  # noqa: F401
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
                        get_registry, render_prometheus)
 from .steps import StepProfiler, get_step_profiler  # noqa: F401
@@ -79,6 +83,8 @@ from .watchdog import (RecompileWarning, RecompileWatchdog,  # noqa: F401
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "get_registry",
     "render_prometheus",
+    "Calibration", "get_calibration", "calibrate",
+    "CostLedger", "ProgramCost", "attribute", "get_ledger", "perf",
     "TraceContext", "context",
     "FederatedScraper", "ScrapeTarget", "install_scraper", "get_scraper",
     "device_memory_stats", "per_device_state_bytes", "record_state_memory",

@@ -142,6 +142,17 @@ class StepProfiler:
             rec.update(extra)
         if sample_env:
             self._sample_environment(rec)
+        if program_id is not None:
+            # perf-attribution join: when the dispatched program has a
+            # cost-ledger entry, the record gains achieved_tflops (and
+            # the live perf/* gauges update) — so /debug/steps and
+            # straggler anomalies carry utilization context. Lazy import:
+            # perf depends only on registry/calibrate, never on steps.
+            try:
+                from . import perf
+                perf.get_ledger().annotate_record(rec)
+            except Exception:
+                pass
 
         stream = (rec.get("program"), rec.get("sig"))
         anomaly = None

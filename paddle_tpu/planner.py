@@ -63,6 +63,12 @@ class Plan:
     source: str = "unevaluated"  # "measured" | "analytic" | "unconstrained"
     fits: Optional[bool] = None
     error: Optional[str] = None
+    # XLA's predicted per-microbatch-step cost (cost_analysis of the
+    # candidate executable the memory estimate already compiles) — the
+    # "predicted" half of predicted-vs-achieved: the perf ledger's
+    # perf/achieved_* gauges supply the achieved half at dispatch time
+    predicted_flops: Optional[float] = None
+    predicted_bytes_accessed: Optional[float] = None
 
     def describe(self) -> str:
         est = ("?" if self.est_bytes_per_device is None
@@ -76,7 +82,9 @@ class Plan:
                 "est_bytes_per_device": self.est_bytes_per_device,
                 "budget_bytes": self.budget_bytes,
                 "source": self.source, "fits": self.fits,
-                "error": self.error}
+                "error": self.error,
+                "predicted_flops": self.predicted_flops,
+                "predicted_bytes_accessed": self.predicted_bytes_accessed}
 
 
 class HbmBudgetError(RuntimeError):
@@ -180,11 +188,15 @@ def _measured_bytes(cp, program, feed, loss_name: str) -> int:
     names = sorted(state_structs)
     fn = cp._build(sorted(feed_structs), [loss_name], names, names,
                    {n: np.asarray(a).ndim for n, a in feed.items()})
-    ma = (fn.lower(state_structs, feed_structs, _make_key(0))
-            .compile().memory_analysis())
+    compiled = fn.lower(state_structs, feed_structs, _make_key(0)).compile()
+    ma = compiled.memory_analysis()
     est = (int(ma.argument_size_in_bytes) + int(ma.temp_size_in_bytes)
            + int(ma.output_size_in_bytes) - int(ma.alias_size_in_bytes))
-    return max(est, 0)
+    # the same compile also carries XLA's flops/bytes prediction — free
+    # to read here, and the other half of predicted-vs-achieved once the
+    # perf ledger attributes real dispatches
+    from .observability import perf
+    return max(est, 0), perf.cost_from_executable(compiled)
 
 
 def _analytic_bytes(cp, program, feed) -> int:
@@ -221,8 +233,11 @@ def estimate_plan(plan: Plan, program, feed, loss_name: str) -> Plan:
     mfeed = _feed_with_microbatch(feed, plan.microbatch)
     cp = _compiled_for(program, loss_name, plan)
     try:
-        plan.est_bytes_per_device = _measured_bytes(cp, program, mfeed,
-                                                    loss_name)
+        plan.est_bytes_per_device, cost = _measured_bytes(cp, program, mfeed,
+                                                          loss_name)
+        if cost is not None:
+            plan.predicted_flops = cost["flops"]
+            plan.predicted_bytes_accessed = cost["bytes_accessed"]
         plan.source = "measured"
     except Exception as e:
         plan.error = f"{type(e).__name__}: {e}"[:300]
@@ -262,6 +277,13 @@ def _record(plan: Plan, candidates: List[Plan], where: str) -> None:
             plan.est_bytes_per_device)
     if plan.budget_bytes is not None:
         reg.gauge("planner/budget_bytes").set(plan.budget_bytes)
+    # predicted side of predicted-vs-achieved: read these against the
+    # perf/achieved_* gauges the cost ledger sets at dispatch time
+    if plan.predicted_flops is not None:
+        reg.gauge("planner/predicted_flops").set(plan.predicted_flops)
+    if plan.predicted_bytes_accessed is not None:
+        reg.gauge("planner/predicted_bytes_accessed").set(
+            plan.predicted_bytes_accessed)
     register_dump_section("hbm_plan", _dump_section)
     get_flight_recorder().note_event(
         "info", "hbm_plan", where=where, **plan.to_dict())

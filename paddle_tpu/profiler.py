@@ -102,9 +102,26 @@ class _OpTimer:
 _op_timer: Optional[_OpTimer] = None
 
 
+def export_op_profile(timer: _OpTimer) -> None:
+    """Publish an eager per-op timing table to the process registry —
+    ``eager/op_ms{op=}`` (cumulative host ms per op type, gauge) and
+    ``eager/op_calls{op=}`` (counter) — so the summary that used to be
+    print-only reaches ``/metrics``, ``/metrics.json``, flight dumps,
+    and federation like every other series."""
+    from .observability.registry import get_registry
+
+    reg = get_registry()
+    for op, secs in timer.times.items():
+        g = reg.gauge("eager/op_ms", op=op)
+        g.set(g.value + secs * 1e3)
+        reg.counter("eager/op_calls", op=op).inc(timer.counts[op])
+
+
 @contextlib.contextmanager
 def op_profiler():
-    """Eager per-op timing: patches the dygraph tracer dispatch."""
+    """Eager per-op timing: patches the dygraph tracer dispatch. On exit
+    the collected table is exported to the registry (export_op_profile)
+    in addition to being available via ``timer.summary()``."""
     global _op_timer
     from .dygraph import tracer as tr_mod
 
@@ -125,7 +142,11 @@ def op_profiler():
         yield _op_timer
     finally:
         tr_mod.Tracer.trace_op = orig
-        _op_timer = None
+        timer, _op_timer = _op_timer, None
+        try:
+            export_op_profile(timer)
+        except Exception:
+            pass
 
 
 def reset_profiler():

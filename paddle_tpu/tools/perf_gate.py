@@ -1,0 +1,245 @@
+"""Bench regression gate: compare a fresh bench JSON against a baseline.
+
+The bench rounds (``BENCH_r0x.json``) are the repo's perf ledger; this
+tool turns them into a gate. Given a fresh bench doc and a baseline, it
+walks a fixed metric table — headline rate, MFU, roofline fractions,
+per-model rates, PS prefetch speedup, dispatch overhead — applies a
+per-metric noise margin, and exits nonzero when any metric regresses
+beyond its margin. Bitwise-equality invariants from the PS sections are
+must-not-flip booleans.
+
+Both sides accept three formats (the driver wraps bench output):
+
+* a bare bench doc — ``{"metric", "value", "unit", "extra": {...}}``;
+* a driver wrapper — ``{"n", "cmd", "rc", "tail", "parsed"}`` where
+  ``parsed`` is the doc;
+* a wrapper whose ``parsed`` is null: the last JSON object line in
+  ``tail`` is used, and when the tail was truncated mid-line (e.g.
+  BENCH_r05.json) known flat metrics are recovered by regex — a
+  best-effort baseline beats no gate at all.
+
+CPU-smoke tolerance: a metric absent or null on BOTH sides is skipped
+(sections that only run on TPU, or that OOM'd in the baseline round,
+don't fail a CPU run). A metric the baseline has but the fresh doc lost
+is itself a regression.
+
+Exit codes: 0 pass, 1 regression, 2 usage / unrecoverable input.
+
+Usage::
+
+    python -m paddle_tpu.tools.perf_gate FRESH BASELINE [--margin-scale S]
+    python bench.py --gate-against BENCH_r05.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Optional
+
+__all__ = ["load_doc", "compare", "gate", "main", "METRICS", "INVARIANTS"]
+
+# (path, relative margin, direction). Margins are per-metric noise
+# allowances from the spread observed across BENCH_r01..r05 re-runs;
+# "higher" metrics may drop by at most margin x baseline, "lower"
+# metrics (overheads) may grow by at most margin x baseline (plus a
+# small absolute slack for near-zero baselines).
+METRICS = [
+    ("value", 0.10, "higher"),
+    ("extra.mfu", 0.10, "higher"),
+    ("extra.resnet50_imgs_per_sec_per_chip", 0.15, "higher"),
+    ("extra.resnet50_mfu", 0.15, "higher"),
+    ("extra.resnet50_roofline_frac", 0.15, "higher"),
+    ("extra.deepfm_rate", 0.15, "higher"),
+    ("extra.nmt_big_rate", 0.15, "higher"),
+    ("extra.nmt_big_mfu", 0.10, "higher"),
+    ("extra.ps_embedding.prefetch_speedup", 0.20, "higher"),
+    ("extra.dispatch_overhead.scan_overhead_pct_of_run", 0.25, "lower"),
+]
+# Absolute slack for "lower" metrics whose baseline is ~0 (a pct that
+# moves 0.1 -> 0.3 is noise, not a 3x regression).
+_ABS_SLACK_LOWER = 2.0
+
+# Booleans that must never flip true -> false.
+INVARIANTS = [
+    "extra.ps_embedding.staleness0_bitwise_equal",
+    "extra.ps_embedding.push_depth1_bitwise_equal",
+    "extra.ps_embedding.hot_cache_bitwise_equal",
+]
+
+# Flat metrics recoverable by regex from a truncated wrapper tail.
+_RECOVERABLE = [p.split(".", 1)[1] for p in (
+    [m[0] for m in METRICS if m[0].startswith("extra.")])
+    if "." not in p.split(".", 1)[1]] + ["nmt_big_vs_baseline",
+                                         "resnet50_vs_baseline",
+                                         "deepfm_vs_baseline"]
+
+
+def _lookup(doc: dict, path: str) -> Any:
+    cur: Any = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _recover_from_tail(tail: str) -> Optional[dict]:
+    """Best-effort doc from a wrapper tail. Try the last parseable JSON
+    object line first; fall back to regex-scraping known flat metrics
+    out of a line the driver truncated mid-JSON."""
+    for ln in reversed(tail.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            try:
+                doc = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and ("metric" in doc or "extra" in doc):
+                return doc
+    extra = {}
+    for name in _RECOVERABLE:
+        m = re.search(r'"%s"\s*:\s*(-?[0-9.eE+]+|null|true|false)'
+                      % re.escape(name), tail)
+        if m:
+            extra[name] = json.loads(m.group(1))
+    for name in [p.rsplit(".", 1)[1] for p in INVARIANTS]:
+        m = re.search(r'"%s"\s*:\s*(true|false)' % re.escape(name), tail)
+        if m:
+            extra.setdefault("ps_embedding", {})[name] = m.group(1) == "true"
+    if not extra:
+        return None
+    return {"metric": None, "value": None, "extra": extra,
+            "_recovered_from_tail": sorted(extra)}
+
+
+def load_doc(path: str) -> dict:
+    """Load a bench doc from any of the accepted formats; raises
+    ValueError when nothing recoverable."""
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if "metric" in raw or ("extra" in raw and "tail" not in raw):
+        return raw
+    if "parsed" in raw or "tail" in raw:  # driver wrapper
+        if isinstance(raw.get("parsed"), dict):
+            return raw["parsed"]
+        doc = _recover_from_tail(raw.get("tail") or "")
+        if doc is not None:
+            return doc
+        raise ValueError(f"{path}: wrapper has parsed=null and no "
+                         f"recoverable metrics in tail")
+    raise ValueError(f"{path}: unrecognized bench JSON shape")
+
+
+def compare(fresh: dict, base: dict, margin_scale: float = 1.0) -> dict:
+    """Walk the metric table; return {checked, skipped, regressions,
+    improvements}. A regression entry carries path/base/fresh/limit."""
+    checked, skipped, regressions, improvements = [], [], [], []
+    for path, margin, direction in METRICS:
+        margin *= margin_scale
+        bv, fv = _lookup(base, path), _lookup(fresh, path)
+        if bv is None and fv is None:
+            skipped.append({"path": path, "reason": "absent both sides"})
+            continue
+        if bv is None:
+            skipped.append({"path": path, "reason": "no baseline value"})
+            continue
+        if fv is None:
+            regressions.append({"path": path, "base": bv, "fresh": None,
+                                "limit": None,
+                                "reason": "metric lost (baseline has a "
+                                          "value, fresh run does not)"})
+            continue
+        bv, fv = float(bv), float(fv)
+        if direction == "higher":
+            limit = bv * (1.0 - margin)
+            ok = fv >= limit
+        else:
+            limit = bv * (1.0 + margin) + _ABS_SLACK_LOWER * margin_scale
+            ok = fv <= limit
+        entry = {"path": path, "base": bv, "fresh": fv,
+                 "limit": round(limit, 6), "direction": direction}
+        checked.append(entry)
+        if not ok:
+            regressions.append(entry)
+        elif (fv > bv) == (direction == "higher") and fv != bv:
+            improvements.append(entry)
+    for path in INVARIANTS:
+        bv, fv = _lookup(base, path), _lookup(fresh, path)
+        if bv is True and fv is False:
+            regressions.append({"path": path, "base": True, "fresh": False,
+                                "limit": True,
+                                "reason": "bitwise invariant flipped"})
+        elif bv is not None and fv is not None:
+            checked.append({"path": path, "base": bv, "fresh": fv,
+                            "limit": True, "direction": "invariant"})
+    return {"checked": checked, "skipped": skipped,
+            "regressions": regressions, "improvements": improvements}
+
+
+def gate(fresh: dict, base: dict, margin_scale: float = 1.0,
+         quiet: bool = False, out=None) -> int:
+    """Compare and report; returns the intended exit code (0/1)."""
+    out = out or sys.stdout
+    rep = compare(fresh, base, margin_scale)
+    if not quiet:
+        if fresh.get("_recovered_from_tail"):
+            print("note: fresh doc regex-recovered from wrapper tail",
+                  file=out)
+        if base.get("_recovered_from_tail"):
+            print(f"note: baseline regex-recovered from wrapper tail "
+                  f"({len(base['_recovered_from_tail'])} fields)", file=out)
+        for e in rep["checked"]:
+            if e["direction"] == "invariant":
+                continue
+            arrow = "within" if e not in rep["regressions"] else "REGRESSED"
+            print(f"  {e['path']:<50} base={e['base']:<12g} "
+                  f"fresh={e['fresh']:<12g} limit={e['limit']:<12g} "
+                  f"{arrow}", file=out)
+        for e in rep["skipped"]:
+            print(f"  {e['path']:<50} skipped ({e['reason']})", file=out)
+        for e in rep["regressions"]:
+            if e.get("reason"):
+                print(f"  {e['path']:<50} REGRESSED ({e['reason']})",
+                      file=out)
+    n = len(rep["regressions"])
+    if n:
+        print(f"perf_gate: FAIL — {n} regression(s) vs baseline", file=out)
+        return 1
+    print(f"perf_gate: PASS — {len(rep['checked'])} checked, "
+          f"{len(rep['skipped'])} skipped, "
+          f"{len(rep['improvements'])} improved", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.perf_gate", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("fresh", help="fresh bench JSON (doc or driver wrapper)")
+    p.add_argument("baseline", help="baseline bench JSON, e.g. "
+                                    "BENCH_r05.json")
+    p.add_argument("--margin-scale", type=float, default=1.0,
+                   help="multiply every noise margin (e.g. 2.0 on noisy "
+                        "shared machines)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the comparison report as JSON")
+    args = p.parse_args(argv)
+    try:
+        fresh = load_doc(args.fresh)
+        base = load_doc(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        rep = compare(fresh, base, args.margin_scale)
+        print(json.dumps(rep))
+        return 1 if rep["regressions"] else 0
+    return gate(fresh, base, args.margin_scale)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
