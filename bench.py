@@ -1694,6 +1694,107 @@ def _bench_fleet_observability_arm(workdir, on_tpu):
             p.wait()
 
 
+def bench_inference_compiler(on_tpu):
+    """Inference-compiler economics (PR 16), three cells: (a) the
+    Program-IR pass pipeline's win attributed PER PASS through the perf
+    CostLedger (ops removed / flops / bytes deltas, wall_ms — the same
+    report `predictor.pass_report` carries); (b) int8 post-training
+    quantization vs bf16 served throughput on the same model bytes at
+    matched accuracy (the calibration gate runs first; its measured
+    delta is recorded). The ≥1.7x int8-over-bf16 contract is asserted on
+    TPU, where the int8 matmul actually changes the MXU/HBM economics —
+    a CPU host emulates int8 matmuls in int32 and may show none of it,
+    so `speedup_target_met` stays None off-TPU; (c) N=3 tenant
+    co-hosting on one fleet under mixed weighted load, each tenant
+    holding its own p99 SLO (the serving_bench --models machinery)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu import inference
+    from paddle_tpu.observability import perf
+    from paddle_tpu.tools import serving_bench as sb
+
+    in_dim, hidden, n_req = (512, 2048, 256) if on_tpu else (64, 256, 96)
+    buckets = (1, 2, 4, 8)
+    slo_ms = 500.0 if on_tpu else 10_000.0
+    d = tempfile.mkdtemp(prefix="infcomp_bench_")
+    out = {}
+    try:
+        rows = sb._gen_rows(n_req, in_dim)
+        calib_feeds = [{"x": r} for r in rows[:8]]
+        pred32 = sb.build_predictor(model_dir=d, in_dim=in_dim,
+                                    hidden=hidden)
+
+        # -- (a) per-pass attribution, straight from the ledger
+        rep = pred32.pass_report
+        out["pass_pipeline"] = {
+            "label": rep["label"],
+            "ops_total_removed": rep["ops_total_removed"],
+            "flops_total_delta": rep["flops_total_delta"],
+            "bytes_total_delta": rep["bytes_total_delta"],
+            "per_pass": [
+                {"pass": r["pass"], "neutrality": r["neutrality"],
+                 "ops_removed": r["ops_before"] - r["ops_after"],
+                 "flops_delta": r["flops_delta"],
+                 "bytes_delta": r["bytes_delta"],
+                 "wall_ms": r["wall_ms"]} for r in rep["passes"]],
+            "in_ledger": perf.get_ledger().pass_reports().get(
+                rep["label"]) is not None,
+        }
+
+        # -- (b) int8 vs bf16 served throughput, same model bytes, same
+        # load; the int8 predictor records its gated accuracy delta
+        arms = {}
+        for prec in ("bf16", "int8"):
+            p = inference.create_predictor(
+                sb._make_config(d, prec, calib_feeds))
+            r = sb.bench_served(p, rows, concurrency=16, buckets=buckets,
+                                batch_delay_ms=1.0)
+            arms[prec] = {"rps": round(r["throughput_rps"], 1),
+                          "p99_ms": round(r["p99_ms"], 2),
+                          "errors": r["errors"]}
+            if prec == "int8":
+                qm = p.quant_meta
+                arms[prec]["accuracy_delta"] = round(
+                    qm["accuracy_delta"], 6)
+                arms[prec]["accuracy_budget"] = qm["accuracy_budget"]
+        speedup = round(arms["int8"]["rps"]
+                        / max(arms["bf16"]["rps"], 1e-9), 2)
+        out["int8_vs_bf16"] = {
+            **{f"{k}_{m}": v for k, a in arms.items()
+               for m, v in a.items()},
+            "speedup": speedup,
+            # the acceptance bar is a TPU statement: int8 halves the
+            # weight bytes and doubles MXU rate there; a CPU int32
+            # emulation can even run slower
+            "speedup_target": 1.7,
+            "speedup_target_met": (speedup >= 1.7) if on_tpu else None,
+        }
+
+        # -- (c) N=3 tenants, weighted mixed load, per-tenant p99 SLO
+        ten = sb.bench_tenants(
+            d, {"ads": 2.0, "feed": 1.0, "search": 1.0}, rows,
+            replicas=4, concurrency=16, buckets=buckets,
+            batch_delay_ms=1.0, precision="int8",
+            calib_feeds=calib_feeds, slo_p99_ms=slo_ms)
+        per_tenant = {
+            name: {"p99_ms": round(trow["p99_ms"], 2),
+                   "requests": trow["requests"],
+                   "errors": trow["errors"],
+                   "throttled": trow["throttled"],
+                   "slo_ok": (trow["router"] or {}).get("slo_ok")}
+            for name, trow in ten["per_tenant"].items()}
+        out["tenancy"] = {
+            "slo_p99_ms": slo_ms,
+            "rps": round(ten["throughput_rps"], 1),
+            "tenants": per_tenant,
+            "all_slo_ok": all(t["slo_ok"] for t in per_tenant.values()),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def bench_online_learning(on_tpu):
     """Streaming online learning (ISSUE 14, paddle_tpu.streaming): one
     process trains a CTR model from an endless skewed stream through
@@ -2034,6 +2135,15 @@ def main(gate_against=None, recalibrate=False):
     except Exception as e:  # pragma: no cover
         extras2["serving_fleet"] = {"error": str(e)[:120]}
     _end_section(extras2, "serving_fleet")
+
+    # inference compiler: per-pass pipeline attribution via the perf
+    # ledger, int8-vs-bf16 served throughput at matched (gated) accuracy,
+    # N=3 tenant co-hosting with per-tenant p99 SLOs (PR 16)
+    try:
+        extras2["inference_compiler"] = bench_inference_compiler(on_tpu)
+    except Exception as e:  # pragma: no cover
+        extras2["inference_compiler"] = {"error": str(e)[:120]}
+    _end_section(extras2, "inference_compiler")
 
     # streaming online learning: train-from-stream + dynamic vocab +
     # delta checkpoints + delta push to serving, in one process (ISSUE

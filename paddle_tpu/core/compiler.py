@@ -273,7 +273,16 @@ class CompiledProgram:
         return self
 
     def with_inference_optimize(self, config=None):
+        """Reference inference_optimize parity: freeze to the test-mode
+        graph, and when an inference `Config` is supplied run its IR
+        pass pipeline (the same compile-then-serve path the Predictor
+        takes) with per-pass cost deltas recorded in the perf ledger."""
         self._program = self._program.clone(for_test=True)
+        if config is not None and getattr(config, "ir_optim", lambda: False)():
+            from ..ir.pipeline import optimize_inference_program
+            self._program = optimize_inference_program(
+                self._program, config,
+                label=f"compiled:0x{id(self._program):x}")
         return self
 
     # -- lowering ----------------------------------------------------------

@@ -824,6 +824,17 @@ def _run_remat_group(ops, decision, env: Dict[str, object],
                               list(out_vals), nondiff_in))
 
 
+def eval_inference_block(program, env: Dict[str, object]) -> Dict[str, object]:
+    """Run `program`'s global block EAGERLY over `env` (merged state +
+    feeds), mutating and returning it — every intermediate var stays
+    visible in `env` afterwards. No jit, no signature cache: this is the
+    observation path (int8 calibration reads activation ranges out of
+    it, debuggers read anything) — per-request serving goes through the
+    Predictor's compiled route instead."""
+    _run_block(program.global_block(), env, ExecContext(None, is_test=True))
+    return env
+
+
 def _run_block(block: Block, env: Dict[str, object], ctx: ExecContext):
     mode = _fuse_updates_mode()
     items = _plan_remat_items(block, ctx)

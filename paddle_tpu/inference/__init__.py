@@ -29,7 +29,8 @@ from ..ir import PassBuilder
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "AnalysisConfig", "create_paddle_predictor",
-           "PsLookupBinding", "PsLookupPredictor", "RowCache"]
+           "PsLookupBinding", "PsLookupPredictor", "RowCache",
+           "QuantizationError"]
 
 
 class PrecisionType:
@@ -38,6 +39,9 @@ class PrecisionType:
     # API-compat alias: the reference's Half means fp16 on GPU; on TPU the
     # low-precision serving dtype is bf16.
     Half = "bfloat16"
+    # post-training-quantized serving: int8 weights + calibrated
+    # activation scales (Config.enable_int8 supplies the sample stream)
+    Int8 = "int8"
 
 
 _PRECISION_ALIASES = {
@@ -46,6 +50,7 @@ _PRECISION_ALIASES = {
     "bf16": PrecisionType.Bfloat16, "bfloat16": PrecisionType.Bfloat16,
     "half": PrecisionType.Bfloat16, "fp16": PrecisionType.Bfloat16,
     "float16": PrecisionType.Bfloat16,
+    "int8": PrecisionType.Int8, "i8": PrecisionType.Int8,
 }
 
 
@@ -87,6 +92,9 @@ class Config:
         self._precision = PrecisionType.Float32
         self._passes_deleted: List[str] = []
         self._extra_passes: List[str] = []
+        self._int8_calib_feeds: Optional[List[dict]] = None
+        self._int8_budget: Optional[float] = None
+        self._int8_table_scales: Optional[Dict[str, float]] = None
 
     # -- model location ----------------------------------------------------
     def set_model(self, model_dir: str, params_file: Optional[str] = None):
@@ -108,7 +116,34 @@ class Config:
         self._memory_optim = flag
 
     def enable_tpu(self, precision: str = PrecisionType.Float32):
-        self._precision = precision
+        self._precision = _resolve_precision(precision)
+
+    def enable_int8(self, sample_feeds: Sequence[Dict[str, np.ndarray]],
+                    accuracy_budget: Optional[float] = None,
+                    table_scales: Optional[Dict[str, float]] = None):
+        """Serve this model post-training-quantized to int8.
+
+        ``sample_feeds`` is the calibration stream — a handful of
+        representative feed dicts; the predictor runs them at fp32 to
+        observe activation abs-max ranges, quantizes the matmul and
+        embedding paths, and **gates promotion** on the measured
+        fp32-vs-int8 output delta staying within ``accuracy_budget``
+        (relative L1; default ``PDTPU_INT8_ACC_BUDGET``, 0.05).
+        ``table_scales`` pins embedding-table quantization scales by
+        param name — required for PS-backed serving, where the resident
+        cache-sized table is a placeholder for the real ShardedTable.
+        See docs/migration.md "Inference compiler"."""
+        sample_feeds = list(sample_feeds or [])
+        if not sample_feeds:
+            raise ValueError(
+                "enable_int8: calibration needs at least one sample feed")
+        self._precision = PrecisionType.Int8
+        self._int8_calib_feeds = sample_feeds
+        if accuracy_budget is not None:
+            self._int8_budget = float(accuracy_budget)
+        if table_scales is not None:
+            self._int8_table_scales = {k: float(v)
+                                       for k, v in table_scales.items()}
 
     # API-compat no-ops (no CUDA/MKLDNN in this build)
     def enable_use_gpu(self, *a, **kw):
@@ -133,7 +168,8 @@ class Config:
         names = ["delete_dropout_op_pass", "conv_bn_fuse_pass",
                  "fc_fuse_pass",
                  "fuse_elewise_add_act_pass", "constant_folding_pass",
-                 "dead_code_elimination_pass"]
+                 "dead_code_elimination_pass", "dead_var_elimination_pass",
+                 "layout_assignment_pass"]
         if self._memory_optim:
             names.append("memory_optimize_pass")
         names += self._extra_passes
@@ -181,12 +217,15 @@ class Predictor:
         self._feed_buf: Dict[str, np.ndarray] = {}
         self._fetch_buf: Dict[str, np.ndarray] = {}
         # `precision` overrides Config.enable_tpu's dtype per-predictor —
-        # the same Config (or model dir) can serve f32 and bf16 replicas
-        self._precision = (_resolve_precision(precision)
-                           if precision is not None else config._precision)
+        # the same Config (or model dir) can serve f32 and bf16 replicas.
+        # Both spellings resolve through the alias table: an unknown
+        # precision string raises here, never a silent fp32 fallback.
+        self._precision = _resolve_precision(
+            precision if precision is not None else config._precision)
         if _shared is not None:
             # clone path (analysis_predictor.cc:479): share program + weights
-            self._program, self._feed_names, self._fetch_names, self._state = _shared
+            (self._program, self._feed_names, self._fetch_names,
+             self._state, self._label) = _shared
             return
         self._load_and_optimize()
 
@@ -221,12 +260,15 @@ class Predictor:
                     model_filename=cfg._model_filename,
                     params_filename=cfg._params_filename)
                 fetch_names = [v.name for v in fetch_vars]
+        base = os.path.basename(os.path.normpath(cfg.model_dir() or "")) \
+            or "model"
+        self._label = f"infer:{base}:{self._precision}"
         if cfg.ir_optim():
-            builder = cfg.pass_builder()
+            from ..ir import PassPipeline
+            pipeline = PassPipeline(cfg.pass_builder(), label=self._label)
             with scope_guard(scope):  # weight-folding passes edit the scope
-                program = builder.apply_all(program, keep=fetch_names,
-                                            fetch_names=fetch_names,
-                                            scope=scope)
+                program = pipeline.run(program, keep=fetch_names,
+                                       fetch_names=fetch_names, scope=scope)
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = fetch_names
@@ -238,6 +280,12 @@ class Predictor:
                 if dtype == PrecisionType.Bfloat16 and val.dtype == jnp.float32:
                     val = val.astype(jnp.bfloat16)
                 self._state[v.name] = val
+        if dtype == PrecisionType.Int8:
+            from .quant import quantize_predictor_inplace
+            quantize_predictor_inplace(
+                self, sample_feeds=cfg._int8_calib_feeds,
+                accuracy_budget=cfg._int8_budget,
+                table_scales=cfg._int8_table_scales)
 
     # -- reference API surface ---------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -261,7 +309,22 @@ class Predictor:
         reference's clone-weights optimization)."""
         return Predictor(self._config, precision=self._precision,
                          _shared=(self._program, self._feed_names,
-                                  self._fetch_names, self._state))
+                                  self._fetch_names, self._state,
+                                  self._label))
+
+    @property
+    def pass_report(self) -> Optional[dict]:
+        """The IR pass pipeline's per-pass cost-delta report (None when
+        ir_optim was off)."""
+        return getattr(self._program, "_pass_report", None)
+
+    @property
+    def quant_meta(self) -> Optional[dict]:
+        """int8 calibration record: activation scales, per-table scales,
+        measured accuracy delta and its budget (None unless quantized).
+        The fleet's ModelRegistry gate and the PS delta re-quantization
+        path both read this."""
+        return getattr(self._program, "_quant_meta", None)
 
     def run(self, feed: Optional[Dict[str, np.ndarray]] = None) -> List[np.ndarray]:
         """Run once. Either pass `feed` directly or pre-fill input handles
@@ -285,15 +348,29 @@ class Predictor:
                 val = val.astype(jnp.bfloat16)
             feed_vals[n] = val
 
-        from ..core.executor import feed_signature
+        from ..core.executor import _sig_digest, feed_signature
+        from ..observability import perf
 
         sig = feed_signature(feed_vals)
         fn = self._cache.get(sig)
+        warm = fn is not None
         if fn is None:
             fn = self._compile()
             self._cache[sig] = fn
+            # serving-side perf attribution: one ledger entry per
+            # (program, signature), so the pass pipeline's wins show up
+            # as perf/* gauges on the very executables it shaped
+            perf.get_ledger().register(
+                id(self._program), _sig_digest(sig), program=self._program,
+                feed=feed_vals, label=getattr(self, "_label", None))
+        import time as _time
+        t0 = _time.perf_counter()
         outs = fn(self._state, feed_vals)
-        outs = [np.asarray(o) for o in outs]
+        outs = [np.asarray(o) for o in outs]  # blocks until done
+        if warm:  # the compiling dispatch would attribute compile wall
+            perf.get_ledger().on_dispatch(
+                id(self._program), _sig_digest(sig),
+                (_time.perf_counter() - t0) * 1e3)
         self._fetch_buf = dict(zip(self._fetch_names, outs))
         return outs
 
@@ -368,3 +445,4 @@ def create_paddle_predictor(config: Config) -> Predictor:
 
 from .ps_lookup import (PsLookupBinding, PsLookupPredictor,  # noqa: E402,F401
                         RowCache)
+from .quant import QuantizationError  # noqa: E402,F401  (registers the pass)

@@ -54,12 +54,14 @@ class RowCache:
     the fleet tests keys on.
     """
 
-    def __init__(self, capacity: int, lanes: int):
+    def __init__(self, capacity: int, lanes: int, dtype=np.uint16):
         if capacity < 1:
             raise ValueError("RowCache capacity must be >= 1")
         self.capacity = int(capacity)
         self.lanes = int(lanes)
-        self._store = np.zeros((self.capacity, self.lanes), np.uint16)
+        # uint16 for packed-f32 wire rows; int8 when the resident
+        # predictor is quantized and rows are stored post-requantization
+        self._store = np.zeros((self.capacity, self.lanes), dtype)
         self._slots = SlotMap(self.capacity)
         self._lru = LruOrder()
         self.hits = 0
@@ -73,10 +75,14 @@ class RowCache:
     def nbytes(self) -> int:
         return self._store.nbytes
 
+    @property
+    def dtype(self):
+        return self._store.dtype
+
     def lookup(self, uids: np.ndarray):
         """rows `[k, lanes]` (hit rows filled) + boolean miss mask."""
         k = len(uids)
-        rows = np.zeros((k, self.lanes), np.uint16)
+        rows = np.zeros((k, self.lanes), self._store.dtype)
         miss = np.zeros(k, bool)
         for j, u in enumerate(np.asarray(uids).tolist()):
             s = self._slots.get(u)
@@ -159,7 +165,32 @@ class PsLookupPredictor:
                 os.environ.get("PDTPU_PS_SERVE_CACHE_ROWS", "65536"))
         self._shapes: Dict[str, tuple] = {}
         self._caches: Dict[str, RowCache] = {}
+        # quantized resident tables: binding param → {"param": renamed
+        # int8 state param, "scale": per-table abs-max, "dt": f32 row dim}
+        self._quant: Dict[str, dict] = {}
+        qmeta = getattr(predictor, "quant_meta", None) or {}
+        qtables = qmeta.get("tables") or {}
         for b in self._bindings:
+            qt = qtables.get(b.param)
+            if qt is not None and qt.get("packed"):
+                # int8_quantize_pass renamed the param and dequantizes at
+                # gather time; the row cache stores int8 rows requantized
+                # from the PS tier's packed-u16 wire format
+                qp = qt["param"]
+                st = self._pred._state.get(qp)
+                if st is None or st.ndim != 2 or str(st.dtype) != "int8":
+                    raise ValueError(
+                        f"PsLookupPredictor: quantized param {qp!r} (for "
+                        f"binding {b.param!r}) missing or not an int8 "
+                        f"[cache_rows, dim] table")
+                self._quant[b.param] = {"param": qp,
+                                        "scale": float(qt["scale"]),
+                                        "dt": int(st.shape[1])}
+                self._shapes[b.param] = tuple(int(d) for d in st.shape)
+                self._caches[b.param] = RowCache(
+                    max(cache_rows_per_table, st.shape[0]),
+                    int(st.shape[1]), dtype=np.int8)
+                continue
             st = self._pred._state.get(b.param)
             if st is None:
                 raise ValueError(
@@ -228,14 +259,21 @@ class PsLookupPredictor:
                     f"holds {cache_rows}; resave the serving model with "
                     f"a larger cache table")
             cache = self._caches[b.param]
+            q = self._quant.get(b.param)
             rows, miss = cache.lookup(uids)
             if miss.any():
                 pulled = np.asarray(b.table.pull(uids[miss]))
+                if q is not None:
+                    # wire format is packed u16; the int8 cache/param
+                    # want rows requantized at the table's stored scale
+                    from .quant import requantize_packed_rows
+                    pulled = requantize_packed_rows(
+                        np.asarray(pulled, np.uint16), q["dt"], q["scale"])
                 rows[miss] = pulled
                 cache.insert(uids[miss], pulled)
-            arr = np.zeros((cache_rows, lanes), np.uint16)
+            arr = np.zeros((cache_rows, lanes), cache.dtype)
             arr[:uids.size] = rows
-            overrides[b.param] = arr
+            overrides[b.param if q is None else q["param"]] = arr
             off = 0
             for n in b.id_feeds:
                 a = feed2[n]
@@ -272,7 +310,13 @@ class PsLookupPredictor:
         are left to fault in on the next request (the table already holds
         the new bytes, so the pull is coherent). Returns #rows refreshed
         — the staleness window for a cached row is the publisher's flush
-        cadence, not checkpoint cadence."""
+        cadence, not checkpoint cadence.
+
+        Quantized residents: pushed rows arrive in the trainer's packed
+        u16 wire format regardless of serving precision, so they are
+        re-quantized here with the table's stored scale before touching
+        the int8 cache — raw u16 bytes must never land in an int8
+        table."""
         uids = np.asarray(uids, np.int64)
         rows = np.asarray(rows, np.uint16)
         n = 0
@@ -280,7 +324,13 @@ class PsLookupPredictor:
             for b in self._bindings:
                 if getattr(b.table, "name", None) != table_name:
                     continue
-                n += self._caches[b.param].update(uids, rows)
+                q = self._quant.get(b.param)
+                if q is not None:
+                    from .quant import requantize_packed_rows
+                    r = requantize_packed_rows(rows, q["dt"], q["scale"])
+                else:
+                    r = rows
+                n += self._caches[b.param].update(uids, r)
         return n
 
     # -- introspection -------------------------------------------------------
@@ -295,7 +345,8 @@ class PsLookupPredictor:
         """Bytes of table data this replica actually holds: the
         cache-sized device param(s) + the host LRU slab. The fleet test
         asserts this is a small fraction of the full table."""
-        dev = sum(rows * lanes * 2 for rows, lanes in self._shapes.values())
+        dev = sum(rows * lanes * (1 if p in self._quant else 2)
+                  for p, (rows, lanes) in self._shapes.items())
         return dev + sum(c.nbytes for c in self._caches.values())
 
     def stats(self) -> dict:

@@ -9,3 +9,6 @@ from .pass_base import (  # noqa: F401
     Pass, PassBuilder, apply_pass, get_pass, register_pass, registered_passes,
 )
 from . import passes  # noqa: F401  (registers the standard passes)
+from .pipeline import (  # noqa: F401
+    PassPipeline, optimize_inference_program,
+)

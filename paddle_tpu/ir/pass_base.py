@@ -18,9 +18,25 @@ from ..core.program import Program
 
 
 class Pass:
-    """Subclass and override apply_impl(program, **kw) -> program."""
+    """Subclass and override apply_impl(program, **kw) -> program.
+
+    Every pass declares its **neutrality contract** — what the transform
+    is allowed to do to the program's output bits (the inference
+    compiler's PassPipeline records it per pass and the neutrality test
+    suite enforces it):
+
+    - ``"bitwise"``    — the optimized program produces bit-identical
+      outputs for every input (the default; pure graph surgery over the
+      same jnp arithmetic).
+    - ``"precision"``  — explicitly precision-changing: the rewrite
+      folds or re-rounds float arithmetic (conv+BN weight folding, int8
+      quantization) and must gate itself on a measured accuracy delta.
+    - ``"annotation"`` — writes plans/reports onto the program
+      (`_memory_plan`, `_layout_plan`, graphviz) and never touches ops.
+    """
 
     name: str = ""
+    neutrality: str = "bitwise"
 
     def apply(self, program: Program, **kw) -> Program:
         out = self.apply_impl(program, **kw)

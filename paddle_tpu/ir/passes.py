@@ -277,6 +277,7 @@ class MemoryOptimizePass(Pass):
     the inference Predictor's donate_argnums."""
 
     name = "memory_optimize_pass"
+    neutrality = "annotation"
 
     def apply_impl(self, program: Program, fetch_names: Optional[List[str]] = None, **kw):
         blk = program.global_block()
@@ -343,6 +344,7 @@ class GraphVizPass(Pass):
     debug_graphviz_path_ build_strategy.h:71)."""
 
     name = "graph_viz_pass"
+    neutrality = "annotation"
 
     def apply_impl(self, program: Program, path: Optional[str] = None, **kw):
         lines = ["digraph G {", "  rankdir=TB;"]
@@ -366,6 +368,132 @@ class GraphVizPass(Pass):
 
 
 @register_pass
+class DeadVarEliminationPass(Pass):
+    """Purge `block.vars` entries no op reads or writes any more — the
+    residue fuse/fold/DCE passes leave behind (DCE removes *ops*; the
+    orphaned Variable descriptors — and for persistables, the weight
+    upload they would trigger — linger until this pass). Feeds
+    (`is_data`), fetch/keep targets and sub-block reads survive; an
+    unreferenced *persistable* is exactly the dead weight this pass
+    exists to drop (conv_bn_fuse did this ad hoc for BN params)."""
+
+    name = "dead_var_elimination_pass"
+
+    def apply_impl(self, program: Program, keep: Optional[List[str]] = None,
+                   fetch_names: Optional[List[str]] = None, **kw):
+        referenced = set()
+        for b in program.blocks:
+            for op in b.ops:
+                referenced |= set(op.input_names())
+                referenced |= set(op.output_names())
+        protect = set(keep or []) | set(fetch_names or [])
+        removed = 0
+        for b in program.blocks:
+            for name in list(b.vars):
+                v = b.vars[name]
+                if (name in referenced or name in protect
+                        or getattr(v, "is_data", False)):
+                    continue
+                del b.vars[name]
+                removed += 1
+        if removed:
+            program._bump_version()
+        return program
+
+
+# MXU/VMEM minimum tile per dtype: (sublane, lane) — the lane dim is
+# always 128; the sublane minimum scales inversely with element width
+# (f32 (8,128), bf16 (16,128), int8 (32,128)).
+_TILE_SUBLANE = {1: 32, 2: 16, 4: 8, 8: 8}
+_TILE_LANE = 128
+
+
+@register_pass
+class LayoutAssignmentPass(Pass):
+    """Annotate the program with a TPU layout plan: for every var with a
+    static shape, the padded footprint once the trailing two dims are
+    rounded up to the dtype's minimum tile, and per matmul-family op
+    whether its contracting/output dims land tile-aligned. XLA assigns
+    the real layouts — this pass exists so pass authors and the perf
+    ledger can see *where the padding waste is* (a [B, 100] fc wastes
+    22% of every (8,128) f32 tile) without digging through HLO. Pure
+    annotation: `program._layout_plan`, no op edits."""
+
+    name = "layout_assignment_pass"
+    neutrality = "annotation"
+
+    MATMUL_OPS = ("mul", "matmul", "matmul_v2", "fused_fc", "quantized_fc",
+                  "quantized_matmul")
+
+    @staticmethod
+    def _padded(shape, itemsize: int):
+        dims = [int(d) if int(d) > 0 else 1 for d in shape]
+        if not dims:
+            return 1, 1
+        natural = 1
+        for d in dims:
+            natural *= d
+        pad = list(dims)
+        pad[-1] = -(-pad[-1] // _TILE_LANE) * _TILE_LANE
+        if len(pad) >= 2:
+            sub = _TILE_SUBLANE.get(itemsize, 8)
+            pad[-2] = -(-pad[-2] // sub) * sub
+        padded = 1
+        for d in pad:
+            padded *= d
+        return natural * itemsize, padded * itemsize
+
+    def apply_impl(self, program: Program, **kw):
+        per_var: Dict[str, dict] = {}
+        natural_total = padded_total = 0
+        for b in program.blocks:
+            for op in b.ops:
+                for name in list(op.input_names()) + list(op.output_names()):
+                    if name in per_var:
+                        continue
+                    v = b._find_var_recursive(name)
+                    if v is None or v.shape is None:
+                        continue
+                    try:
+                        itemsize = int(np.dtype(v.dtype).itemsize)
+                    except TypeError:
+                        itemsize = 4
+                    nat, pad = self._padded(v.shape, itemsize)
+                    per_var[name] = {"natural_bytes": nat,
+                                     "padded_bytes": pad,
+                                     "waste": round(1.0 - nat / pad, 4)}
+                    natural_total += nat
+                    padded_total += pad
+        ops = []
+        for b in program.blocks:
+            for op in b.ops:
+                if op.type not in self.MATMUL_OPS:
+                    continue
+                slot = "Input" if op.type.endswith("fc") else "X"
+                xs = op.input(slot) or op.input("X")
+                ws = op.input("W") or op.input("Y")
+                vx = b._find_var_recursive(xs[0]) if xs else None
+                vw = b._find_var_recursive(ws[0]) if ws else None
+                k = int(vx.shape[-1]) if vx is not None and vx.shape else 0
+                n = int(vw.shape[-1]) if vw is not None and vw.shape else 0
+                ops.append({"op": op.type, "out": op.output_names()[:1],
+                            "k": k, "n": n,
+                            "k_aligned": k > 0 and k % _TILE_LANE == 0,
+                            "n_aligned": n > 0 and n % _TILE_LANE == 0})
+        worst = sorted(per_var.items(), key=lambda kv: -(
+            kv[1]["padded_bytes"] - kv[1]["natural_bytes"]))[:8]
+        program._layout_plan = {
+            "natural_bytes": natural_total,
+            "padded_bytes": padded_total,
+            "waste_fraction": (round(1.0 - natural_total / padded_total, 4)
+                               if padded_total else 0.0),
+            "matmul_ops": ops,
+            "worst_vars": [{"var": n, **d} for n, d in worst],
+        }
+        return program
+
+
+@register_pass
 class ConvBnFusePass(Pass):
     """Fold inference-mode batch_norm into the preceding conv2d's weights
     (reference ir/conv_bn_fuse_pass.cc): w' = w·γ/√(σ²+ε) per out channel,
@@ -374,6 +502,8 @@ class ConvBnFusePass(Pass):
     caller passes `scope=` — the inference Predictor does."""
 
     name = "conv_bn_fuse_pass"
+    # folding w·γ/√(σ²+ε) re-rounds the conv weights — same math, new bits
+    neutrality = "precision"
 
     def apply_impl(self, program: Program, scope=None, **kw):
         if scope is None:

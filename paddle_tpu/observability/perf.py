@@ -272,7 +272,10 @@ class CostLedger:
         self._lock = threading.Lock()
         self._entries: "collections.OrderedDict[tuple, ProgramCost]" = \
             collections.OrderedDict()
+        self._pass_reports: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
         self._dump_registered = False
+        self._pass_dump_registered = False
 
     def _registry(self):
         return self._reg if self._reg is not None else get_registry()
@@ -331,6 +334,42 @@ class CostLedger:
     def get(self, program_id, sig: Optional[str]) -> Optional[ProgramCost]:
         with self._lock:
             return self._entries.get((_pkey(program_id), sig))
+
+    # -- pass attribution (compile time, from ir.PassPipeline) -----------
+    def record_passes(self, label: str, report: dict) -> None:
+        """Record one PassPipeline run: the per-pass cost-delta report
+        keyed by the program label, exported as ``ir/pass_*`` gauges and
+        the ``ir_passes`` flight-dump section. Never raises."""
+        if not enabled():
+            return
+        try:
+            with self._lock:
+                self._pass_reports[label] = report
+                while len(self._pass_reports) > self._max:
+                    self._pass_reports.popitem(last=False)
+                if not self._pass_dump_registered:
+                    self._pass_dump_registered = True
+                    try:
+                        from .flight import register_dump_section
+                        register_dump_section("ir_passes", self.pass_reports)
+                    except Exception:
+                        pass
+            reg = self._registry()
+            for rec in report.get("passes", ()):
+                labels = {"program": label, "ir_pass": rec["pass"]}
+                reg.gauge("ir/pass_flops_delta", **labels).set(
+                    rec.get("flops_delta", 0.0))
+                reg.gauge("ir/pass_bytes_delta", **labels).set(
+                    rec.get("bytes_delta", 0.0))
+                reg.gauge("ir/pass_ops_removed", **labels).set(
+                    rec.get("ops_before", 0) - rec.get("ops_after", 0))
+        except Exception:
+            pass
+
+    def pass_reports(self) -> dict:
+        """label → the PassPipeline report recorded for that program."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._pass_reports.items()}
 
     # -- attribution (dispatch time) ------------------------------------
     def on_dispatch(self, program_id, sig: Optional[str], wall_ms: float
@@ -392,6 +431,7 @@ class CostLedger:
     def reset(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._pass_reports.clear()
 
 
 _ledger = CostLedger()

@@ -18,6 +18,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from .common import act_map, one, opt_input
+
+_ACTS = act_map()
 
 
 def _quant_dequant(x, scale, bits):
@@ -119,3 +122,69 @@ def _fake_cw_dequantize_max_abs(ctx, inputs, attrs):
             else (1,) * x.ndim
         out = out * s.reshape(shape) / float((1 << (int(b) - 1)) - 1)
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# REAL int8 runtime ops (post-training quantization, inference/quant.py).
+# Unlike the fake-quant family above, these carry int8 weights and run the
+# gemm in int8×int8→int32 (`preferred_element_type`) with a float dequant
+# epilogue — on TPU the int8 MXU path at (32, 128) tiles, roughly 2× the
+# bf16 macs/cycle. Symmetric scheme throughout:
+#   x ≈ xq · sx/127 (per tensor, sx calibrated),  w ≈ wq · sw/127 (per
+#   out-channel), so  x@w ≈ (xq@wq) · sx·sw/127².
+# Inference-only: registered non-differentiable.
+# ---------------------------------------------------------------------------
+
+
+def _quantize_act(x, scale):
+    """float activations → int8 with the calibrated per-tensor scale."""
+    inv = 127.0 / jnp.maximum(jnp.float32(scale), 1e-8)
+    return jnp.clip(jnp.round(x.astype(jnp.float32) * inv),
+                    -127.0, 127.0).astype(jnp.int8)
+
+
+@register_op("quantized_fc", differentiable=False)
+def _quantized_fc(ctx, inputs, attrs):
+    """fused_fc rewritten by int8_quantize_pass: quantize the activation
+    at the calibrated scale, int8 gemm into int32, dequant by
+    sx·sw/127² per out-channel, then float bias + activation."""
+    (x,) = inputs["Input"]
+    (w,) = inputs["W"]                 # int8 [k, n]
+    (w_scale,) = inputs["WScale"]      # f32 [n] (per out-channel abs-max)
+    b = opt_input(inputs, "Bias")
+    act_scale = float(attrs["act_scale"])
+    ncol = int(attrs.get("in_num_col_dims", 1))
+    if ncol < 0:                       # matmul-style: all-but-last lead
+        ncol = x.ndim - 1
+    lead = x.shape[:ncol]
+    m = 1
+    for d in lead:
+        m *= int(d)
+    x2 = x.reshape((m, -1))
+    xq = _quantize_act(x2, act_scale)
+    acc = jnp.matmul(xq, w, preferred_element_type=jnp.int32)
+    deq = (act_scale / 127.0) * (w_scale.astype(jnp.float32) / 127.0)
+    out = acc.astype(jnp.float32) * deq.reshape((1, -1))
+    if b is not None:
+        out = out + b.astype(jnp.float32).reshape((1, -1))
+    out = _ACTS[attrs.get("activation_type", "")](out)
+    return one(out.reshape(tuple(lead) + (w.shape[-1],)))
+
+
+@register_op("quantized_lookup_table", differentiable=False)
+def _quantized_lookup_table(ctx, inputs, attrs):
+    """lookup_table(/_v2) rewritten by int8_quantize_pass: gather int8
+    rows and dequant with the per-table scale. `squeeze_last` preserves
+    lookup_table's trailing-1 id squeeze; `table_scale` is the table's
+    abs-max."""
+    (w,) = inputs["W"]                 # int8 [V, D]
+    (ids,) = inputs["Ids"]
+    scale = float(attrs["table_scale"])
+    idx = ids
+    if attrs.get("squeeze_last") and ids.ndim >= 2 and ids.shape[-1] == 1:
+        idx = ids[..., 0]
+    out = jnp.take(w, idx, axis=0).astype(jnp.float32) * (scale / 127.0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
+    return one(out)

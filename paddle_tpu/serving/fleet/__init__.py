@@ -11,6 +11,13 @@ model directories; `ServingFleet.rollout()` for zero-downtime weight
 swaps (background-warm → atomic flip → drain, one replica at a time)
 and `ab_split()` for weighted A/B between two live versions.
 
+Multi-tenant co-hosting: `ServingFleet(..., tenants={...})` partitions
+the replica pool by tenant weight (each partition serving its tenant's
+model version), routes `infer(feed, tenant=...)` only within the
+partition, throttles each tenant at its weighted admission share
+(`TenantThrottledError`) and tracks per-tenant p99 against a declared
+SLO (`tenant_stats()`).
+
 PS-backed CTR serving plugs in through `predictor_factory`: build each
 replica's predictor as an `inference.PsLookupPredictor` and the fleet
 serves a big-table model while every replica holds only an LRU row
@@ -31,10 +38,11 @@ from .fleet import ServingFleet  # noqa: F401
 from .registry import ModelRegistry, ModelVersion  # noqa: F401
 from .replica import (ProcessReplica, ReplicaDeadError,  # noqa: F401
                       ThreadReplica)
-from .router import FleetRouter, NoReplicaAvailableError  # noqa: F401
+from .router import (FleetRouter, NoReplicaAvailableError,  # noqa: F401
+                     TenantThrottledError)
 
 __all__ = [
     "FleetRouter", "ModelRegistry", "ModelVersion",
     "NoReplicaAvailableError", "ProcessReplica", "ReplicaDeadError",
-    "ServingFleet", "ThreadReplica",
+    "ServingFleet", "TenantThrottledError", "ThreadReplica",
 ]

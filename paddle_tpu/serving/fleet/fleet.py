@@ -15,6 +15,15 @@ the FleetRouter in front, and drive lifecycle operations against
   version B and weight the router: weighted A/B between two live
   versions.
 * ``submit()/infer()`` — the router's failover-wrapped request path.
+
+Multi-tenant co-hosting: pass ``tenants={name: {"version": v,
+"weight": w, "slo_p99_ms": ms}}`` and the replica pool is partitioned
+by weight (largest remainder, every tenant keeps at least one replica),
+each partition serving its tenant's model version. Requests then carry
+``tenant=``; the router enforces the weighted admission share and
+tracks per-tenant p99 against the declared SLO (``tenant_stats()``).
+``rollout(version, tenant=...)`` swaps one tenant's partition without
+touching the others.
 """
 from __future__ import annotations
 
@@ -33,6 +42,25 @@ from .router import FleetRouter
 __all__ = ["ServingFleet"]
 
 
+def _partition_by_weight(total: int, weights: Dict[str, float]) -> Dict[str, int]:
+    """Split `total` replica slots across tenants proportional to weight:
+    floor of the proportional quota on top of a guaranteed 1 each, then
+    largest-remainder for what's left."""
+    names = list(weights)
+    if total < len(names):
+        raise ValueError(f"{len(names)} tenants need at least "
+                         f"{len(names)} replicas (got {total})")
+    wsum = sum(weights.values())
+    rest = total - len(names)
+    quota = {n: weights[n] / wsum * rest for n in names}
+    alloc = {n: 1 + int(math.floor(quota[n])) for n in names}
+    leftover = total - sum(alloc.values())
+    for n in sorted(names, key=lambda n: quota[n] - math.floor(quota[n]),
+                    reverse=True)[:leftover]:
+        alloc[n] += 1
+    return alloc
+
+
 class ServingFleet:
     def __init__(self, registry: ModelRegistry, version: Optional[str] = None,
                  replicas: int = 3, mode: str = "thread",
@@ -41,7 +69,9 @@ class ServingFleet:
                  predictor_factory=None, example_feed=None,
                  server_kwargs: Optional[dict] = None,
                  env: Optional[dict] = None,
-                 health_interval_s: Optional[float] = None, seed: int = 0):
+                 health_interval_s: Optional[float] = None, seed: int = 0,
+                 tenants: Optional[Dict[str, dict]] = None,
+                 tenant_capacity: Optional[int] = None):
         if replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         if mode not in ("thread", "process"):
@@ -50,30 +80,59 @@ class ServingFleet:
             raise ValueError("predictor_factory is thread-mode only (a "
                              "subprocess builds its own predictor)")
         self.registry = registry
-        version = version if version is not None else registry.latest()
-        if version is None:
-            raise ValueError("registry is empty — register a version first")
-        model = registry.resolve(version)
         self.mode = mode
+        self._tenants = tenants
         self._replicas: List = []
-        if mode == "thread":
-            for i in range(replicas):
-                self._replicas.append(ThreadReplica(
-                    f"replica-{i}", model, buckets=buckets,
+
+        def build(name, model, tenant=None):
+            if mode == "thread":
+                r = ThreadReplica(
+                    name, model, buckets=buckets,
                     predictor_factory=predictor_factory, warm=warm,
-                    example_feed=example_feed, server_kwargs=server_kwargs))
+                    example_feed=example_feed, server_kwargs=server_kwargs)
+            else:
+                r = ProcessReplica(name, model, buckets=buckets, warm=warm,
+                                   env=env, server_kwargs=server_kwargs)
+            r.tenant = tenant
+            self._replicas.append(r)
+            return r
+
+        if tenants:
+            # tenant partitions: each tenant's replicas serve its own
+            # version; the int `replicas` is the total pool being split
+            alloc = _partition_by_weight(
+                replicas,
+                {n: float(s.get("weight", 1.0)) for n, s in tenants.items()})
+            for tname, spec in tenants.items():
+                v = spec.get("version") or version or registry.latest()
+                if v is None:
+                    raise ValueError(f"tenant {tname!r} names no version "
+                                     "and the registry is empty")
+                model = registry.resolve(v)
+                for i in range(alloc[tname]):
+                    build(f"{tname}/replica-{i}", model, tenant=tname)
         else:
-            # spawn all workers first, then wait: startup cost is one
-            # worker's wall time, not N of them
+            version = version if version is not None else registry.latest()
+            if version is None:
+                raise ValueError(
+                    "registry is empty — register a version first")
+            model = registry.resolve(version)
             for i in range(replicas):
-                self._replicas.append(ProcessReplica(
-                    f"replica-{i}", model, buckets=buckets, warm=warm,
-                    env=env, server_kwargs=server_kwargs))
+                build(f"replica-{i}", model)
+        if mode == "process":
+            # spawned all workers above; wait after, so startup cost is
+            # one worker's wall time, not N of them
             for r in self._replicas:
                 r.wait_ready()
         self.router = FleetRouter(self._replicas, policy=policy,
                                   health_interval_s=health_interval_s,
                                   seed=seed)
+        if tenants:
+            self.router.set_tenants(
+                {n: {"weight": s.get("weight", 1.0),
+                     "slo_p99_ms": s.get("slo_p99_ms")}
+                 for n, s in tenants.items()},
+                capacity=tenant_capacity)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingFleet":
@@ -98,12 +157,17 @@ class ServingFleet:
 
     # -- request path -------------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
-               timeout_ms: Optional[float] = None):
-        return self.router.submit(feed, timeout_ms=timeout_ms)
+               timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None):
+        return self.router.submit(feed, timeout_ms=timeout_ms, tenant=tenant)
 
     def infer(self, feed: Dict[str, np.ndarray],
-              timeout_ms: Optional[float] = None) -> List[np.ndarray]:
-        return self.router.infer(feed, timeout_ms=timeout_ms)
+              timeout_ms: Optional[float] = None,
+              tenant: Optional[str] = None) -> List[np.ndarray]:
+        return self.router.infer(feed, timeout_ms=timeout_ms, tenant=tenant)
+
+    def tenant_stats(self) -> Optional[dict]:
+        return self.router.tenant_stats()
 
     # -- version management -------------------------------------------------
     @property
@@ -118,16 +182,20 @@ class ServingFleet:
         return live
 
     def rollout(self, version: str,
-                only: Optional[Sequence[str]] = None) -> dict:
-        """Swap every live replica (or the named subset) to `version`,
-        one at a time, each swap warm-then-flip-then-drain. Returns the
-        per-replica swap reports; a replica that died mid-rollout is
-        reported, not fatal (the rest of the fleet still converges)."""
+                only: Optional[Sequence[str]] = None,
+                tenant: Optional[str] = None) -> dict:
+        """Swap every live replica (or the named subset, or one tenant's
+        partition) to `version`, one at a time, each swap
+        warm-then-flip-then-drain. Returns the per-replica swap reports;
+        a replica that died mid-rollout is reported, not fatal (the rest
+        of the fleet still converges)."""
         model = self.registry.resolve(version)
         t0 = time.monotonic()
         reports = {}
         names = set(only) if only is not None else None
         for r in self._replicas:
+            if tenant is not None and getattr(r, "tenant", None) != tenant:
+                continue
             if names is not None and r.name not in names:
                 continue
             if not r.alive:
@@ -166,4 +234,5 @@ class ServingFleet:
 
     def stats(self) -> dict:
         return {"mode": self.mode, "versions_live": self.versions_live(),
+                "tenants": self.tenant_stats(),
                 "router": self.router.stats()}

@@ -12,6 +12,13 @@ and the registry reads the checkpoint's SHA-256 manifest via
 ``Checkpointer.verified_steps()`` — a version can only claim lineage
 from a step whose on-disk bytes actually verify, so a torn or corrupt
 training checkpoint can never be promoted to serving.
+
+Quantization promotion gate: registering ``precision="int8"`` requires
+``calibration={"accuracy_delta": ..., "samples": ...}`` metadata (the
+measurement `inference.quant.quantize_predictor_inplace` produces), and
+the recorded delta must sit inside the accuracy budget — an
+uncalibrated or out-of-budget int8 export can never be promoted to a
+servable version.
 """
 from __future__ import annotations
 
@@ -66,6 +73,24 @@ class ModelRegistry:
             raise ValueError(
                 f"registry: {model_path!r} missing — not an inference "
                 f"model dir (io.save_inference_model writes __model__)")
+        if precision is not None and str(precision).lower() in ("int8", "i8"):
+            from ...inference.quant import default_budget
+            calib = meta.get("calibration")
+            if not isinstance(calib, dict) or "accuracy_delta" not in calib:
+                raise ValueError(
+                    f"registry: version {version!r} claims int8 but has no "
+                    "calibration metadata — pass calibration={'accuracy_"
+                    "delta': ..., 'samples': ...} (quantize_predictor_"
+                    "inplace measures it); refusing to promote an "
+                    "uncalibrated quantized model")
+            budget = float(calib.get("accuracy_budget", default_budget()))
+            delta = float(calib["accuracy_delta"])
+            if delta > budget:
+                raise ValueError(
+                    f"registry: version {version!r} int8 accuracy delta "
+                    f"{delta:.6f} exceeds budget {budget:.6f} — refusing "
+                    "to promote; recalibrate with more samples or raise "
+                    "the budget explicitly")
         if checkpointer is not None:
             verified = checkpointer.verified_steps()
             if step is None:

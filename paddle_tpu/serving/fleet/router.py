@@ -24,12 +24,23 @@ Each replica is tried at most once per request; non-replica errors
 unchanged. `QueueFullError` also fails over (another replica may have
 room) but surfaces when every replica is full — backpressure stays
 explicit at the fleet boundary.
+
+Multi-tenant co-hosting (generalizing the A/B weight split): replicas
+carry a ``tenant`` tag and ``set_tenants`` declares the tenant table —
+relative capacity weight plus an optional p99 SLO per tenant. A request
+submitted with ``tenant=`` routes only to that tenant's replicas, and
+admission is capped at the tenant's weighted share of fleet capacity
+(`TenantThrottledError` — a bursting tenant is throttled at the door
+instead of queuing behind everyone else's work, which is what keeps the
+*other* tenants' p99 flat). Per-tenant latency lands in a labelled
+histogram; ``tenant_stats`` reports p99-vs-SLO per tenant.
 """
 from __future__ import annotations
 
 import os
 import random
 import threading
+import time
 
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
@@ -44,7 +55,7 @@ from ...observability.tracer import trace_span
 from ...ps.transport import TransportError
 from .replica import ReplicaDeadError
 
-__all__ = ["FleetRouter", "NoReplicaAvailableError"]
+__all__ = ["FleetRouter", "NoReplicaAvailableError", "TenantThrottledError"]
 
 # a replica died under the request — replay it elsewhere
 _FAILOVER_ERRORS = (TransportError, ServerClosedError, ReplicaDeadError,
@@ -53,6 +64,14 @@ _FAILOVER_ERRORS = (TransportError, ServerClosedError, ReplicaDeadError,
 
 class NoReplicaAvailableError(ServingError):
     """Every replica is ejected, draining, or already tried."""
+
+
+class TenantThrottledError(ServingError):
+    """The tenant is at its weighted capacity share — back off and retry.
+
+    Raised at admission, before any replica queue is touched: one
+    tenant's burst must not consume fleet headroom another tenant's SLO
+    depends on."""
 
 
 class _ReplicaSlot:
@@ -83,6 +102,10 @@ class FleetRouter:
         self._rr = 0
         self._rng = random.Random(seed)
         self._weights: Optional[Dict[str, float]] = None
+        # tenancy: {tenant: {"weight": normalized, "slo_p99_ms": float|None,
+        #                    "share": max in-flight}} — None = single-tenant
+        self._tenants: Optional[Dict[str, dict]] = None
+        self._tenant_out: Dict[str, int] = {}
         self._interval = (health_interval_s if health_interval_s is not None
                           else float(os.environ.get(
                               "PDTPU_FLEET_HEALTH_INTERVAL", "0.5")))
@@ -170,12 +193,99 @@ class FleetRouter:
         with self._lock:
             self._weights = weights
 
+    # -- tenancy ------------------------------------------------------------
+    def set_tenants(self, tenants: Optional[Dict[str, dict]],
+                    capacity: Optional[int] = None) -> None:
+        """Declare the tenant table: ``{name: {"weight": w,
+        "slo_p99_ms": ms}}`` (None returns to single-tenant routing).
+        ``capacity`` is the fleet-wide in-flight budget the weights
+        divide (default: 8 × replica count); every tenant gets at least
+        one admission slot."""
+        if tenants is None:
+            with self._lock:
+                self._tenants = None
+                self._tenant_out = {}
+            return
+        total = sum(float(t.get("weight", 1.0)) for t in tenants.values())
+        if total <= 0:
+            raise ValueError("tenant weights must sum to > 0")
+        cap = int(capacity) if capacity is not None else 8 * len(self._slots)
+        table = {}
+        for name, spec in tenants.items():
+            w = float(spec.get("weight", 1.0)) / total
+            slo = spec.get("slo_p99_ms")
+            table[name] = {"weight": w,
+                           "slo_p99_ms": None if slo is None else float(slo),
+                           "share": max(1, int(round(w * cap)))}
+        with self._lock:
+            self._tenants = table
+            self._tenant_out = {name: 0 for name in table}
+
+    def _admit(self, tenant: str) -> None:
+        """Count the request against the tenant's capacity share."""
+        with self._lock:
+            table = self._tenants
+            if table is None:
+                return
+            spec = table.get(tenant)
+            if spec is None:
+                raise ValueError(f"unknown tenant {tenant!r}; declared: "
+                                 f"{sorted(table)}")
+            if self._tenant_out[tenant] >= spec["share"]:
+                self.metrics.counter("fleet/tenant_throttled",
+                                     tenant=tenant).inc()
+                raise TenantThrottledError(
+                    f"tenant {tenant!r} at capacity share "
+                    f"({spec['share']} in flight)")
+            self._tenant_out[tenant] += 1
+
+    def _release(self, tenant: str, t0: float, ok: bool) -> None:
+        with self._lock:
+            if self._tenants is not None and tenant in self._tenant_out:
+                self._tenant_out[tenant] = max(
+                    0, self._tenant_out[tenant] - 1)
+        if ok:
+            self.metrics.histogram("fleet/tenant_latency_ms",
+                                   tenant=tenant).observe(
+                (time.monotonic() - t0) * 1e3)
+
+    def tenant_stats(self) -> Optional[dict]:
+        """Per-tenant view: share, in-flight, request/throttle counts,
+        observed p99 against the declared SLO (``slo_ok`` is None until
+        latency samples exist)."""
+        with self._lock:
+            table = self._tenants
+            if table is None:
+                return None
+            out = dict(self._tenant_out)
+            table = {k: dict(v) for k, v in table.items()}
+        stats = {}
+        for name, spec in table.items():
+            p99 = self.metrics.histogram(
+                "fleet/tenant_latency_ms", tenant=name).percentile(99)
+            slo = spec["slo_p99_ms"]
+            stats[name] = {
+                "weight": spec["weight"], "share": spec["share"],
+                "outstanding": out.get(name, 0),
+                "requests": self.metrics.counter(
+                    "fleet/tenant_requests", tenant=name).value,
+                "throttled": self.metrics.counter(
+                    "fleet/tenant_throttled", tenant=name).value,
+                "p99_ms": p99, "slo_p99_ms": slo,
+                "slo_ok": (None if p99 is None or slo is None
+                           else bool(p99 <= slo)),
+            }
+        return stats
+
     # -- replica choice -----------------------------------------------------
-    def _pick(self, exclude: set):
+    def _pick(self, exclude: set, tenant: Optional[str] = None):
         with self._lock:
             cands = [s for s in self._slots
                      if s.eligible and s.replica.name not in exclude
                      and s.replica.alive]
+            if tenant is not None:
+                cands = [s for s in cands
+                         if getattr(s.replica, "tenant", None) == tenant]
             if not cands:
                 return None
             weights = self._weights
@@ -202,12 +312,23 @@ class FleetRouter:
 
     # -- request path -------------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
-               timeout_ms: Optional[float] = None) -> Future:
+               timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Route one request; the returned Future resolves to the output
         slices. Failover happens inside — the caller only ever sees a
-        non-replica error or the final result."""
+        non-replica error or the final result. With ``tenant=`` the
+        request is admission-checked against the tenant's capacity share
+        (raising :class:`TenantThrottledError` synchronously) and routed
+        only to that tenant's replicas."""
         outer: Future = Future()
         attempted: set = set()
+        if tenant is not None:
+            self._admit(tenant)  # raises before any queue is touched
+            self.metrics.counter("fleet/tenant_requests",
+                                 tenant=tenant).inc()
+            t0 = time.monotonic()
+            outer.add_done_callback(
+                lambda f: self._release(tenant, t0, f.exception() is None))
         self.metrics.counter("fleet/requests").inc()
         # every routed request is one distributed trace: adopt the
         # caller's context or root a fresh one here — try_next may run
@@ -216,10 +337,12 @@ class FleetRouter:
         root = _trace_ctx.current() or _trace_ctx.new_trace()
 
         def try_next(last_error: Optional[Exception]) -> None:
-            replica = self._pick(attempted)
+            replica = self._pick(attempted, tenant)
             if replica is None:
                 outer.set_exception(last_error or NoReplicaAvailableError(
-                    f"no eligible replica (tried {sorted(attempted)})"))
+                    f"no eligible replica"
+                    + (f" for tenant {tenant!r}" if tenant else "")
+                    + f" (tried {sorted(attempted)})"))
                 return
             attempted.add(replica.name)
             try:
@@ -260,8 +383,10 @@ class FleetRouter:
         return outer
 
     def infer(self, feed: Dict[str, np.ndarray],
-              timeout_ms: Optional[float] = None) -> List[np.ndarray]:
-        return self.submit(feed, timeout_ms=timeout_ms).result()
+              timeout_ms: Optional[float] = None,
+              tenant: Optional[str] = None) -> List[np.ndarray]:
+        return self.submit(feed, timeout_ms=timeout_ms,
+                           tenant=tenant).result()
 
     # -- introspection ------------------------------------------------------
     @property
@@ -274,9 +399,11 @@ class FleetRouter:
                 "eligible": s.eligible, "degraded": s.degraded,
                 "ejected": s.ejected, "alive": s.replica.alive,
                 "version": s.replica.version,
+                "tenant": getattr(s.replica, "tenant", None),
                 "outstanding": s.replica.outstanding}
                 for s in self._slots}
             weights = dict(self._weights) if self._weights else None
         return {"policy": self.policy, "replicas": per,
                 "version_weights": weights,
+                "tenants": self.tenant_stats(),
                 "metrics": self.metrics.snapshot()}

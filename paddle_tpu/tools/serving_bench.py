@@ -17,10 +17,29 @@ CLI::
     python -m paddle_tpu.tools.serving_bench --requests 256 --concurrency 32
     python -m paddle_tpu.tools.serving_bench --qps 500 --duration 5 \
         --buckets 1,2,4,8,16,32 --batch-delay-ms 2
+    python -m paddle_tpu.tools.serving_bench --precision int8
+    python -m paddle_tpu.tools.serving_bench --models ads:2,feed:1,search:1 \
+        --replicas 4 --slo-p99-ms 500
 
 Output: one throughput + latency-percentile row per mode, plus the
 serving metrics report. Exit code 1 if batched throughput does not beat
 sequential (the property BENCH rounds assert).
+
+``--precision int8`` serves the post-training-quantized model: the
+bench's own request rows double as the calibration stream
+(Config.enable_int8), so the accuracy gate runs before any load is
+generated — a model that fails calibration fails the bench.
+
+``--models a:2,b:1`` switches to multi-tenant co-hosting: each
+name:weight pair becomes a tenant on ONE ServingFleet (its own
+registered model version, replica partition sized by weight), the load
+mix draws each request's tenant proportional to weight, and the output
+grows one latency row PER TENANT plus the router's ``tenant_stats``.
+``--slo-p99-ms`` then gates per tenant — exit 2 if ANY tenant's p99
+breaches (same exit-code contract as the single-model gate). Combine
+with ``--precision int8`` and the fleet serves quantized replicas,
+registered through the registry's int8 promotion gate with the
+measured accuracy delta.
 
 Telemetry sidecars: ``--metrics-out m.json`` dumps the unified
 observability Registry snapshot (serving counters AND executor
@@ -49,11 +68,29 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["build_predictor", "bench_sequential", "bench_served",
-           "bench_fleet", "percentile_row", "main"]
+           "bench_fleet", "bench_tenants", "percentile_row", "main"]
+
+
+def _make_config(model_dir: str, precision: Optional[str],
+                 calib_feeds=None):
+    """Config for `model_dir` at `precision`. int8 needs a calibration
+    stream (`calib_feeds`); other precisions flow through enable_tpu so
+    an unknown string raises here, before any load is generated."""
+    from paddle_tpu import inference
+
+    cfg = inference.Config(model_dir)
+    if precision is None:
+        return cfg
+    if inference._resolve_precision(precision) == "int8":
+        cfg.enable_int8(calib_feeds)
+    else:
+        cfg.enable_tpu(precision=precision)
+    return cfg
 
 
 def build_predictor(model_dir: Optional[str] = None, in_dim: int = 512,
-                    hidden: int = 2048, classes: int = 16, layers: int = 2):
+                    hidden: int = 2048, classes: int = 16, layers: int = 2,
+                    precision: Optional[str] = None, calib_feeds=None):
     """Save an MLP inference model and return its Predictor. The default
     size (2x2048 hidden) is deliberately weight-heavy: per batch-1 call
     the CPU/TPU must re-read every weight, so batching has real economics
@@ -72,7 +109,8 @@ def build_predictor(model_dir: Optional[str] = None, in_dim: int = 512,
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
-    return inference.create_predictor(inference.Config(model_dir))
+    return inference.create_predictor(
+        _make_config(model_dir, precision, calib_feeds))
 
 
 def _gen_rows(n: int, in_dim: int, seed: int = 0) -> List[np.ndarray]:
@@ -235,6 +273,122 @@ def bench_fleet(model_dir: str, rows: List[np.ndarray], replicas: int = 3,
     return out
 
 
+def bench_tenants(model_dir: str, specs: "dict[str, float]",
+                  rows: List[np.ndarray], replicas: int = 0,
+                  concurrency: int = 32, buckets=(1, 2, 4, 8, 16, 32),
+                  batch_delay_ms: float = 2.0,
+                  precision: Optional[str] = None, calib_feeds=None,
+                  slo_p99_ms: Optional[float] = None,
+                  seed: int = 0) -> dict:
+    """Multi-tenant co-hosting bench: every `specs` name:weight pair is
+    registered as its own model version and co-hosted on ONE fleet whose
+    replica pool is partitioned by weight. Mixed load — each request's
+    tenant is drawn proportional to weight — then one latency summary
+    PER TENANT (the isolation claim is per-tenant p99, not the blended
+    number) plus the router's own tenant_stats.
+
+    With ``precision='int8'`` the accuracy delta is measured once
+    against `calib_feeds` and every tenant's version is registered
+    through the registry's int8 promotion gate with that calibration
+    metadata; replicas then build quantized predictors."""
+    from paddle_tpu.serving import fleet as fleet_mod
+
+    total = max(replicas, len(specs))
+    reg = fleet_mod.ModelRegistry()
+    factory, reg_precision, meta = None, None, {}
+    if precision is not None:
+        from paddle_tpu import inference
+
+        if inference._resolve_precision(precision) == "int8":
+            probe = inference.create_predictor(
+                _make_config(model_dir, precision, calib_feeds))
+            qm = probe.quant_meta
+            reg_precision = "int8"
+            meta = {"calibration": {
+                "accuracy_delta": qm["accuracy_delta"],
+                "accuracy_budget": qm["accuracy_budget"],
+                "samples": qm["samples"]}}
+
+        def factory(model):
+            from paddle_tpu.inference import create_predictor
+            return create_predictor(
+                _make_config(model.model_dir, precision, calib_feeds))
+
+    tenants = {}
+    for name, weight in specs.items():
+        reg.register(f"{name}-v1", model_dir, precision=reg_precision,
+                     **meta)
+        tenants[name] = {"version": f"{name}-v1", "weight": weight,
+                         "slo_p99_ms": slo_p99_ms}
+    fl = fleet_mod.ServingFleet(
+        reg, replicas=total, buckets=buckets, predictor_factory=factory,
+        server_kwargs={"max_batch_delay_ms": batch_delay_ms,
+                       "max_queue_size": max(len(rows), 1024)},
+        tenants=tenants)
+
+    names = list(specs)
+    wsum = sum(specs.values())
+    p = np.asarray([specs[n] / wsum for n in names])
+    assign = np.random.RandomState(seed + 2).choice(
+        len(names), size=len(rows), p=p)
+    lats = [0.0] * len(rows)
+    errors = {n: 0 for n in names}
+    throttled = {n: 0 for n in names}
+    elock = threading.Lock()
+
+    with fl:
+        t0 = time.monotonic()
+        it = iter(list(enumerate(rows)))
+        lock = threading.Lock()
+
+        def drive():
+            while True:
+                with lock:
+                    nxt = next(it, None)
+                if nxt is None:
+                    return
+                i, r = nxt
+                tenant = names[assign[i]]
+                s = time.monotonic()
+                try:
+                    fl.infer({"x": r}, tenant=tenant)
+                    lats[i] = (time.monotonic() - s) * 1e3
+                except fleet_mod.TenantThrottledError:
+                    with elock:
+                        throttled[tenant] += 1
+                except Exception:
+                    with elock:
+                        errors[tenant] += 1
+
+        threads = [threading.Thread(target=drive)
+                   for _ in range(max(1, concurrency))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        tstats = fl.tenant_stats()
+
+    per_tenant = {}
+    for j, name in enumerate(names):
+        tl = [lats[i] for i in range(len(rows))
+              if assign[i] == j and lats[i] > 0]
+        row = _summarize(f"tenant:{name}(w={specs[name]:g})",
+                         len(tl), wall, tl)
+        row["errors"] = errors[name]
+        row["throttled"] = throttled[name]
+        row["router"] = tstats.get(name)
+        per_tenant[name] = row
+    ok = len(rows) - sum(errors.values()) - sum(throttled.values())
+    out = _summarize(f"tenants(n={len(names)},r={total})", ok, wall,
+                     [x for x in lats if x > 0])
+    out["errors"] = sum(errors.values())
+    out["throttled"] = sum(throttled.values())
+    out["per_tenant"] = per_tenant
+    out["precision"] = precision or "fp32"
+    return out
+
+
 def _collect_fleet_telemetry(fl):
     """(federated /fleet doc, [(name, chrome-trace), ...]) for a live
     fleet: coordinator + every replica, per-target failures recorded in
@@ -301,6 +455,16 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-mode", choices=("thread", "process"),
                     default="thread",
                     help="fleet replica isolation for --replicas")
+    ap.add_argument("--precision", default=None,
+                    help="serving precision (fp32/bf16/int8); int8 "
+                         "calibrates on the bench's own request rows and "
+                         "runs the accuracy gate before generating load")
+    ap.add_argument("--models", default=None,
+                    help="multi-tenant mode: 'a:2,b:1' name:weight pairs "
+                         "co-hosted on one fleet (replica pool from "
+                         "--replicas, partitioned by weight); reports "
+                         "per-tenant p99 and gates --slo-p99-ms per "
+                         "tenant")
     ap.add_argument("--slo-p99-ms", type=float, default=None,
                     help="p99 latency SLO gate: exit 2 if the headline "
                          "mode (fleet with --replicas > 1, else served) "
@@ -324,9 +488,19 @@ def main(argv=None) -> int:
     n = (args.requests if args.qps <= 0
          else max(1, int(args.qps * args.duration)))
     rows = _gen_rows(n, args.in_dim, args.seed)
+    # int8 calibration reuses the head of the request stream — the
+    # activation ranges the bench serves are the ranges it calibrated on
+    calib = [{"x": r} for r in rows[:8]]
     model_dir = tempfile.mkdtemp(prefix="serving_bench_")
     pred = build_predictor(model_dir=model_dir, in_dim=args.in_dim,
-                           hidden=args.hidden, layers=args.layers)
+                           hidden=args.hidden, layers=args.layers,
+                           precision=args.precision, calib_feeds=calib)
+    if args.precision:
+        qm = pred.quant_meta
+        if qm is not None:
+            print(f"int8 calibration: accuracy_delta="
+                  f"{qm['accuracy_delta']:.6f} (budget "
+                  f"{qm['accuracy_budget']:g}, {qm['samples']} samples)")
 
     header = (f"{'mode':<18}{'reqs':>6}{'wall_s':>9}{'rps':>12}"
               f"{'mean_ms':>10}{'p50_ms':>10}{'p95_ms':>10}{'p99_ms':>10}")
@@ -352,7 +526,22 @@ def main(argv=None) -> int:
         scraper.join(timeout=10)
     print(percentile_row(served))
     flt = None
-    if args.replicas > 1:
+    ten = None
+    if args.models:
+        specs = {}
+        for part in args.models.split(","):
+            name, _, w = part.partition(":")
+            specs[name.strip()] = float(w) if w.strip() else 1.0
+        ten = bench_tenants(model_dir, specs, rows,
+                            replicas=args.replicas,
+                            concurrency=args.concurrency, buckets=buckets,
+                            batch_delay_ms=args.batch_delay_ms,
+                            precision=args.precision, calib_feeds=calib,
+                            slo_p99_ms=args.slo_p99_ms, seed=args.seed)
+        print(percentile_row(ten))
+        for trow in ten["per_tenant"].values():
+            print(percentile_row(trow))
+    if args.replicas > 1 and not args.models:
         flt = bench_fleet(model_dir, rows, replicas=args.replicas,
                           concurrency=args.concurrency, buckets=buckets,
                           batch_delay_ms=args.batch_delay_ms,
@@ -383,6 +572,8 @@ def main(argv=None) -> int:
         if flt is not None and flt["fleet"].get("federated"):
             # the whole fleet's series, per process, + autoscale signals
             snap["bench/fleet_federated"] = flt["fleet"]["federated"]
+        if ten is not None:
+            snap["bench/tenants"] = ten
         with open(args.metrics_out, "w") as f:
             json.dump(snap, f, indent=2, sort_keys=True)
         print(f"wrote registry snapshot to {args.metrics_out}")
@@ -416,6 +607,23 @@ def main(argv=None) -> int:
             print("FAIL: dynamic batching did not beat sequential")
             return 1
     if args.slo_p99_ms is not None:
+        if ten is not None:
+            # tenancy mode gates PER TENANT: co-hosting only counts as
+            # isolation if every tenant holds its own p99
+            breaches = []
+            for name, trow in ten["per_tenant"].items():
+                bad = (trow["p99_ms"] > args.slo_p99_ms
+                       or trow["errors"] > 0)
+                print(f"SLO p99 <= {args.slo_p99_ms:g}ms tenant "
+                      f"{name}: p99={trow['p99_ms']:.2f}ms "
+                      f"errors={trow['errors']} "
+                      f"throttled={trow['throttled']} "
+                      f"-> {'FAIL' if bad else 'ok'}")
+                if bad:
+                    breaches.append(name)
+            if breaches:
+                return 2
+            return 0
         head = flt if flt is not None else served
         breached = (head["p99_ms"] > args.slo_p99_ms
                     or head.get("errors", 0) > 0)

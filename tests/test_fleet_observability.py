@@ -287,6 +287,13 @@ def test_federated_scraper_merges_and_derives_signals():
     dead target is recorded (not raised), and the autoscaler gauges
     distill out of the merged series."""
     srv = ShardServer([EmbeddingShard("tb", 0, V)]).serve_in_thread()
+    # The in-thread pserver target serves the process-global registry, so
+    # straggler anomalies recorded by earlier tests in this process ride
+    # along in its series — only the stub's contribution is exact.
+    pre_anomalies = sum(
+        float(s.get("value") or 0.0)
+        for s in get_registry().series(deep=True)
+        if s.get("name") == "steps/anomalies")
     stub = [{"name": "ps/shard_pull_ms", "type": "summary",
              "labels": {"shard": "0"},
              "summary": {"count": 4, "sum": 8.0, "p50": 2.0, "p95": 3.0,
@@ -309,9 +316,11 @@ def test_federated_scraper_merges_and_derives_signals():
         assert any(s["name"] == "ps/server_requests"
                    for s in ps_t["series"])
         sig = doc["signals"]
-        assert sig["ps_pull_p99_ms"] == {"0": 3.5}
-        assert sig["queue_depth"] == {"w0": 7.0}
-        assert sig["stragglers"] == 2.0
+        # per-key: the pserver target may carry real shard_pull/queue
+        # series from earlier in-process tests alongside the stub's
+        assert sig["ps_pull_p99_ms"]["0"] == 3.5
+        assert sig["queue_depth"]["w0"] == 7.0
+        assert sig["stragglers"] == 2.0 + pre_anomalies
         assert sig["targets_unreachable"] == 1
         reg = get_registry()
         assert reg.gauge("autoscale/ps_pull_p99_ms",

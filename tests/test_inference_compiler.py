@@ -1,0 +1,433 @@
+"""Inference compiler acceptance surface: PassPipeline attribution,
+int8 post-training quantization (calibrate → rewrite → gate), the fleet
+registry's int8 promotion gate, quantized PS-lookup serving with
+delta-push re-quantization, and multi-tenant co-hosting (routing
+isolation, weighted admission throttling, per-tenant p99 SLOs).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+IN_DIM, HID, CLASSES = 16, 32, 4
+
+
+def _save_mlp(model_dir, seed=0):
+    import jax.numpy as jnp
+    from paddle_tpu.core.scope import global_scope
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        h = fluid.layers.fc(x, HID, act="relu")
+        out = fluid.layers.fc(h, CLASSES, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sc = global_scope()
+        rng = np.random.RandomState(seed)
+        for n in sc.var_names():
+            v = np.asarray(sc.find_var(n))
+            if v.dtype == np.float32:
+                sc.set_var(n, jnp.asarray(
+                    rng.uniform(-0.5, 0.5, v.shape).astype(np.float32)))
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main)
+    return model_dir
+
+
+@pytest.fixture(scope="module")
+def mlp_dir(tmp_path_factory):
+    from paddle_tpu.core import program as prog_mod
+    from paddle_tpu.core import scope as scope_mod
+
+    old = (prog_mod._main_program, prog_mod._startup_program,
+           scope_mod._global_scope, scope_mod._current_scope)
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    scope_mod._global_scope = scope_mod.Scope()
+    scope_mod._current_scope = scope_mod._global_scope
+    try:
+        return _save_mlp(str(tmp_path_factory.mktemp("infc") / "mlp"))
+    finally:
+        (prog_mod._main_program, prog_mod._startup_program,
+         scope_mod._global_scope, scope_mod._current_scope) = old
+
+
+def _samples(n=4, batch=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(batch, IN_DIM).astype(np.float32)}
+            for _ in range(n)]
+
+
+# -- pass pipeline + perf-ledger attribution ------------------------------
+
+def test_predictor_pass_report_lands_in_ledger(mlp_dir):
+    from paddle_tpu import inference
+    from paddle_tpu.observability import perf
+
+    pred = inference.create_predictor(inference.Config(mlp_dir))
+    report = pred.pass_report
+    assert report is not None
+    names = [r["pass"] for r in report["passes"]]
+    # the tentpole pipeline: fusion + DCE + the new dead-var/layout passes
+    for expected in ("fc_fuse_pass", "dead_code_elimination_pass",
+                     "dead_var_elimination_pass", "layout_assignment_pass",
+                     "memory_optimize_pass"):
+        assert expected in names, names
+    for rec in report["passes"]:
+        for key in ("neutrality", "ops_before", "ops_after",
+                    "flops_delta", "bytes_delta", "wall_ms"):
+            assert key in rec, rec
+    # fc fusion really removed ops and the totals account for it
+    fc = next(r for r in report["passes"] if r["pass"] == "fc_fuse_pass")
+    assert fc["ops_before"] > fc["ops_after"]
+    assert report["ops_total_removed"] >= (
+        fc["ops_before"] - fc["ops_after"])
+    # the ledger holds the same report, keyed by the predictor label
+    assert report["label"].startswith("infer:")
+    assert perf.get_ledger().pass_reports().get(
+        report["label"]) is not None
+    # layout annotation rode along
+    assert pred._program._layout_plan["matmul_ops"]
+
+
+def test_compiled_program_inference_optimize_runs_pipeline():
+    from paddle_tpu import compiler, inference
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [IN_DIM])
+        h = fluid.layers.fc(x, HID, act="relu")
+        out = fluid.layers.fc(h, CLASSES)  # noqa: F841
+    cp = compiler.CompiledProgram(main).with_inference_optimize(
+        inference.Config())
+    ops = [op.type for op in cp._program.global_block().ops]
+    assert "fused_fc" in ops
+    assert cp._program._pass_report["passes"]
+
+
+# -- int8 post-training quantization --------------------------------------
+
+def test_int8_quantizes_and_matches_fp32(mlp_dir):
+    from paddle_tpu import inference
+
+    samples = _samples()
+    p32 = inference.create_predictor(inference.Config(mlp_dir))
+    cfg = inference.Config(mlp_dir)
+    cfg.enable_int8(samples)
+    p8 = inference.create_predictor(cfg)
+
+    ops = [op.type for op in p8._program.global_block().ops]
+    assert ops.count("quantized_fc") == 2 and "fused_fc" not in ops
+    # fp32 weights left the device; int8 twins + scales arrived
+    dtypes = {k: str(v.dtype) for k, v in p8._state.items()}
+    assert [k for k in dtypes if k.endswith("@int8")]
+    assert all(dtypes[k] == "int8" for k in dtypes if k.endswith("@int8"))
+    assert not [k for k, d in dtypes.items()
+                if d == "float32" and k.endswith(".w_0")]
+
+    meta = p8.quant_meta
+    assert meta["precision"] == "int8"
+    assert meta["samples"] == len(samples)
+    assert 0.0 <= meta["accuracy_delta"] <= meta["accuracy_budget"]
+    assert meta["fc"] and meta["act_scales"]
+
+    for f in samples:
+        ref = np.asarray(p32.run(f)[0])
+        got = np.asarray(p8.run(f)[0])
+        assert float(np.mean(np.abs(got - ref))) <= 0.05 * (
+            float(np.mean(np.abs(ref))) + 1e-8)
+
+    # int8_quantize_pass is attributed in the same pass report
+    assert "int8_quantize_pass" in [r["pass"]
+                                    for r in p8.pass_report["passes"]]
+
+    # clones share the quantized program + meta and serve identically
+    c = p8.clone()
+    assert c.quant_meta is p8.quant_meta
+    np.testing.assert_array_equal(np.asarray(p8.run(samples[0])[0]),
+                                  np.asarray(c.run(samples[0])[0]))
+
+
+def test_int8_accuracy_gate_rejects_over_budget(mlp_dir):
+    from paddle_tpu import inference
+    from paddle_tpu.inference import QuantizationError
+
+    cfg = inference.Config(mlp_dir)
+    cfg.enable_int8(_samples(), accuracy_budget=1e-9)
+    with pytest.raises(QuantizationError, match="accuracy gate"):
+        inference.create_predictor(cfg)
+
+
+def test_int8_without_calibration_stream_raises(mlp_dir):
+    from paddle_tpu import inference
+    from paddle_tpu.inference import QuantizationError
+
+    with pytest.raises(QuantizationError, match="calibration stream"):
+        inference.create_predictor(inference.Config(mlp_dir),
+                                   precision="int8")
+    with pytest.raises(ValueError, match="at least one sample"):
+        inference.Config(mlp_dir).enable_int8([])
+
+
+def test_unknown_precision_raises_not_silent_fp32(mlp_dir):
+    """Satellite contract: a typo'd precision string must raise, never
+    fall back to fp32."""
+    from paddle_tpu import inference
+
+    with pytest.raises(ValueError, match="unknown precision"):
+        inference.create_predictor(inference.Config(mlp_dir),
+                                   precision="fp31")
+    with pytest.raises(ValueError, match="unknown precision"):
+        inference.Config(mlp_dir).enable_tpu(precision="in8")
+    # the known spellings resolve
+    for ok in ("fp32", "float32", "bf16", "int8", "i8"):
+        assert inference._resolve_precision(ok)
+
+
+# -- registry promotion gate ----------------------------------------------
+
+def test_registry_gates_int8_promotion(mlp_dir):
+    from paddle_tpu.serving import fleet
+
+    reg = fleet.ModelRegistry()
+    with pytest.raises(ValueError, match="no calibration metadata"):
+        reg.register("q-bad", mlp_dir, precision="int8")
+    with pytest.raises(ValueError, match="exceeds budget"):
+        reg.register("q-worse", mlp_dir, precision="int8",
+                     calibration={"accuracy_delta": 0.2,
+                                  "accuracy_budget": 0.05, "samples": 4})
+    mv = reg.register("q-ok", mlp_dir, precision="int8",
+                      calibration={"accuracy_delta": 0.008,
+                                   "accuracy_budget": 0.05, "samples": 4})
+    assert mv.meta["calibration"]["accuracy_delta"] == 0.008
+    # fp32 versions are untouched by the gate
+    reg.register("f32", mlp_dir)
+    assert len(reg) == 2
+
+
+# -- quantized PS-lookup serving + delta-push re-quantization -------------
+
+V, D, MULT, F, CAP = 128, 4, 2, 3, 24
+
+
+def _save_ctr(model_dir, vocab_rows, packed=None, dense=None):
+    import jax.numpy as jnp
+    from paddle_tpu import layers
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.initializer import RowPackInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [F], dtype="int64")
+        emb = layers.embedding(
+            ids, [vocab_rows, D * MULT], is_sparse=True, row_pack=True,
+            param_attr=ParamAttr(name="tb", initializer=RowPackInitializer(
+                D, D * MULT, -1.0, 1.0)))
+        emb = layers.slice(emb, axes=[2], starts=[0], ends=[D])
+        r = layers.reshape(emb, [-1, F * D])
+        out = layers.fc(r, CLASSES, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sc = global_scope()
+        if packed is not None:
+            sc.set_var("tb", jnp.asarray(packed))
+            dense = {n: np.asarray(sc.find_var(n))
+                     for n in sc.var_names()
+                     if n != "tb"
+                     and np.asarray(sc.find_var(n)).dtype == np.float32}
+        else:
+            for n, v in dense.items():
+                sc.set_var(n, jnp.asarray(v))
+            sc.set_var("tb", jnp.zeros((vocab_rows, 128), jnp.uint16))
+        fluid.io.save_inference_model(model_dir, ["ids"], [out], exe, main)
+    return dense
+
+
+def test_ps_lookup_int8_delta_push_requantizes(tmp_path):
+    """Satellite regression: an int8-resident PS serving table must
+    re-quantize delta-pushed rows with the stored scale — u16 wire bytes
+    must never land in the int8 cache raw."""
+    import jax.numpy as jnp
+    from paddle_tpu import inference
+    from paddle_tpu.inference.quant import requantize_packed_rows
+    from paddle_tpu.ops.deferred_rows import pack_rows
+    from paddle_tpu.ps import RangeSpec, ShardedTable
+
+    vis = np.random.RandomState(7).uniform(-1, 1, (V, D)).astype("float32")
+    full = np.zeros((V, D * MULT), "float32")
+    full[:, :D] = vis
+    packed = np.asarray(pack_rows(jnp.asarray(full)))
+    dense = _save_ctr(str(tmp_path / "local"), V, packed=packed)
+    _save_ctr(str(tmp_path / "ps"), CAP, dense=dense)
+    table_scale = float(np.max(np.abs(vis)))
+
+    rng = np.random.RandomState(3)
+    samples = [{"ids": rng.randint(0, CAP, (2, F)).astype(np.int64)}
+               for _ in range(3)]
+    table = ShardedTable.build_in_process(
+        "tb", RangeSpec.even(V, 3), full_rows=packed)
+    try:
+        cfg = inference.Config(str(tmp_path / "ps"))
+        # placeholder cache table is zeros → pin the real table's scale
+        cfg.enable_int8(samples, accuracy_budget=10.0,
+                        table_scales={"tb": table_scale})
+        base = inference.create_predictor(cfg)
+        ops = [op.type for op in base._program.global_block().ops]
+        assert "quantized_lookup_table" in ops
+        ps = inference.PsLookupPredictor(
+            base, [inference.PsLookupBinding("tb", table, ["ids"])],
+            cache_rows_per_table=32)
+        q = ps._quant["tb"]
+        cache = ps._caches["tb"]
+        assert str(cache.dtype) == "int8"
+
+        # int8 PS serving tracks the fp32 local-table reference closely
+        ref = inference.create_predictor(
+            inference.Config(str(tmp_path / "local")))
+        ids = rng.randint(0, V, (2, F)).astype(np.int64)
+        o_ref = np.asarray(ref.run({"ids": ids})[0])
+        o_ps = np.asarray(ps.run({"ids": ids})[0])
+        assert float(np.abs(o_ref - o_ps).max()) < 0.05
+
+        # delta push: fresh training bytes arrive as packed u16
+        touched = np.unique(ids.reshape(-1))
+        nvis = np.random.RandomState(9).uniform(
+            -1, 1, (touched.size, D)).astype("float32")
+        nrows = np.zeros((touched.size, D * MULT), "float32")
+        nrows[:, :D] = nvis
+        new_packed = np.asarray(pack_rows(jnp.asarray(nrows)))
+        assert ps.apply_delta("tb", touched, new_packed) == touched.size
+
+        got, miss = cache.lookup(touched)
+        assert not miss.any()
+        want = requantize_packed_rows(new_packed, q["dt"], q["scale"])
+        np.testing.assert_array_equal(got, want)
+        # raw u16 truncation would look nothing like the requantized rows
+        raw = new_packed[:, :q["dt"]].astype(np.int8)
+        assert not np.array_equal(got, raw)
+
+        # and the served output reflects the new rows through dequant
+        o2 = np.asarray(ps.run({"ids": ids})[0])
+        assert float(np.abs(o2 - o_ps).max()) > 1e-6
+    finally:
+        table.close()
+
+
+# -- multi-tenant co-hosting ----------------------------------------------
+
+def _two_model_registry(tmp_path):
+    from paddle_tpu.serving import fleet
+
+    reg = fleet.ModelRegistry()
+    reg.register("v1", _save_mlp(str(tmp_path / "v1"), seed=1))
+    reg.register("v2", _save_mlp(str(tmp_path / "v2"), seed=2))
+    return reg
+
+
+def test_multi_tenant_fleet_routing_and_slo(tmp_path):
+    """Tentpole (c): N=3 tenants co-hosted on one fleet — weighted
+    replica partitions, per-tenant routing to the right model version,
+    per-tenant p99 within the declared SLO under mixed load."""
+    from paddle_tpu import inference
+    from paddle_tpu.serving import fleet
+
+    reg = _two_model_registry(tmp_path)
+    ref1 = inference.create_predictor(
+        inference.Config(reg.resolve("v1").model_dir))
+    ref2 = inference.create_predictor(
+        inference.Config(reg.resolve("v2").model_dir))
+    tenants = {
+        "ads": {"version": "v1", "weight": 2.0, "slo_p99_ms": 5000.0},
+        "feed": {"version": "v2", "weight": 1.0, "slo_p99_ms": 5000.0},
+        "search": {"version": "v1", "weight": 1.0, "slo_p99_ms": 5000.0},
+    }
+    fl = fleet.ServingFleet(
+        reg, replicas=4, buckets=(1, 2, 4),
+        server_kwargs={"max_batch_delay_ms": 1.0},
+        health_interval_s=0.1, tenants=tenants)
+    with fl:
+        # weighted partition: 2/1/1, every replica tenant-tagged
+        by_tenant = {}
+        for r in fl.replicas:
+            by_tenant.setdefault(r.tenant, []).append(r.version)
+        assert sorted(len(v) for v in by_tenant.values()) == [1, 1, 2]
+        assert set(by_tenant) == set(tenants)
+        assert set(by_tenant["feed"]) == {"v2"}
+
+        rng = np.random.RandomState(0)
+        feeds = [rng.randn(2, IN_DIM).astype(np.float32)
+                 for _ in range(6)]
+        for x in feeds:
+            o_ads = fl.infer({"x": x}, tenant="ads")[0]
+            o_feed = fl.infer({"x": x}, tenant="feed")[0]
+            np.testing.assert_array_equal(
+                np.asarray(o_ads), np.asarray(ref1.run({"x": x})[0]))
+            np.testing.assert_array_equal(
+                np.asarray(o_feed), np.asarray(ref2.run({"x": x})[0]))
+            fl.infer({"x": x}, tenant="search")
+
+        stats = fl.tenant_stats()
+        assert set(stats) == set(tenants)
+        for name, st in stats.items():
+            assert st["requests"] >= 6, (name, st)
+            assert st["p99_ms"] is not None
+            assert st["slo_ok"] is True, (name, st)
+        # weighted admission shares: ads (w=2) gets double the share
+        assert stats["ads"]["share"] == 2 * stats["feed"]["share"]
+
+        with pytest.raises(ValueError, match="unknown tenant"):
+            fl.infer({"x": feeds[0]}, tenant="video")
+
+
+def test_tenant_throttling_and_isolation(tmp_path):
+    """A tenant at its admission share is throttled at the door
+    (TenantThrottledError) without consuming another tenant's
+    capacity."""
+    from paddle_tpu.serving import fleet
+    from paddle_tpu.serving.fleet import TenantThrottledError
+
+    reg = _two_model_registry(tmp_path)
+    fl = fleet.ServingFleet(
+        reg, replicas=2, buckets=(1, 2, 4),
+        server_kwargs={"max_batch_delay_ms": 1.0},
+        health_interval_s=0.1,
+        tenants={"a": {"version": "v1", "weight": 1.0},
+                 "b": {"version": "v1", "weight": 1.0}},
+        tenant_capacity=2)  # 1 in-flight slot per tenant
+    x = np.zeros((1, IN_DIM), np.float32)
+    with fl:
+        fl.infer({"x": x}, tenant="a")  # warm
+        # hold tenant a's only slot open by faking an in-flight request
+        fl.router._tenant_out["a"] = 1
+        with pytest.raises(TenantThrottledError):
+            fl.submit({"x": x}, tenant="a")
+        assert fl.tenant_stats()["a"]["throttled"] == 1
+        # tenant b is unaffected by a's saturation
+        assert np.asarray(fl.infer({"x": x}, tenant="b")[0]).shape == (
+            1, CLASSES)
+        fl.router._tenant_out["a"] = 0
+        fl.infer({"x": x}, tenant="a")  # a recovers once slots free
+
+
+def test_tenant_rollout_swaps_only_that_partition(tmp_path):
+    from paddle_tpu.serving import fleet
+
+    reg = _two_model_registry(tmp_path)
+    fl = fleet.ServingFleet(
+        reg, replicas=2, buckets=(1, 2, 4),
+        server_kwargs={"max_batch_delay_ms": 1.0},
+        health_interval_s=0.1,
+        tenants={"a": {"version": "v1", "weight": 1.0},
+                 "b": {"version": "v1", "weight": 1.0}})
+    with fl:
+        report = fl.rollout("v2", tenant="a")
+        assert all(name.startswith("a/")
+                   for name in report["replicas"]), report
+        versions = {r.tenant: r.version for r in fl.replicas}
+        assert versions == {"a": "v2", "b": "v1"}

@@ -1,0 +1,212 @@
+"""Pass-pipeline neutrality contracts on the seed models.
+
+Every pass in ``ir/passes.py`` declares a neutrality contract
+(``bitwise`` / ``precision`` / ``annotation`` — see ir/pass_base.py);
+this suite *proves* the bitwise ones on real forward programs — bert,
+resnet, deepfm and transformer-NMT — by running each program before and
+after optimization in the SAME scope and comparing output bits, the
+ir-pass analog of the reference's per-pass tester pairs
+(fc_fuse_pass_tester.cc etc., which assert op sets but only allclose
+numerics; the TPU backend's deterministic executor lets us demand
+equality).
+
+conv_bn_fuse_pass declares ``precision`` (folding γ/√(σ²+ε) into conv
+weights re-rounds them) but is a structural no-op without a scope, so
+the full default pipeline stays bitwise in these tests — asserted, not
+assumed.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run_bits(exe, program, feed, fetch_name):
+    (out,) = exe.run(program, feed=feed, fetch_list=[fetch_name])
+    return np.asarray(out)
+
+
+def _assert_pipeline_bitwise(main, startup, feed, fetch_name,
+                             prune_feeds=None):
+    """Run fp32 reference vs default-inference-pipeline-optimized clone
+    on identical weights; bits must match. Returns the pass report.
+    ``prune_feeds`` strips training ops (autodiff/optimizer) first — a
+    program that updates weights per run can't be compared across
+    runs."""
+    from paddle_tpu.inference import Config
+    from paddle_tpu.ir import PassPipeline
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        if prune_feeds is not None:
+            fwd = main._prune_for_inference(prune_feeds, [fetch_name])
+        else:
+            fwd = main.clone(for_test=True)
+        ref = _run_bits(exe, fwd, feed, fetch_name)
+        opt = fwd.clone(for_test=True)
+        PassPipeline(Config().pass_builder(), record=False).run(
+            opt, keep=[fetch_name], fetch_names=[fetch_name])
+        got = _run_bits(exe, opt, feed, fetch_name)
+    np.testing.assert_array_equal(ref, got)
+    report = opt._pass_report
+    assert [r["pass"] for r in report["passes"]], "pipeline ran no passes"
+    for rec in report["passes"]:
+        assert rec["neutrality"] in ("bitwise", "precision", "annotation")
+    return report
+
+
+def test_bert_forward_pipeline_bitwise():
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                          num_heads=2, ffn_size=32, max_position=16,
+                          hidden_dropout=0.1, attn_dropout=0.1)
+    main, startup, feeds, loss = bert.build_pretrain_program(
+        cfg, 2, 8, optimizer_factory=None, is_test=True)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(0, 64, (2, 8)).astype("int64"),
+        "pos_ids": np.tile(np.arange(8), (2, 1)).astype("int64"),
+        "sent_ids": np.zeros((2, 8), "int64"),
+        "input_mask": np.ones((2, 8), "float32"),
+        "mlm_labels": rng.randint(0, 64, (2, 8, 1)).astype("int64"),
+    }
+    report = _assert_pipeline_bitwise(main, startup, feed, loss.name)
+    # bert has live dropout ops at build time; the delete pass must act
+    deleted = {r["pass"]: r for r in report["passes"]}
+    assert "delete_dropout_op_pass" in deleted
+
+
+def test_resnet_forward_pipeline_bitwise():
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 32, 32])
+        out = resnet.resnet(img, depth=50, num_classes=10, is_test=True)
+    feed = {"img": np.random.RandomState(1)
+            .randn(2, 3, 32, 32).astype("float32") * 0.1}
+    _assert_pipeline_bitwise(main, startup, feed, out.name)
+
+
+def test_deepfm_forward_pipeline_bitwise():
+    from paddle_tpu.models import deepfm
+
+    main, startup, feeds, loss, prob = deepfm.build_train_program(
+        vocab_size=64, num_fields=4, num_dense=4, embed_dim=8,
+        hidden_sizes=(16, 8))
+    rng = np.random.RandomState(2)
+    feed = {
+        "sparse_ids": rng.randint(0, 64, (4, 4)).astype("int64"),
+        "dense": rng.randn(4, 4).astype("float32"),
+    }
+    _assert_pipeline_bitwise(main, startup, feed, prob.name,
+                             prune_feeds=["sparse_ids", "dense"])
+
+
+def test_nmt_forward_pipeline_bitwise():
+    from paddle_tpu.models import transformer_nmt as nmt
+
+    cfg = nmt.TransformerConfig(src_vocab=32, tgt_vocab=32, d_model=16,
+                                n_heads=2, d_ff=32, n_enc=1, n_dec=1,
+                                dropout=0.1, max_len=16)
+    main, startup, feeds, loss = nmt.build_train_program(
+        cfg, src_len=8, tgt_len=8, is_test=True)
+    rng = np.random.RandomState(3)
+    causal = np.triu(np.full((8, 8), -1e4, np.float32), 1)[None, None]
+    feed = {
+        "src_ids": rng.randint(1, 32, (2, 8)).astype("int64"),
+        "tgt_ids": rng.randint(1, 32, (2, 8)).astype("int64"),
+        "lbl_ids": rng.randint(1, 32, (2, 8, 1)).astype("int64"),
+        "src_mask": np.zeros((2, 1, 1, 8), "float32"),
+        "tgt_mask": np.broadcast_to(causal, (2, 1, 8, 8)).copy(),
+    }
+    _assert_pipeline_bitwise(
+        main, startup, feed, loss.name,
+        prune_feeds=["src_ids", "tgt_ids", "lbl_ids", "src_mask",
+                     "tgt_mask"])
+
+
+def test_each_bitwise_pass_individually_neutral():
+    """Apply every registered bitwise-contract pass ALONE to an
+    mlp+embedding+dropout program — each one must preserve output bits
+    by itself, not just inside the pipeline ordering."""
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [3], dtype="int64")
+        x = fluid.layers.data("x", [8])
+        emb = fluid.layers.embedding(ids, size=[32, 8])
+        e = fluid.layers.reshape(emb, [-1, 24])
+        h = fluid.layers.concat([e, x], axis=1)
+        h = fluid.layers.fc(h, 16, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        dead = fluid.layers.fc(h, 5)  # noqa: F841 — never fetched
+        out = fluid.layers.fc(h, 4, act="softmax")
+    rng = np.random.RandomState(4)
+    feed = {"ids": rng.randint(0, 32, (2, 3)).astype("int64"),
+            "x": rng.randn(2, 8).astype("float32")}
+
+    bitwise = [n for n in ir.registered_passes()
+               if getattr(ir.get_pass(n), "neutrality", "bitwise")
+               == "bitwise"]
+    assert {"fc_fuse_pass", "constant_folding_pass",
+            "dead_code_elimination_pass", "dead_var_elimination_pass",
+            "fuse_elewise_add_act_pass",
+            "delete_dropout_op_pass"} <= set(bitwise)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fwd = main.clone(for_test=True)
+        ref = _run_bits(exe, fwd, feed, out.name)
+        for name in bitwise:
+            opt = fwd.clone(for_test=True)
+            ir.apply_pass(opt, name, keep=[out.name],
+                          fetch_names=[out.name])
+            got = _run_bits(exe, opt, feed, out.name)
+            np.testing.assert_array_equal(
+                ref, got, err_msg=f"{name} broke bitwise neutrality")
+
+
+def test_dead_var_elimination_prunes_unreferenced_vars():
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        kept = fluid.layers.fc(x, 4)
+        dead = fluid.layers.fc(x, 9)  # noqa: F841
+    blk = main.global_block()
+    ir.apply_pass(main, "dead_code_elimination_pass", keep=[kept.name])
+    n_vars = len(blk.vars)
+    ir.apply_pass(main, "dead_var_elimination_pass", keep=[kept.name])
+    assert len(blk.vars) < n_vars
+    # data vars and everything the surviving ops touch stay
+    assert "x" in blk.vars and kept.name in blk.vars
+    live = {n for op in blk.ops for n in op.input_names()} | \
+           {n for op in blk.ops for n in op.output_names()}
+    assert live <= set(blk.vars)
+
+
+def test_layout_assignment_annotates_tpu_tiling():
+    """layout_assignment_pass computes (8,128)-tile padding waste and
+    matmul alignment without touching any op — pure annotation."""
+    from paddle_tpu import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [17])  # deliberately lane-misaligned
+        out = fluid.layers.fc(x, 3)
+    ops_before = [op.type for op in main.global_block().ops]
+    ir.apply_pass(main, "layout_assignment_pass", keep=[out.name])
+    assert [op.type for op in main.global_block().ops] == ops_before
+    plan = main._layout_plan
+    assert plan["padded_bytes"] >= plan["natural_bytes"] > 0
+    assert 0.0 < plan["waste_fraction"] < 1.0
+    assert plan["matmul_ops"], "fc matmul should be recorded"
+    rec = plan["matmul_ops"][0]
+    assert rec["k"] == 17 and not rec["k_aligned"]
+    assert rec["n"] == 3 and not rec["n_aligned"]
