@@ -1953,6 +1953,336 @@ def bench_online_learning(on_tpu):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_slo_alerting(on_tpu):
+    """SLO engine chaos cell (ISSUE 17): a small online-learning stack
+    over REAL subprocess pservers — training pushes through the tier,
+    a DeltaPublisher streams rows to a PsLookupPredictor, a ShardMonitor
+    and FederatedScraper feed an SloEngine + AlertManager — then one
+    pserver is SIGKILLed under load. Asserted end to end: the
+    availability (``PsShardAvailability``) and staleness
+    (``DeltaStaleness``) page alerts reach ``firing`` within two scrape
+    sweeps of their condition first being observable, auto-``resolve``
+    after the shard restarts and the tier recovers, and the
+    alert-triggered flight dump names the dead shard."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers
+    from paddle_tpu.initializer import RowPackInitializer
+    from paddle_tpu.observability import (AlertManager, FederatedScraper,
+                                          ScrapeTarget, SloEngine, SloSpec,
+                                          get_registry,
+                                          install_alert_manager,
+                                          install_scraper)
+    from paddle_tpu.param_attr import ParamAttr
+    from paddle_tpu.parallel.checkpoint import Checkpointer
+    from paddle_tpu.ps import (PsEmbeddingTier, PsTableBinding, RangeSpec,
+                               ShardedTable, ShardMonitor, SocketClient)
+    from paddle_tpu.streaming import DeltaPublisher
+
+    vocab, batch = (16_384, 256) if on_tpu else (4_000, 32)
+    fields, d, mult = 8, 8, 2
+    lanes = d * mult
+    staleness_budget_ms = 1200.0
+    sweep_s = 0.25          # scraper cadence
+    dead_s = 1.6            # outage long enough to blow the budget
+    # page windows compress to 5 s / ~0.42 s: a hard outage saturates
+    # both within one bad sweep, exactly the multiwindow design intent
+    window_scale = 1.0 / 720.0
+
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "ps_server_runner.py")
+    spec = RangeSpec.even(vocab, 2)
+
+    def launch(i, port=0):
+        lo, hi = spec.bounds(i)
+        p = subprocess.Popen(
+            [sys.executable, runner, "--port", str(port),
+             "--table", f"slo_t:{lo}:{hi}"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        ep = p.stdout.readline().strip()
+        if not ep:
+            raise RuntimeError("pserver runner died at boot")
+        return p, ep
+
+    # generous retry budget: the worker must survive a ~2 s outage
+    # inside one push, then recover via the checkpoint+journal hook
+    knobs = {"PDTPU_PS_RETRIES": "400", "PDTPU_PS_RETRY_BACKOFF_MS": "20",
+             "PDTPU_PS_TIMEOUT": "10"}
+    saved_env = {k: os.environ.get(k) for k in
+                 list(knobs) + ["PDTPU_FLIGHT_DIR"]}
+    workdir = tempfile.mkdtemp(prefix="pdtpu_bench_slo_")
+    os.environ.update(knobs)
+    os.environ["PDTPU_FLIGHT_DIR"] = os.path.join(workdir, "flight")
+
+    def build(train):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", [fields], dtype="int64")
+            emb = layers.embedding(
+                ids, [batch * fields, lanes], is_sparse=True,
+                row_pack=True,
+                param_attr=ParamAttr(name="slo_t",
+                                     initializer=RowPackInitializer(
+                                         d, lanes, -0.01, 0.01)))
+            emb = layers.slice(emb, axes=[2], starts=[0], ends=[d])
+            score = layers.reshape(layers.reduce_sum(emb, dim=[1, 2]),
+                                   [-1, 1])
+            if not train:
+                return main, startup, score
+            lbl = layers.data("lbl", [1], dtype="float32")
+            loss = layers.mean(layers.square_error_cost(score, lbl))
+            fluid.optimizer.Adagrad(
+                0.1, packed_rows={
+                    "rows_per_step": batch * fields}).minimize(loss)
+        return main, startup, loss
+
+    reg = get_registry()
+    procs, eps = [], []
+    monitor = scraper = pub = tier = None
+    stop_evt = threading.Event()
+    train_err = []
+    try:
+        for i in range(2):
+            p, ep = launch(i)
+            procs.append(p)
+            eps.append(ep)
+        table = ShardedTable("slo_t", spec,
+                             [SocketClient(ep) for ep in eps])
+
+        # serving half
+        imain, istart, iscore = build(train=False)
+        iexe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            iexe.run(istart)
+            fluid.io.save_inference_model(
+                os.path.join(workdir, "m"), ["ids"], [iscore], iexe, imain)
+        base = inference.create_predictor(
+            inference.Config(os.path.join(workdir, "m")))
+        ps = inference.PsLookupPredictor(
+            base, [inference.PsLookupBinding("slo_t", table, ["ids"])],
+            cache_rows_per_table=batch * fields)
+        pub = DeltaPublisher(table, staleness_s=0.4)
+        pub.attach_predictor(ps)
+
+        # judgment layer: monitor -> scraper -> SLO engine -> alerts
+        monitor = ShardMonitor(eps, interval_s=0.1).start()
+        am = AlertManager(for_s=0.0, resolved_hold_s=600.0)
+        install_alert_manager(am)
+        events = []          # (wall_t, sweep_no, event) timeline
+        sweeps = [0]
+        first_bad = {}       # alert name -> sweep_no condition observable
+        am.add_sink(lambda ev: events.append(
+            (time.time(), sweeps[0], ev)))
+        scraper = FederatedScraper(
+            [ScrapeTarget.local()]
+            + [ScrapeTarget.ps(ep, shard=i) for i, ep in enumerate(eps)],
+            interval_s=sweep_s, timeout=0.5)
+
+        def count_sweep(doc):
+            sweeps[0] += 1
+            for r in doc["targets"]:
+                for s in r["series"]:
+                    if (s.get("name") == "ps/shard_up"
+                            and not s.get("value")
+                            and "PsShardAvailability" not in first_bad):
+                        first_bad["PsShardAvailability"] = sweeps[0]
+                    if (s.get("name") == "staleness/last_visible_ts"
+                            and s.get("value")
+                            and (time.time() - s["value"]) * 1e3
+                            > staleness_budget_ms
+                            and "DeltaStaleness" not in first_bad):
+                        first_bad["DeltaStaleness"] = sweeps[0]
+
+        scraper.add_sweep_listener(count_sweep)
+        engine = SloEngine(
+            [SloSpec.floor("PsShardAvailability", "ps/shard_up", 1.0,
+                           group_by="shard", objective=0.999),
+             SloSpec.freshness("DeltaStaleness",
+                               "staleness/last_visible_ts",
+                               staleness_budget_ms, group_by="table",
+                               objective=0.999)],
+            alert_manager=am, window_scale=window_scale)
+        engine.attach(scraper)
+        install_scraper(scraper)
+
+        # training load
+        main, startup, loss = build(train=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        rng = np.random.RandomState(23)
+        with fluid.scope_guard(sc):
+            exe.run(startup)
+            ck = Checkpointer(os.path.join(workdir, "ck"))
+            ck.save(0, program=main, scope=sc, blocking=True,
+                    ps_tables={"slo_t": table})
+            tier = PsEmbeddingTier(
+                main, [PsTableBinding("slo_t", table, ["ids"])],
+                pull_ahead=1, push_depth=0)
+            tier.attach_checkpointer(ck)
+
+            def feed_gen():
+                while not stop_evt.is_set():
+                    yield {"ids": rng.randint(
+                               0, vocab, (batch, fields)).astype("int64"),
+                           "lbl": rng.randint(
+                               0, 2, (batch, 1)).astype("float32")}
+
+            def train_loop():
+                try:
+                    for prep in tier.steps(lambda: feed_gen()):
+                        tier.run_step(exe, prep, fetch_list=[loss])
+                        if stop_evt.is_set():
+                            break
+                        time.sleep(0.03)
+                except Exception as e:  # surfaced in the result doc
+                    train_err.append(f"{type(e).__name__}: {e}")
+
+            def serve_loop():
+                while not stop_evt.is_set():
+                    try:
+                        ps.run({"ids": rng.randint(
+                            0, vocab, (8, fields)).astype("int64")})
+                    except Exception:
+                        pass  # outage window: serving pulls block/fail
+                    time.sleep(0.1)
+
+            tthread = threading.Thread(target=train_loop, daemon=True)
+            sthread = threading.Thread(target=serve_loop, daemon=True)
+            tthread.start()
+            sthread.start()
+            scraper.start()
+
+            time.sleep(2.0)                     # healthy baseline
+            kill_t = time.time()
+            kill_sweep = sweeps[0]
+            procs[1].kill()
+            procs[1].wait()
+            port1 = int(eps[1].rsplit(":", 1)[1])
+            time.sleep(dead_s)                  # the outage window
+            procs[1], _ = launch(1, port=port1)
+
+            # recovery + resolution tail: wait for both pages to clear
+            deadline = time.time() + 20.0
+            while time.time() < deadline:
+                if not am.firing(severity="page"):
+                    break
+                time.sleep(0.25)
+            time.sleep(3.0)  # let warn-severity windows drain too
+
+            stop_evt.set()
+            tthread.join(timeout=30.0)
+            sthread.join(timeout=10.0)
+            scraper.stop()
+            tier.flush()
+            tier.close()
+            tier = None
+            pub.close()
+            pub = None
+
+        # ------------------------------------------------ the assertions
+        def fired(name):
+            return [(t, sw, ev) for t, sw, ev in events
+                    if ev["event"] == "firing" and ev["name"] == name
+                    and ev["severity"] == "page" and t >= kill_t]
+
+        avail = fired("PsShardAvailability")
+        stale = fired("DeltaStaleness")
+        assert avail, f"availability page never fired; events={events}"
+        assert stale, f"staleness page never fired; events={events}"
+        assert avail[0][2]["labels"].get("shard") == "1", avail[0][2]
+        avail_sweeps = avail[0][1] - first_bad["PsShardAvailability"]
+        stale_sweeps = stale[0][1] - first_bad["DeltaStaleness"]
+        assert avail_sweeps <= 2, (
+            f"availability took {avail_sweeps} sweeps past first bad "
+            f"scrape (kill@{kill_sweep}, bad@{first_bad}, "
+            f"fire@{avail[0][1]})")
+        assert stale_sweeps <= 2, (
+            f"staleness took {stale_sweeps} sweeps past first bad "
+            f"scrape (bad@{first_bad}, fire@{stale[0][1]})")
+        still_firing = [a.name for a in am.firing()]
+        assert not still_firing, (
+            f"alerts still firing after recovery: {still_firing}")
+        page_states = {(a.name): a.state
+                       for a in am.alerts(severity="page")}
+        assert page_states.get("PsShardAvailability") == "resolved", (
+            page_states)
+
+        # the page's flight dump names the dead shard
+        dump_path = avail[0][2].get("dump_path")
+        assert dump_path and os.path.exists(dump_path), avail[0][2]
+        with open(dump_path) as f:
+            dump = json.load(f)
+        assert dump["context"]["alert"] == "PsShardAvailability", (
+            dump["context"])
+        assert dump["context"]["labels"].get("shard") == "1", (
+            dump["context"])
+
+        # e2e staleness audit populated (publisher stamp -> serving
+        # visibility), and the resolve round-trip timing
+        e2e = ps.staleness_e2e_percentiles()
+        assert e2e["p50"] is not None, "staleness/e2e_ms never populated"
+        resolve_ev = [(t, sw, ev) for t, sw, ev in events
+                      if ev["event"] == "resolved"
+                      and ev["name"] == "PsShardAvailability"
+                      and ev["severity"] == "page"]
+        return {
+            "vocab": vocab, "batch": batch,
+            "sweep_s": sweep_s, "window_scale": window_scale,
+            "outage_s": dead_s,
+            "staleness_budget_ms": staleness_budget_ms,
+            "avail_fire_sweeps_past_bad": int(avail_sweeps),
+            "stale_fire_sweeps_past_bad": int(stale_sweeps),
+            "avail_fire_after_kill_ms": round(
+                (avail[0][0] - kill_t) * 1e3, 1),
+            "stale_fire_after_kill_ms": round(
+                (stale[0][0] - kill_t) * 1e3, 1),
+            "page_resolved_after_kill_ms": (round(
+                (resolve_ev[0][0] - kill_t) * 1e3, 1)
+                if resolve_ev else None),
+            "total_alert_events": len(events),
+            "staleness_e2e_ms": e2e,
+            "flight_dump_names_shard": dump["context"]["labels"]["shard"],
+            "train_error": train_err[0] if train_err else None,
+            "recoveries": int(reg.counter("ps/recoveries").value),
+        }
+    finally:
+        stop_evt.set()
+        try:
+            if scraper is not None:
+                scraper.stop()
+        except Exception:
+            pass
+        install_scraper(None)
+        install_alert_manager(None)
+        if monitor is not None:
+            monitor.stop()
+        if tier is not None:
+            try:
+                tier.close()
+            except Exception:
+                pass
+        if pub is not None:
+            try:
+                pub.close()
+            except Exception:
+                pass
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(workdir, ignore_errors=True)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main(gate_against=None, recalibrate=False):
     import jax
 
@@ -2154,6 +2484,16 @@ def main(gate_against=None, recalibrate=False):
     except Exception as e:  # pragma: no cover
         extras2["online_learning"] = {"error": str(e)[:120]}
     _end_section(extras2, "online_learning")
+
+    # SLO engine chaos cell (ISSUE 17): SIGKILL a pserver under a live
+    # train+serve stack — availability + staleness pages must fire
+    # within two sweeps, resolve after recovery, and the alert-triggered
+    # flight dump must name the dead shard
+    try:
+        extras2["slo_alerting"] = bench_slo_alerting(on_tpu)
+    except Exception as e:  # pragma: no cover
+        extras2["slo_alerting"] = {"error": str(e)[:120]}
+    _end_section(extras2, "slo_alerting")
 
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms

@@ -31,8 +31,10 @@ publishes a new checkpoint).
 """
 from __future__ import annotations
 
+import collections
 import os
 import threading
+import time
 
 from typing import Dict, List, Optional, Sequence
 
@@ -165,6 +167,9 @@ class PsLookupPredictor:
                 os.environ.get("PDTPU_PS_SERVE_CACHE_ROWS", "65536"))
         self._shapes: Dict[str, tuple] = {}
         self._caches: Dict[str, RowCache] = {}
+        # staleness auditor: recent train→serve e2e samples (ms)
+        self._e2e_samples: "collections.deque" = collections.deque(
+            maxlen=4096)
         # quantized resident tables: binding param → {"param": renamed
         # int8 state param, "scale": per-table abs-max, "dt": f32 row dim}
         self._quant: Dict[str, dict] = {}
@@ -303,7 +308,7 @@ class PsLookupPredictor:
             return self._pred.run_padded(feed2, batch_size)
 
     def apply_delta(self, table_name: str, uids: np.ndarray,
-                    rows: np.ndarray) -> int:
+                    rows: np.ndarray, meta: Optional[dict] = None) -> int:
         """Online-learning delta push: overwrite the cached copies of
         `uids` with freshly-trained `rows` for every binding backed by
         `table_name`. Resident rows are refreshed in place; absent rows
@@ -316,7 +321,17 @@ class PsLookupPredictor:
         u16 wire format regardless of serving precision, so they are
         re-quantized here with the table's stored scale before touching
         the int8 cache — raw u16 bytes must never land in an int8
-        table."""
+        table.
+
+        Staleness auditor (`meta` — what a meta-aware `DeltaPublisher`
+        subscription passes): ``meta["enqueue_t"]`` carries each row's
+        trainer-side push time, so this end of the pipe can record the
+        TRUE train→serve latency — push to visible-in-serving-cache —
+        into ``staleness/e2e_ms{table=}``, and stamp the freshness clock
+        ``staleness/last_visible_ts{table=}`` (unix time) whose *age* is
+        what the ``DeltaStaleness`` SLO alerts on: when delta flow
+        stalls, no histogram samples arrive at all, but the clock keeps
+        aging."""
         uids = np.asarray(uids, np.int64)
         rows = np.asarray(rows, np.uint16)
         n = 0
@@ -331,7 +346,38 @@ class PsLookupPredictor:
                 else:
                     r = rows
                 n += self._caches[b.param].update(uids, r)
+        if meta is not None:
+            self._audit_visibility(table_name, meta)
         return n
+
+    def _audit_visibility(self, table_name: str, meta: dict) -> None:
+        """Record the serving end of the staleness audit for one delta
+        batch (outside the serve lock — observability must not extend
+        the request critical section)."""
+        from ..observability import get_registry
+        now = time.monotonic()
+        reg = get_registry()
+        enq = np.asarray(meta.get("enqueue_t", ()), np.float64)
+        if enq.size:
+            e2e_ms = (now - enq) * 1e3
+            h = reg.histogram("staleness/e2e_ms", table=table_name)
+            for v in e2e_ms.tolist():
+                h.observe(v)
+            self._e2e_samples.extend(e2e_ms.tolist())
+        reg.gauge("staleness/last_visible_ts", table=table_name).set(
+            time.time())
+
+    def staleness_e2e_percentiles(self) -> dict:
+        """{p50, p99, max} over recent end-to-end staleness samples (ms,
+        trainer push → visible in this replica's cache); all-None until
+        a meta-aware publisher subscription delivers a batch."""
+        s = list(self._e2e_samples)
+        if not s:
+            return {"p50": None, "p99": None, "max": None}
+        arr = np.asarray(s, np.float64)
+        return {"p50": float(np.percentile(arr, 50)),
+                "p99": float(np.percentile(arr, 99)),
+                "max": float(arr.max())}
 
     # -- introspection -------------------------------------------------------
     def invalidate(self) -> None:

@@ -42,6 +42,14 @@ capability along its natural seam:
   dispatch sites dump a post-mortem (steps, registry snapshot, device
   memory, compiled signatures, watchdog state) to ``PDTPU_FLIGHT_DIR``
   before re-raising.
+- **SloEngine / AlertManager** (slo.py / alerts.py) — the judgment
+  layer over the sensor plane: declarative `SloSpec`s compiled into
+  recording rules evaluated on every `FederatedScraper` sweep, the
+  standard multi-window multi-burn-rate page/warn formulation, a
+  pending→firing→resolved alert state machine publishing
+  ``ALERTS{alertname,severity,alertstate}``, pluggable sinks (file /
+  webhook / callback — the autoscaler hook), an ``/alerts`` endpoint,
+  an ``alerts`` health check, and alert-triggered flight dumps.
 
 Quick start::
 
@@ -58,6 +66,9 @@ from . import calibrate  # noqa: F401
 from . import context  # noqa: F401
 from . import federate  # noqa: F401
 from . import perf  # noqa: F401
+from .alerts import (Alert, AlertFiringError, AlertManager,  # noqa: F401
+                     FileSink, WebhookSink, get_alert_manager,
+                     install_alert_manager)
 from .calibrate import Calibration, get_calibration  # noqa: F401
 from .context import TraceContext  # noqa: F401
 from .federate import (FederatedScraper, ScrapeTarget,  # noqa: F401
@@ -74,6 +85,8 @@ from .memory import (device_memory_stats,  # noqa: F401
 from .perf import CostLedger, ProgramCost, attribute, get_ledger  # noqa: F401
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
                        get_registry, render_prometheus)
+from .slo import (BURN_RATE_WINDOWS, SloEngine, SloSpec,  # noqa: F401
+                  default_slos)
 from .steps import StepProfiler, get_step_profiler  # noqa: F401
 from .tracer import (Tracer, get_tracer, server_span,  # noqa: F401
                      start_trace, trace_span)
@@ -97,4 +110,7 @@ __all__ = [
     "IntrospectionServer", "serve_introspection", "stop_introspection",
     "maybe_serve_from_env", "register_health_check",
     "unregister_health_check", "run_health_checks",
+    "SloSpec", "SloEngine", "default_slos", "BURN_RATE_WINDOWS",
+    "Alert", "AlertManager", "AlertFiringError", "FileSink",
+    "WebhookSink", "install_alert_manager", "get_alert_manager",
 ]

@@ -46,10 +46,24 @@ import urllib.request
 
 from typing import Callable, List, Optional
 
-from .registry import get_registry, render_prometheus
+from .registry import Registry, get_registry, render_prometheus
 
 __all__ = ["ScrapeTarget", "FederatedScraper", "install_scraper",
            "get_scraper"]
+
+Registry.describe("autoscale/ps_pull_p99_ms",
+                  "worst per-shard PS pull p99 seen across the fleet")
+Registry.describe("autoscale/queue_depth",
+                  "serving queue depth per process")
+Registry.describe("autoscale/stragglers",
+                  "step anomaly count summed across the fleet")
+Registry.describe("autoscale/recoveries",
+                  "PS shard recovery count summed across the fleet")
+Registry.describe("autoscale/shards_down",
+                  "PS shards currently reporting down")
+Registry.describe("autoscale/targets_unreachable",
+                  "scrape targets that failed this sweep")
+Registry.describe("fleet/scrape_ms", "federated sweep duration")
 
 
 def _series_from_snapshot(snap: dict) -> List[dict]:
@@ -164,13 +178,37 @@ class FederatedScraper:
         self._last: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._listeners: List[Callable[[dict], None]] = []
+        # label sets published into autoscale/* on the previous sweep,
+        # so _signals can retire gauges whose source target vanished
+        self._prev_pull_shards: set = set()
+        self._prev_queue_procs: set = set()
         reg = get_registry()
         self._h_scrape = reg.histogram("fleet/scrape_ms")
         self._c_failed = reg.counter("fleet/scrape_failures")
 
     def add_target(self, target: ScrapeTarget) -> ScrapeTarget:
-        self.targets.append(target)
+        """Add a target; a target with the SAME name replaces the old
+        one (re-adding a bounced worker must not double-count it)."""
+        with self._lock:
+            self.targets = ([t for t in self.targets
+                             if t.name != target.name] + [target])
         return target
+
+    def remove_target(self, name: str) -> bool:
+        """Drop the target named `name`; its derived ``autoscale/*``
+        gauges retire on the next sweep. Returns True if found."""
+        with self._lock:
+            before = len(self.targets)
+            self.targets = [t for t in self.targets if t.name != name]
+            return len(self.targets) != before
+
+    def add_sweep_listener(self, fn: Callable[[dict], None]) -> Callable:
+        """Call ``fn(doc)`` with every completed sweep document — the
+        SLO engine's subscription point. Listener exceptions are
+        swallowed (an alerting bug must not kill the scrape loop)."""
+        self._listeners.append(fn)
+        return fn
 
     # ------------------------------------------------------------- scraping
     def scrape_once(self) -> dict:
@@ -179,7 +217,9 @@ class FederatedScraper:
         never raised, so one dead worker can't take down the scrape."""
         t0 = time.perf_counter()
         results = []
-        for t in self.targets:
+        with self._lock:
+            targets = list(self.targets)
+        for t in targets:
             s0 = time.perf_counter()
             try:
                 series = t.scrape(self.timeout)
@@ -199,6 +239,12 @@ class FederatedScraper:
         self._h_scrape.observe((time.perf_counter() - t0) * 1e3)
         with self._lock:
             self._last = doc
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(doc)
+            except Exception:
+                pass  # a listener bug must not kill the scrape loop
         return doc
 
     def last(self) -> Optional[dict]:
@@ -259,6 +305,14 @@ class FederatedScraper:
                 elif name == "ps/shard_up":
                     if not s.get("value"):
                         shards_down += 1
+        # retire per-label gauges whose source vanished this sweep — a
+        # removed shard/process must not linger as a live-looking sample
+        for sh in self._prev_pull_shards - set(pull_p99):
+            reg.remove("autoscale/ps_pull_p99_ms", shard=sh)
+        for proc in self._prev_queue_procs - set(queue_depth):
+            reg.remove("autoscale/queue_depth", process=proc)
+        self._prev_pull_shards = set(pull_p99)
+        self._prev_queue_procs = set(queue_depth)
         for sh, v in pull_p99.items():
             reg.gauge("autoscale/ps_pull_p99_ms", shard=sh).set(v)
         for proc, v in queue_depth.items():

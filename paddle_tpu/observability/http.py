@@ -14,6 +14,8 @@ flight-recorder contents:
     GET /fleet           one federated scrape of every process (requires
                          an installed federate.FederatedScraper; 404
                          otherwise, 503 when any target is unreachable)
+    GET /alerts          live alert states (requires an installed
+                         alerts.AlertManager; 404 otherwise)
     GET /healthz         named health checks, ok/degraded/failing
                          aggregation (200 for ok/degraded, 503 for failing)
     GET /debug/steps     recent StepProfiler records (?n=50 to limit)
@@ -141,6 +143,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 else:
                     doc = scraper.scrape_once()
                     self._send_json(200 if doc["ok"] else 503, doc)
+            elif path == "/alerts":
+                from . import alerts  # deferred: alerts imports us
+                mgr = alerts.get_alert_manager()
+                if mgr is None:
+                    self._send(404, "no AlertManager installed "
+                                    "(observability.alerts."
+                                    "install_alert_manager)\n",
+                               "text/plain")
+                else:
+                    self._send_json(200, mgr.doc())
             elif path == "/healthz":
                 overall, detail = run_health_checks()
                 code = 200 if overall in ("ok", "degraded") else 503
@@ -160,7 +172,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             elif path == "/":
                 self._send(200, "paddle_tpu introspection: /metrics "
                                 "/metrics.json /metrics/series /fleet "
-                                "/healthz /debug/steps "
+                                "/alerts /healthz /debug/steps "
                                 "/debug/flight\n", "text/plain")
             else:
                 self._send(404, f"no such endpoint: {path}\n", "text/plain")

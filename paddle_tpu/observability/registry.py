@@ -38,6 +38,20 @@ def _label_key(labels: dict) -> tuple:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+# Module-level help-text store (`Registry.describe`): metric name ->
+# one-line doc, shared by every registry in the process so federated and
+# local exposition emit identical ``# HELP`` lines. Keyed on the RAW
+# name (pre-sanitization), matching how callers register metrics.
+_HELP: Dict[str, str] = {}
+_HELP_LOCK = threading.Lock()
+
+
+def _prom_help_text(text: str) -> str:
+    """HELP-line escaping per the exposition spec: backslash and
+    newline only (double quotes are legal in help text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _prom_metric_name(name: str) -> str:
     """Map to the exposition-spec metric-name charset
     ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (we also fold ``:`` to ``_`` — the
@@ -243,6 +257,47 @@ class Registry:
                 m = self._histograms[key] = Histogram(name, cap, labels)
             return m
 
+    # -- documentation -----------------------------------------------------
+    @staticmethod
+    def describe(name: str, help_text: str) -> None:
+        """Attach a one-line doc to a metric name; `render_prometheus`
+        emits it as a ``# HELP`` line (described series only). Process-
+        wide (module-level store), so it applies to every registry and
+        to federated re-rendering alike."""
+        with _HELP_LOCK:
+            _HELP[str(name)] = str(help_text)
+
+    @staticmethod
+    def help_for(name: str) -> Optional[str]:
+        with _HELP_LOCK:
+            return _HELP.get(str(name))
+
+    # -- removal -----------------------------------------------------------
+    def remove(self, name: str, **labels) -> bool:
+        """Drop the exact (name, labels) series from this registry, all
+        three kinds. Returns True if anything was removed. The federated
+        scraper uses this to retire ``autoscale/*`` gauges whose source
+        target vanished, so a removed shard's last reading doesn't
+        linger forever as a live-looking sample."""
+        key = (name, _label_key(labels))
+        removed = False
+        with self._lock:
+            for d in (self._counters, self._gauges, self._histograms):
+                if d.pop(key, None) is not None:
+                    removed = True
+        return removed
+
+    def remove_matching(self, name: str) -> int:
+        """Drop every series with metric name `name`, any label set.
+        Returns the number of series removed."""
+        n = 0
+        with self._lock:
+            for d in (self._counters, self._gauges, self._histograms):
+                for key in [k for k in d if k[0] == name]:
+                    del d[key]
+                    n += 1
+        return n
+
     # -- composition -------------------------------------------------------
     def attach(self, child: "Registry") -> "Registry":
         """Include `child`'s metrics in this registry's deep exports.
@@ -402,6 +457,10 @@ def render_prometheus(series: List[dict], extra_labels=()) -> str:
             pname = _prom_metric_name(name)
             if pname not in typed:
                 typed.add(pname)
+                help_text = Registry.help_for(name)
+                if help_text is not None:
+                    lines.append(
+                        f"# HELP {pname} {_prom_help_text(help_text)}")
                 lines.append(f"# TYPE {pname} {kind}")
             if kind == "summary":
                 summ = s.get("summary") or {}

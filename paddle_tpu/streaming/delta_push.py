@@ -28,7 +28,12 @@ row is visible to every subscriber within ``staleness_s`` plus one
 subscriber-callback time, env-tunable via ``PDTPU_STREAM_STALENESS_S``
 (seconds, default 2.0). Observed per-row staleness (flush time − push
 time) feeds the ``stream/staleness_ms`` histogram and the local p50/p99
-sample window the bench and soak assertions read.
+sample window the bench and soak assertions read. That number is the
+publisher's HALF of the story; meta-aware subscribers (``subscribe(fn,
+meta=True)``, which `attach_predictor` uses) additionally receive the
+per-row enqueue stamps and record their own visibility time, closing
+the TRUE train→serve audit as ``staleness/e2e_ms`` (push → visible in
+the serving cache) — the histogram the ``DeltaStaleness`` SLO reads.
 """
 from __future__ import annotations
 
@@ -58,7 +63,8 @@ class DeltaPublisher:
                 f"staleness_s must be > 0, got {staleness_s}")
         self.table = table
         self.staleness_s = float(staleness_s)
-        self._subs: List[Callable] = []
+        self._subs: List[tuple] = []  # (fn, wants_meta)
+        self._seq = 0
         # uid -> (row copy, enqueue time): last write wins, age is the
         # FIRST unflushed write's (the staleness bound is on the oldest
         # pending byte, not the newest)
@@ -94,14 +100,26 @@ class DeltaPublisher:
                                     prev[1] if prev is not None else now)
 
     # -- fan-out -------------------------------------------------------------
-    def subscribe(self, fn: Callable) -> None:
+    def subscribe(self, fn: Callable, meta: bool = False) -> None:
         """``fn(table_name, sorted_uids, rows)`` on every flush. Runs on
         the publisher thread — keep it bounded (a cache refresh, not a
-        network round-trip per row)."""
-        self._subs.append(fn)
+        network round-trip per row).
+
+        With ``meta=True`` the subscriber instead gets
+        ``fn(table_name, sorted_uids, rows, meta=meta_dict)`` where the
+        dict carries the staleness-auditor stamps: ``seq`` (flush
+        number), ``published_t`` (monotonic flush time) and
+        ``enqueue_t`` (float64 array aligned with `uids`: each row's
+        FIRST unflushed push time). A meta-aware consumer records its
+        own visibility time against these stamps, producing a true
+        train→serve end-to-end freshness histogram instead of the
+        publisher-half number `staleness_percentiles` sees."""
+        self._subs.append((fn, bool(meta)))
 
     def attach_predictor(self, predictor) -> None:
-        self.subscribe(predictor.apply_delta)
+        # meta-aware: the predictor stamps visibility per delta batch,
+        # closing the e2e staleness audit (staleness/e2e_ms)
+        self.subscribe(predictor.apply_delta, meta=True)
 
     def attach_hot_cache(self, hot_cache) -> None:
         self.subscribe(lambda name, uids, rows: hot_cache.drop_rows(uids))
@@ -116,14 +134,22 @@ class DeltaPublisher:
             pending, self._pending = self._pending, {}
         uids = np.asarray(sorted(pending), np.int64)
         rows = np.stack([pending[int(u)][0] for u in uids])
-        ages_ms = [(now - pending[int(u)][1]) * 1e3 for u in uids.tolist()]
+        enqueue_t = np.asarray([pending[int(u)][1] for u in uids.tolist()],
+                               np.float64)
+        ages_ms = ((now - enqueue_t) * 1e3).tolist()
         for a in ages_ms:
             self._h_staleness.observe(a)
         self.staleness_samples.extend(ages_ms)
         name = getattr(self.table, "name", "?")
-        for fn in list(self._subs):
+        self._seq += 1
+        meta = {"seq": self._seq, "published_t": now,
+                "enqueue_t": enqueue_t}
+        for fn, wants_meta in list(self._subs):
             try:
-                fn(name, uids, rows)
+                if wants_meta:
+                    fn(name, uids, rows, meta=meta)
+                else:
+                    fn(name, uids, rows)
             except Exception:
                 # one sick replica must not stall the stream (or lose the
                 # flush for its siblings); it re-converges on its next

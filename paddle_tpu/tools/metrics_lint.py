@@ -1,0 +1,123 @@
+"""Static lint for metric names: catch drift before a scraper does.
+
+The registry sanitizes names at export time (``/`` → ``_`` etc.), which
+keeps hostile values scrapeable but also means two DIFFERENT raw names
+can silently collide post-sanitization, and a typo'd name simply
+becomes a new, empty series. This linter scans the package source for
+``counter("..."``/``gauge("..."``/``histogram("..."`` string literals
+and fails on:
+
+* exposition-illegal raw names — anything outside
+  ``[a-zA-Z_][a-zA-Z0-9_/]*`` (the repo convention: ``/`` namespacing,
+  folded to ``_`` at export). A dash or colon would fold silently and
+  is exactly the drift this lint exists to catch;
+* the same raw name registered with conflicting metric types (a
+  ``counter("x")`` here and a ``gauge("x")`` there renders two ``# TYPE``
+  claims for one series — Prometheus rejects the page);
+* two distinct raw names that sanitize to the same exposition name
+  (post-fold collision).
+
+Wired as a plain pytest (tests/test_metrics_lint.py) so CI catches
+metric-name drift on every run, and as a CLI::
+
+    python -m paddle_tpu.tools.metrics_lint [root]
+
+Exit 0 when clean, 1 with one line per problem otherwise.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+__all__ = ["scan_file", "lint_source_tree", "main"]
+
+# reg.counter("name" / .gauge('name' / histogram("name" — a quote must
+# immediately follow the paren, so definitions (`def counter(self, ...`)
+# and f-strings (dynamic names are the caller's problem) don't match
+_CALL_RE = re.compile(
+    r"\b(counter|gauge|histogram)\(\s*(['\"])((?:[^'\"\\]|\\.)*)\2")
+
+_LEGAL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_/]*$")
+
+
+def _sanitized(name: str) -> str:
+    from ..observability.registry import _prom_metric_name
+    return _prom_metric_name(name)
+
+
+def scan_file(path: str) -> List[Tuple[str, str, int]]:
+    """(metric_type, raw_name, line_number) for every literal metric
+    registration in `path`."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    out = []
+    for m in _CALL_RE.finditer(src):
+        line = src.count("\n", 0, m.start()) + 1
+        out.append((m.group(1), m.group(3), line))
+    return out
+
+
+def lint_source_tree(root: str) -> List[str]:
+    """One human-readable line per problem found under `root`
+    (recursively, ``*.py``); empty list means clean."""
+    sites: Dict[str, List[Tuple[str, str, int]]] = {}  # name -> uses
+    problems: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            # the linter's own docstring is full of deliberately-bad
+            # example registrations
+            if not fn.endswith(".py") or fn == "metrics_lint.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            for mtype, name, line in scan_file(path):
+                sites.setdefault(name, []).append((mtype, rel, line))
+                if not _LEGAL_RE.match(name):
+                    problems.append(
+                        f"{rel}:{line}: illegal metric name {name!r} "
+                        f"(must match [a-zA-Z_][a-zA-Z0-9_/]*)")
+    # type conflicts: one raw name, more than one metric type
+    for name in sorted(sites):
+        types = sorted({t for t, _, _ in sites[name]})
+        if len(types) > 1:
+            where = ", ".join(f"{t} at {r}:{ln}"
+                              for t, r, ln in sites[name])
+            problems.append(
+                f"metric {name!r} registered with conflicting types "
+                f"{types}: {where}")
+    # post-sanitization collisions between distinct raw names
+    by_exposed: Dict[str, set] = {}
+    for name in sites:
+        by_exposed.setdefault(_sanitized(name), set()).add(name)
+    for exposed, names in sorted(by_exposed.items()):
+        if len(names) > 1:
+            problems.append(
+                f"raw names {sorted(names)} all sanitize to {exposed!r} "
+                f"— they would merge into one exposition series")
+    return problems
+
+
+def default_root() -> str:
+    """The paddle_tpu package directory (what CI lints)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else default_root()
+    problems = lint_source_tree(root)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"metrics_lint: {len(problems)} problem(s) under {root}")
+        return 1
+    print(f"metrics_lint: clean ({root})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,7 +43,10 @@ Commands:
   (``/metrics/series``). Prints a per-process table (role, reachability,
   scrape latency, series count, queue depth / pull p99 where present)
   and the derived ``autoscale/*`` signals; ``--json`` prints the full
-  ``/fleet`` document. Exit 1 when ANY scrape failed.
+  ``/fleet`` document. Exit 1 when ANY scrape failed. ``--watch N``
+  re-scrapes and re-renders every N seconds (screen cleared each pass,
+  Ctrl-C exits 0) — quick shard-level watching without the full
+  ``tools/ops_console`` dashboard.
 
 The hot-row cache lives in the WORKER process, not on the shards, so
 its ``ps/cache_*`` series come from the worker's introspection plane:
@@ -61,6 +64,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 __all__ = ["main"]
 
@@ -239,6 +243,9 @@ def main(argv=None) -> int:
                          "the pserver endpoints")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (dump-health always is)")
+    ap.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="fleet: re-scrape and re-render every N seconds "
+                         "(clear screen each pass; Ctrl-C exits cleanly)")
     args = ap.parse_args(argv)
 
     if args.cmd == "fleet":
@@ -249,11 +256,28 @@ def main(argv=None) -> int:
             if not workers:  # a fleet needs SOMETHING to scrape
                 raise
             eps = []
-        doc = fleet_scrape(eps, workers, timeout=args.timeout)
-        if args.json:
-            print(json.dumps(doc, sort_keys=True, default=str))
-        else:
-            print(format_fleet(doc))
+
+        def render_once() -> dict:
+            doc = fleet_scrape(eps, workers, timeout=args.timeout)
+            if args.json:
+                print(json.dumps(doc, sort_keys=True, default=str))
+            else:
+                print(format_fleet(doc))
+            return doc
+
+        if args.watch is not None:
+            if args.watch <= 0:
+                raise SystemExit("ps_admin: --watch must be > 0")
+            try:
+                while True:
+                    # ANSI clear + home — a poor man's watch(1)
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                    render_once()
+                    sys.stdout.flush()
+                    time.sleep(args.watch)
+            except KeyboardInterrupt:
+                return 0
+        doc = render_once()
         return 0 if doc["ok"] else 1
 
     eps = _endpoints(args.endpoints)

@@ -509,3 +509,68 @@ def test_train_step_roots_one_trace_across_shard_pulls():
         table.close()
         for s in servers:
             s.stop()
+
+
+# -- target churn (ISSUE 17 satellite) -------------------------------------
+
+def test_scraper_target_churn_retires_stale_autoscale_gauges():
+    """An autoscaled fleet adds and removes targets between sweeps. The
+    distilled autoscale/* gauges must follow: a vanished shard/process
+    leaves NO stale gauge behind (an autoscaler keying on it would act
+    on a ghost), and re-adding a target under the SAME name replaces
+    the old one instead of double-counting its series."""
+    def stub(shard, depth):
+        return [{"name": "ps/shard_pull_ms", "type": "summary",
+                 "labels": {"shard": str(shard)},
+                 "summary": {"count": 4, "sum": 8.0, "p50": 2.0,
+                             "p95": 3.0, "p99": 3.5}},
+                {"name": "serving/queue_depth", "type": "gauge",
+                 "labels": {}, "value": float(depth)}]
+
+    reg = get_registry()
+    sc = FederatedScraper(
+        [ScrapeTarget.call(lambda: stub(77, 5), name="churn-a",
+                           role="worker"),
+         ScrapeTarget.call(lambda: stub(78, 9), name="churn-b",
+                           role="worker")])
+    try:
+        sc.scrape_once()
+        assert reg.gauge("autoscale/ps_pull_p99_ms",
+                         shard="77").value == 3.5
+        assert reg.gauge("autoscale/queue_depth",
+                         process="churn-b").value == 9.0
+
+        # target vanishes: its per-shard and per-process gauges retire
+        # on the next sweep rather than freezing at the last value
+        assert sc.remove_target("churn-b") is True
+        assert sc.remove_target("churn-b") is False  # already gone
+        doc = sc.scrape_once()
+        assert {t["process"] for t in doc["targets"]} == {"churn-a"}
+        live = {(s["name"], tuple(sorted(s["labels"].items())))
+                for s in reg.series()}
+        assert ("autoscale/ps_pull_p99_ms",
+                (("shard", "78"),)) not in live
+        assert ("autoscale/queue_depth",
+                (("process", "churn-b"),)) not in live
+        assert ("autoscale/ps_pull_p99_ms", (("shard", "77"),)) in live
+
+        # same-name re-add REPLACES: one target row, one series set, the
+        # new reader's numbers (not a sum with the stale registration)
+        sc.add_target(ScrapeTarget.call(lambda: stub(78, 2),
+                                        name="churn-b", role="worker"))
+        sc.add_target(ScrapeTarget.call(lambda: stub(78, 4),
+                                        name="churn-b", role="worker"))
+        doc = sc.scrape_once()
+        rows = [t for t in doc["targets"] if t["process"] == "churn-b"]
+        assert len(rows) == 1
+        assert doc["signals"]["queue_depth"]["churn-b"] == 4.0
+        assert reg.gauge("autoscale/queue_depth",
+                         process="churn-b").value == 4.0
+        assert reg.gauge("autoscale/ps_pull_p99_ms",
+                         shard="78").value == 3.5
+    finally:
+        for g in (("autoscale/ps_pull_p99_ms", {"shard": "77"}),
+                  ("autoscale/ps_pull_p99_ms", {"shard": "78"}),
+                  ("autoscale/queue_depth", {"process": "churn-a"}),
+                  ("autoscale/queue_depth", {"process": "churn-b"})):
+            reg.remove(g[0], **g[1])

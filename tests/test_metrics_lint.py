@@ -1,0 +1,90 @@
+"""Metric-name lint (ISSUE 17 satellite): the tier-1 gate that keeps
+every literal ``counter(...)``/``gauge(...)``/``histogram(...)``
+registration in the package exposition-legal, type-consistent, and
+collision-free after Prometheus name sanitization — plus unit coverage
+of the linter itself over synthetic trees."""
+import subprocess
+import sys
+
+from paddle_tpu.tools.metrics_lint import (default_root, lint_source_tree,
+                                           main, scan_file)
+
+
+def test_package_source_is_lint_clean():
+    """THE gate: any metric-name drift in paddle_tpu fails tier-1."""
+    assert lint_source_tree(default_root()) == []
+
+
+def test_scan_finds_literal_registrations(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "reg.counter('a/b').inc()\n"
+        "x = reg.gauge(\"c/d\", shard='0')\n"
+        "reg.histogram( 'e_f' ).observe(1)\n"
+        "def counter(self, name):  # a definition, not a call\n"
+        "    pass\n"
+        "reg.counter(f'dyn/{name}')  # dynamic: caller's problem\n"
+        "reg.counter(name)  # non-literal\n")
+    assert scan_file(str(p)) == [
+        ("counter", "a/b", 1), ("gauge", "c/d", 2), ("histogram", "e_f", 3)]
+
+
+def test_lint_flags_illegal_names(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        "reg.counter('has-dash')\n"
+        "reg.gauge('0leading')\n"
+        "reg.histogram('ok/name')\n")
+    problems = lint_source_tree(str(tmp_path))
+    assert len(problems) == 2
+    assert any("has-dash" in p and "bad.py:1" in p for p in problems)
+    assert any("0leading" in p and "bad.py:2" in p for p in problems)
+
+
+def test_lint_flags_type_conflicts_across_files(tmp_path):
+    (tmp_path / "a.py").write_text("reg.counter('x/y')\n")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "b.py").write_text("reg.gauge('x/y')\n")
+    (problem,) = lint_source_tree(str(tmp_path))
+    assert "conflicting types" in problem
+    assert "'x/y'" in problem and "a.py:1" in problem
+    assert "counter" in problem and "gauge" in problem
+
+
+def test_lint_flags_post_sanitization_collisions(tmp_path):
+    # distinct raw names that fold to the same exposition name
+    (tmp_path / "a.py").write_text(
+        "reg.counter('x/y')\nreg.counter('x_y')\n")
+    (problem,) = lint_source_tree(str(tmp_path))
+    assert "sanitize to 'x_y'" in problem
+    # same raw name twice is NOT a collision
+    (tmp_path / "a.py").write_text(
+        "reg.counter('x/y')\nreg.counter('x/y')\n")
+    assert lint_source_tree(str(tmp_path)) == []
+
+
+def test_lint_skips_pycache_and_itself(tmp_path):
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.py").write_text(
+        "reg.counter('very-bad')\n")
+    # the linter's own docstring is full of deliberately-bad examples
+    (tmp_path / "metrics_lint.py").write_text("reg.counter('also-bad')\n")
+    assert lint_source_tree(str(tmp_path)) == []
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert main([str(tmp_path)]) == 0
+    assert "clean" in capsys.readouterr().out
+    (tmp_path / "bad.py").write_text("reg.counter('has-dash')\n")
+    assert main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "has-dash" in out and "1 problem(s)" in out
+
+
+def test_module_entrypoint_runs_clean():
+    """`python -m paddle_tpu.tools.metrics_lint` is the CI invocation;
+    it must work without JAX-level setup (bastion-grade tooling)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.metrics_lint"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout

@@ -1,0 +1,566 @@
+"""SLO engine + alert lifecycle (ISSUE 17): declarative SloSpecs
+compiled into burn-rate rules over the federated sweep, the
+pending→firing→resolved state machine with sinks and flight dumps, the
+train→serve staleness audit, and the operator surfaces (/alerts,
+healthz, ops console, ps_admin --watch).
+
+Engine tests inject the clock (``observe(doc, now=, now_wall=)``) and
+use isolated Registry instances, so burn windows are exercised at the
+REAL 1h/5m table without wall-clock sleeps.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid  # noqa: F401  (backend init)
+from paddle_tpu.observability import alerts as alerts_mod
+from paddle_tpu.observability.alerts import (AlertManager, FileSink,
+                                             get_alert_manager,
+                                             install_alert_manager)
+from paddle_tpu.observability.http import run_health_checks
+from paddle_tpu.observability.registry import (Registry, get_registry,
+                                               render_prometheus)
+from paddle_tpu.observability.slo import (BURN_RATE_WINDOWS, SloEngine,
+                                          SloSpec, _wlabel, default_slos)
+
+
+def g(name, value, **labels):
+    return {"name": name, "type": "gauge", "labels": labels,
+            "value": float(value)}
+
+
+def c(name, value, **labels):
+    return {"name": name, "type": "counter", "labels": labels,
+            "value": float(value)}
+
+
+def summ(name, field_vals, **labels):
+    return {"name": name, "type": "summary", "labels": labels,
+            "summary": dict(field_vals)}
+
+
+def mk(specs, **kw):
+    """Engine + manager over a private registry (no cross-test leaks)."""
+    reg = Registry()
+    am = AlertManager(registry=reg, **kw.pop("am", {}))
+    eng = SloEngine(specs, alert_manager=am, registry=reg, **kw)
+    return reg, am, eng
+
+
+def states(am, name):
+    return {a.state for a in am.alerts() if a.name == name}
+
+
+# -- SloSpec ---------------------------------------------------------------
+
+def test_slospec_validation():
+    with pytest.raises(ValueError, match="mode"):
+        SloSpec("X", "m", "between")
+    with pytest.raises(ValueError, match="total_metric"):
+        SloSpec("X", "m", "ratio")
+    with pytest.raises(ValueError, match="bound"):
+        SloSpec("X", "m", "min_above")
+    with pytest.raises(ValueError, match="objective"):
+        SloSpec("X", "m", "min_above", bound=1.0, objective=1.0)
+    with pytest.raises(ValueError, match="missing"):
+        SloSpec("X", "m", "min_above", bound=1.0, missing="page_me")
+    with pytest.raises(ValueError, match="duplicate"):
+        SloEngine([SloSpec.floor("X", "m", 1.0),
+                   SloSpec.ceiling("X", "m", 2.0)])
+    s = SloSpec.freshness("F", "clock", 2000.0)
+    assert s.mode == "age_below" and s.bound == 2.0  # ms -> seconds
+    assert abs(s.budget - 0.001) < 1e-12
+
+
+def test_default_slos_cover_the_stack():
+    specs = {s.name: s for s in default_slos()}
+    assert set(specs) == {
+        "PsShardAvailability", "PsPullLatency", "ServingAvailability",
+        "ServingTenantLatency", "ServingTenantAvailability",
+        "DeltaStaleness"}
+    assert specs["PsShardAvailability"].group_by == "shard"
+    assert specs["ServingTenantLatency"].group_by == "tenant"
+    assert specs["ServingTenantLatency"].field == "p99"
+    assert specs["DeltaStaleness"].metric == "staleness/last_visible_ts"
+    assert specs["ServingAvailability"].total_metric == "serving/requests"
+    # training floors are opt-in (budgets are model-specific)
+    withf = {s.name: s for s in default_slos(step_time_ms=40.0,
+                                             mfu_floor=0.3)}
+    assert withf["TrainStepTime"].bound == 40.0
+    assert withf["MfuFloor"].mode == "min_above"
+
+
+# -- rule evaluation -------------------------------------------------------
+
+def test_floor_fires_per_group_and_names_offender():
+    reg, am, eng = mk([SloSpec.floor("Avail", "up", 1.0, group_by="shard",
+                                     objective=0.999)])
+    for t in range(3):  # healthy baseline
+        eng.observe([g("up", 1, shard="0"), g("up", 1, shard="1")],
+                    now=float(t))
+    eng.observe([g("up", 1, shard="0"), g("up", 0, shard="1")], now=3.0)
+    firing = am.firing(severity="page")
+    assert [a.labels for a in firing] == [{"slo": "Avail", "shard": "1"}]
+    # hard outage saturates BOTH warn windows too (multiwindow AND)
+    assert {a.severity for a in am.firing()} == {"page", "warn"}
+    assert firing[0].annotations["burn_5m"] > 14.4
+    assert firing[0].annotations["value"] == 0.0
+    # the healthy group never even allocated an alert
+    assert all(a.labels["shard"] == "1" for a in am.alerts())
+
+
+def test_ceiling_reads_summary_percentile():
+    reg, am, eng = mk([SloSpec.latency("Pull", "pull_ms", 100.0,
+                                       group_by="shard")])
+    eng.observe([summ("pull_ms", {"p99": 60.0, "p50": 5.0}, shard="0")],
+                now=0.0)
+    assert am.alerts() == []
+    eng.observe([summ("pull_ms", {"p99": 400.0, "p50": 5.0}, shard="0")],
+                now=1.0)
+    (a,) = am.firing(severity="page")
+    assert a.labels == {"slo": "Pull", "shard": "0"}
+    assert a.annotations["value"] == 400.0  # the raw p99, not the burn
+
+
+def test_age_below_freshness_clock():
+    reg, am, eng = mk([SloSpec.freshness("Stale", "clock", 1200.0,
+                                         group_by="table")])
+    w = 1_000_000.0
+    eng.observe([g("clock", w - 0.4, table="tb")], now=0.0, now_wall=w)
+    assert am.alerts() == []
+    # the stall signature: the clock VALUE freezes while wall time moves
+    eng.observe([g("clock", w - 0.4, table="tb")], now=5.0,
+                now_wall=w + 4.0)
+    (a,) = am.firing(severity="page")
+    assert a.labels == {"slo": "Stale", "table": "tb"}
+    assert a.annotations["value"] > 1.2  # the observed age, seconds
+
+
+def test_ratio_mode_deltas_weights_and_counter_reset():
+    reg, am, eng = mk([SloSpec.ratio("Avail", "errs", "reqs",
+                                     objective=0.999)])
+    out = eng.observe([c("errs", 0), c("reqs", 100)], now=0.0)
+    assert out["Avail"] == {}  # first sweep only establishes baselines
+    out = eng.observe([c("errs", 10), c("reqs", 200)], now=1.0)
+    assert out["Avail"][""]["bad"] == pytest.approx(0.1)  # 10/100 new
+    (a,) = am.firing(severity="page")  # burn 0.1/0.001 = 100 > 14.4
+    assert a.value == pytest.approx(100.0)
+    # counter reset (process restart): tolerated, no sample, no crash
+    out = eng.observe([c("errs", 0), c("reqs", 5)], now=2.0)
+    assert out["Avail"][""]["bad"] == pytest.approx(0.1)  # ring unchanged
+    # idle sweep (no new requests): no observation either
+    eng.observe([c("errs", 0), c("reqs", 5)], now=3.0)
+
+
+def test_recording_gauges_use_logical_window_labels():
+    reg, am, eng = mk([SloSpec.floor("Avail", "up", 1.0)],
+                      window_scale=1.0 / 720.0)
+    eng.observe([g("up", 0)], now=0.0)
+    assert reg.gauge("slo/bad_fraction", slo="Avail").value == 1.0
+    for wlab in ("1h", "5m", "6h", "30m"):  # NOT the scaled seconds
+        assert reg.gauge("slo/burn_rate", slo="Avail",
+                         window=wlab).value == pytest.approx(1000.0)
+    assert [_wlabel(w) for _, lw, sw, _ in BURN_RATE_WINDOWS
+            for w in (lw, sw)] == ["1h", "5m", "6h", "30m"]
+
+
+def test_vanished_group_drains_resolves_and_cleans_gauges():
+    reg, am, eng = mk([SloSpec.floor("Avail", "up", 1.0,
+                                     group_by="shard")],
+                      window_scale=1.0 / 3600.0)  # max window ~6 s
+    eng.observe([g("up", 0, shard="9")], now=0.0)
+    assert states(am, "Avail") == {"firing"}
+    # the shard's target disappears entirely; its ring decays instead of
+    # freezing the alert in the firing state forever
+    eng.observe([], now=0.05)  # still inside the scaled short windows
+    assert states(am, "Avail") == {"firing"}
+    eng.observe([], now=1.0)  # short windows cleared: page resolves...
+    assert states(am, "Avail") == {"resolved"}
+    assert [s for s in reg.series() if s["name"] == "slo/burn_rate"]
+    eng.observe([], now=10.0)  # ...and past the longest window the ring
+    assert states(am, "Avail") == {"resolved"}  # drains, gauges retire
+    assert not [s for s in reg.series()
+                if s["name"] in ("slo/bad_fraction", "slo/burn_rate")]
+
+
+def test_missing_bad_counts_silent_group_as_out_of_slo():
+    reg, am, eng = mk([SloSpec.floor("Avail", "up", 1.0, group_by="shard",
+                                     missing="bad")])
+    eng.observe([g("up", 1, shard="0")], now=0.0)
+    assert am.alerts() == []
+    eng.observe([], now=1.0)  # known group went silent: that IS bad
+    (a,) = am.firing(severity="page")
+    assert a.labels == {"slo": "Avail", "shard": "0"}
+
+
+# -- alert state machine ---------------------------------------------------
+
+def test_for_s_pending_then_firing_and_silent_pending_clear():
+    reg = Registry()
+    events = []
+    am = AlertManager(for_s=5.0, registry=reg, sinks=[events.append])
+    am.update("A", True, now=0.0)
+    assert states(am, "A") == {"pending"} and events == []
+    am.update("A", True, now=3.0)
+    assert states(am, "A") == {"pending"}
+    am.update("A", True, now=6.0)  # held for_s: fire
+    assert states(am, "A") == {"firing"}
+    assert [e["event"] for e in events] == ["firing"]
+    # a blip that clears while still pending vanishes without a trace
+    am.update("B", True, now=10.0)
+    am.update("B", False, now=11.0)
+    assert states(am, "B") == set()
+    assert [e["event"] for e in events] == ["firing"]  # no B events
+    assert not [s for s in reg.series()
+                if s["name"] == "ALERTS" and s["labels"].get(
+                    "alertname") == "B"]
+
+
+def test_resolve_refire_and_hold_pruning():
+    reg = Registry()
+    events = []
+    am = AlertManager(for_s=0.0, resolved_hold_s=10.0, registry=reg,
+                      sinks=[events.append])
+    am.update("A", True, severity="page", labels={"shard": "1"}, now=0.0)
+    am.update("A", False, labels={"shard": "1"}, severity="page", now=1.0)
+    assert states(am, "A") == {"resolved"}
+    assert [e["event"] for e in events] == ["firing", "resolved"]
+    # condition returns while the resolved record is held: re-fire
+    am.update("A", True, severity="page", labels={"shard": "1"}, now=2.0)
+    assert states(am, "A") == {"firing"}
+    am.update("A", False, labels={"shard": "1"}, severity="page", now=3.0)
+    # past the hold the episode is pruned (any update ticks the clock)
+    am.update("other", False, now=20.0)
+    assert am.alerts() == []
+    assert not [s for s in reg.series() if s["name"] == "ALERTS"]
+
+
+def test_alerts_series_follows_state():
+    reg = Registry()
+    am = AlertManager(for_s=5.0, registry=reg)
+
+    def alert_states():
+        return {s["labels"]["alertstate"] for s in reg.series()
+                if s["name"] == "ALERTS"}
+
+    am.update("A", True, now=0.0)
+    assert alert_states() == {"pending"}
+    am.update("A", True, now=6.0)
+    assert alert_states() == {"firing"}  # pending series removed
+    am.update("A", False, now=7.0)
+    assert alert_states() == {"resolved"}
+    (s,) = [s for s in reg.series() if s["name"] == "ALERTS"]
+    assert s["labels"]["alertname"] == "A"
+    assert s["labels"]["severity"] == "page"
+
+
+def test_sinks_file_callback_and_error_isolation(tmp_path):
+    reg = Registry()
+    path = tmp_path / "alerts.jsonl"
+    seen = []
+
+    def sick(event):
+        raise RuntimeError("sink down")
+
+    am = AlertManager(registry=reg,
+                      sinks=[FileSink(str(path)), sick, seen.append])
+    am.update("A", True, labels={"shard": "2"}, value=99.0, now=0.0)
+    am.update("A", False, labels={"shard": "2"}, now=1.0)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["event"] for l in lines] == ["firing", "resolved"]
+    assert lines[0]["labels"] == {"shard": "2"}
+    assert lines[0]["value"] == 99.0
+    # the raising sink was counted and did NOT starve its siblings
+    assert [e["event"] for e in seen] == ["firing", "resolved"]
+    assert reg.counter("alerts/sink_errors").value == 2.0
+
+
+def test_page_fire_writes_flight_dump_warn_does_not(tmp_path, monkeypatch):
+    monkeypatch.setenv("PDTPU_FLIGHT_DIR", str(tmp_path))
+    events = []
+    am = AlertManager(registry=Registry(), sinks=[events.append])
+    am.update("W", True, severity="warn", now=0.0)
+    am.update("P", True, severity="page", labels={"shard": "3"},
+              value=500.0, now=0.0)
+    by_name = {a.name: a for a in am.firing()}
+    assert by_name["W"].dump_path is None
+    dump_path = by_name["P"].dump_path
+    assert dump_path and os.path.exists(dump_path)
+    dump = json.loads(open(dump_path).read())
+    assert dump["exception"]["type"] == "AlertFiringError"
+    assert dump["context"]["alert"] == "P"
+    assert dump["context"]["labels"] == {"shard": "3"}
+    assert dump["context"]["value"] == 500.0
+    # the firing EVENT carries the dump path too (sinks see forensics)
+    (pev,) = [e for e in events if e["name"] == "P"]
+    assert pev["dump_path"] == dump_path
+
+
+def test_health_check_and_process_install():
+    am = AlertManager(registry=Registry())
+    assert am.health_check() == "ok"
+    am.update("W", True, severity="warn", now=0.0)
+    status, detail = am.health_check()
+    assert status == "degraded" and "W" in detail
+    am.update("P", True, severity="page", now=0.0)
+    status, detail = am.health_check()
+    assert status == "failing" and "P" in detail
+    assert get_alert_manager() is None
+    try:
+        install_alert_manager(am)
+        assert get_alert_manager() is am
+        overall, checks = run_health_checks()
+        assert overall == "failing"
+        assert checks["alerts"]["status"] == "failing"
+    finally:
+        install_alert_manager(None)
+    assert "alerts" not in run_health_checks()[1]
+
+
+def test_alerts_endpoint_and_healthz(tmp_path):
+    from test_observability import _http_get
+    from paddle_tpu.observability.http import IntrospectionServer
+
+    srv = IntrospectionServer(port=0)
+    srv.start()
+    am = AlertManager(registry=Registry())
+    try:
+        code, body = _http_get(srv.url + "/alerts")
+        assert code == 404 and "install_alert_manager" in body
+        install_alert_manager(am)
+        am.update("P", True, severity="page", labels={"shard": "0"},
+                  now=0.0)
+        code, body = _http_get(srv.url + "/alerts")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["firing"] == 1
+        (a,) = doc["alerts"]
+        assert (a["name"], a["state"]) == ("P", "firing")
+        assert a["labels"] == {"shard": "0"}
+        code, body = _http_get(srv.url + "/healthz")
+        assert code == 503  # a firing page fails the whole process
+        assert json.loads(body)["checks"]["alerts"]["status"] == "failing"
+        # labels are part of an alert's identity: the clear must name it
+        am.update("P", False, severity="page", labels={"shard": "0"},
+                  now=1.0)
+        code, _ = _http_get(srv.url + "/healthz")
+        assert code == 200
+    finally:
+        install_alert_manager(None)
+        srv.stop()
+
+
+# -- staleness audit plumbing ---------------------------------------------
+
+class _StubTable:
+    name = "tb"
+
+    def __init__(self):
+        self.listeners = []
+
+    def add_push_listener(self, fn):
+        self.listeners.append(fn)
+
+    def remove_push_listener(self, fn):
+        self.listeners.remove(fn)
+
+
+def test_publisher_meta_subscription_contract():
+    from paddle_tpu.streaming import DeltaPublisher
+
+    pub = DeltaPublisher(_StubTable(), staleness_s=60.0, start=False)
+    legacy, metaed = [], []
+    pub.subscribe(lambda name, uids, rows: legacy.append((name, uids)))
+    pub.subscribe(lambda name, uids, rows, meta: metaed.append(
+        (uids, meta)), meta=True)
+    r = np.arange(4, dtype=np.uint16).reshape(2, 2)
+    pub._on_push(np.array([7, 3]), r)
+    time.sleep(0.01)
+    pub._on_push(np.array([7]), r[:1] + 1)  # re-push: newest bytes...
+    assert pub.flush() == 2
+    (name, uids) = legacy[0]
+    assert name == "tb" and uids.tolist() == [3, 7]
+    uids, meta = metaed[0]
+    assert meta["seq"] == 1
+    assert meta["enqueue_t"].shape == (2,)
+    # ...but the FIRST unflushed push's timestamp (staleness bounds the
+    # oldest pending byte): uid 7's stamp predates uid 3's second write
+    i7 = uids.tolist().index(7)
+    assert meta["enqueue_t"][i7] <= meta["published_t"]
+    assert pub.flush() == 0  # nothing pending: subscribers not called
+    assert len(legacy) == 1 and len(metaed) == 1
+    pub._on_push(np.array([1]), r[:1])
+    assert pub.flush() == 1
+    assert metaed[1][1]["seq"] == 2
+
+
+def test_predictor_audit_closes_e2e_staleness(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.ps import (EmbeddingShard, InProcessClient, RangeSpec,
+                               ShardedTable)
+    from test_streaming import CAP, _save_online_model
+
+    vocab = 60
+    table = ShardedTable(
+        "tb", RangeSpec.even(vocab, 1),
+        [InProcessClient([EmbeddingShard("tb", 0, vocab)])])
+    _save_online_model(str(tmp_path / "m"), CAP)
+    base = inference.create_predictor(
+        inference.Config(str(tmp_path / "m")))
+    ps = inference.PsLookupPredictor(
+        base, [inference.PsLookupBinding("tb", table, ["ids"])],
+        cache_rows_per_table=vocab)
+    reg = get_registry()
+    reg.remove_matching("staleness/e2e_ms")
+    reg.remove_matching("staleness/last_visible_ts")
+    assert ps.staleness_e2e_percentiles() == {"p50": None, "p99": None,
+                                              "max": None}
+    uids = np.array([1, 5], np.int64)
+    rows = np.zeros((2, 128), np.uint16)
+    # legacy (meta-less) delivery applies bytes but records no audit
+    ps.apply_delta("tb", uids, rows)
+    assert not [s for s in reg.series()
+                if s["name"] == "staleness/last_visible_ts"]
+    # meta-aware delivery closes the audit: e2e histogram + clock
+    ps.apply_delta("tb", uids, rows, meta={
+        "seq": 1, "published_t": time.monotonic(),
+        "enqueue_t": np.full(2, time.monotonic() - 0.05)})
+    pct = ps.staleness_e2e_percentiles()
+    assert pct["p50"] is not None and 40.0 < pct["max"] < 5000.0
+    (h,) = [s for s in reg.series() if s["name"] == "staleness/e2e_ms"]
+    assert h["labels"]["table"] == "tb"
+    assert h["summary"]["count"] == 2
+    (clk,) = [s for s in reg.series()
+              if s["name"] == "staleness/last_visible_ts"]
+    assert 0.0 <= time.time() - clk["value"] < 60.0
+
+
+# -- exposition satellites -------------------------------------------------
+
+def test_help_lines_for_described_series_only():
+    reg = Registry()
+    Registry.describe("helped/x", "counted\nthings \\ escaped")
+    reg.counter("helped/x").inc()
+    reg.counter("bare/y").inc()
+    text = render_prometheus(reg.series())
+    assert ("# HELP helped_x counted\\nthings \\\\ escaped"
+            in text.splitlines())
+    help_i = text.index("# HELP helped_x")
+    assert help_i < text.index("# TYPE helped_x")
+    assert "# HELP bare_y" not in text
+    assert Registry.help_for("helped/x").startswith("counted")
+    assert Registry.help_for("bare/y") is None
+
+
+def test_registry_remove_and_remove_matching():
+    reg = Registry()
+    reg.gauge("m", shard="0").set(1)
+    reg.gauge("m", shard="1").set(2)
+    reg.counter("m", shard="2").inc()
+    assert reg.remove("m", shard="0") is True
+    assert reg.remove("m", shard="0") is False
+    assert reg.remove("m", shard="nope") is False
+    assert {s["labels"]["shard"] for s in reg.series()
+            if s["name"] == "m"} == {"1", "2"}
+    assert reg.remove_matching("m") == 2
+    assert reg.series() == []
+
+
+# -- operator surfaces -----------------------------------------------------
+
+def test_ops_console_render_frames():
+    from paddle_tpu.tools import ops_console
+
+    down = ops_console.render(
+        {"reachable": False, "notes": ["/fleet: URLError: refused"]},
+        color=False)
+    assert "COORDINATOR UNREACHABLE" in down
+    frame = {
+        "reachable": True,
+        "alerts": {"alerts": [
+            {"name": "PsShardAvailability", "severity": "page",
+             "state": "firing", "value": 500.0,
+             "labels": {"slo": "PsShardAvailability", "shard": "1"}},
+            {"name": "DeltaStaleness", "severity": "warn",
+             "state": "resolved", "labels": {}}],
+            "firing": 1, "pending": 0, "resolved": 1},
+        "fleet": {"targets": [
+            {"process": "pserver:1", "role": "pserver", "shard": 1,
+             "ok": False, "scrape_ms": 0.4, "error": "refused",
+             "series": []},
+            {"process": "w0", "role": "worker", "shard": None, "ok": True,
+             "scrape_ms": 1.2, "series": [
+                 {"name": "serving/queue_depth", "type": "gauge",
+                  "value": 7.0},
+                 {"name": "ps/shard_pull_ms", "type": "summary",
+                  "summary": {"p99": 12.5}}]}],
+            "signals": {"queue_depth": {"w0": 7.0}}},
+        "notes": []}
+    out = ops_console.render(frame, color=False)
+    assert "1 firing / 0 pending / 1 resolved" in out
+    assert "[page] PsShardAvailability{shard=1} firing  burn=500.0" in out
+    assert "DOWN" in out and "refused" in out
+    assert "queue_depth" in out  # signals line
+    colored = ops_console.render(frame, color=True)
+    assert "\x1b[31;1m" in colored  # firing page renders red
+    empty = ops_console.render(
+        {"reachable": True, "alerts": None, "fleet": None,
+         "notes": ["/alerts: not wired"]}, color=False)
+    assert "no AlertManager" in empty and "not wired" in empty
+
+
+def test_ops_console_once_exit_codes(capsys):
+    from paddle_tpu.observability.http import IntrospectionServer
+    from paddle_tpu.tools import ops_console
+
+    srv = IntrospectionServer(port=0)
+    srv.start()
+    am = AlertManager(registry=Registry())
+    try:
+        # endpoints not wired yet: still renders, exits 0
+        rc = ops_console.main([srv.url, "--once", "--no-color"])
+        assert rc == 0
+        assert "no AlertManager" in capsys.readouterr().out
+        install_alert_manager(am)
+        am.update("P", True, severity="page", now=0.0)
+        rc = ops_console.main([srv.url, "--once", "--no-color"])
+        assert rc == 1  # firing alert
+        assert "firing" in capsys.readouterr().out
+        rc = ops_console.main(["http://127.0.0.1:9", "--once",
+                               "--no-color", "--timeout", "0.5"])
+        assert rc == 2  # unreachable
+        with pytest.raises(SystemExit):
+            ops_console.main([srv.url, "--interval", "0"])
+    finally:
+        install_alert_manager(None)
+        srv.stop()
+
+
+def test_ps_admin_fleet_watch(capsys, monkeypatch):
+    from paddle_tpu.ps import EmbeddingShard, ShardServer
+    from paddle_tpu.tools import ps_admin
+
+    srv = ShardServer([EmbeddingShard("tb", 0, 8)]).serve_in_thread()
+    frames = []
+
+    def sleep_twice(_s):
+        frames.append(capsys.readouterr().out)
+        if len(frames) >= 2:
+            raise KeyboardInterrupt
+    monkeypatch.setattr(ps_admin.time, "sleep", sleep_twice)
+    try:
+        rc = ps_admin.main(["fleet", "--endpoints", srv.endpoint,
+                            "--watch", "0.01"])
+        assert rc == 0  # Ctrl-C is a clean exit
+        assert len(frames) == 2
+        for f in frames:
+            assert "\x1b[2J" in f  # in-place repaint, not a scroll
+            assert "pserver" in f
+        with pytest.raises(SystemExit):
+            ps_admin.main(["fleet", "--endpoints", srv.endpoint,
+                           "--watch", "-1"])
+    finally:
+        srv.stop()

@@ -847,6 +847,20 @@ def test_online_smoke_auc_improves_and_serving_is_fresh(tmp_path):
             got, miss = cache.lookup(uids)
             assert not miss.any()
             np.testing.assert_array_equal(got, table.pull(uids))
+            # the e2e staleness audit populated along the way: the
+            # publisher's meta stamps crossed into the serving replica
+            # (staleness/e2e_ms histogram + the DeltaStaleness freshness
+            # clock the SLO engine alerts on)
+            e2e = ps.staleness_e2e_percentiles()
+            assert e2e["p50"] is not None and e2e["p99"] >= e2e["p50"]
+            series = get_registry().series()
+            (h,) = [s for s in series if s["name"] == "staleness/e2e_ms"
+                    and s["labels"].get("table") == "tb"]
+            assert h["summary"]["count"] > 0
+            (clk,) = [s for s in series
+                      if s["name"] == "staleness/last_visible_ts"
+                      and s["labels"].get("table") == "tb"]
+            assert 0.0 <= time.time() - clk["value"] < 60.0
         finally:
             tier.close()
             pub.close()
