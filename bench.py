@@ -117,36 +117,67 @@ def _run_section_child(name):
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu" or "tpu" in str(dev).lower()
-    with get_flight_recorder().guard(f"bench/{name}"), \
-            planner.guard(f"bench/{name}"):
-        if os.environ.get("PDTPU_BENCH_FORCE_OOM") == name:
-            # test hook for the isolation contract itself: a synthetic
-            # OOM deep in one section must not cascade past it, and must
-            # surface as HbmBudgetError carrying the plan in effect
-            plan = planner.Plan(0, "none", 1, source="unconstrained",
-                                fits=True)
-            planner._record(plan, [plan], f"bench/{name}")
-            raise RuntimeError(
-                f"RESOURCE_EXHAUSTED: forced OOM in section {name!r} "
-                f"(PDTPU_BENCH_FORCE_OOM)")
-        if name == "nmt_big":
-            rate, ms, mfu, nb, shapes = bench_nmt(on_tpu)
-            result = {"rate": rate, "ms": ms, "mfu": mfu, "n_shapes": nb,
-                      "shapes": shapes}
-        elif name == "ring_attn":
-            extras = {}
-            speedup = _bench_ring_attn(extras) if on_tpu else None
-            result = {"speedup": speedup, "extras": extras}
-        elif name == "dygraph":
-            dy = None
-            if on_tpu:
-                from paddle_tpu.tools.op_bench import bench_dygraph_mlp
-                dy = bench_dygraph_mlp(steps=20)
-            result = {"dy": dy}
-        else:
-            raise ValueError(f"unknown bench section {name!r}")
+    try:
+        with get_flight_recorder().guard(f"bench/{name}"), \
+                planner.guard(f"bench/{name}"):
+            if os.environ.get("PDTPU_BENCH_FORCE_OOM") == name:
+                # test hook for the isolation contract itself: a synthetic
+                # OOM deep in one section must not cascade past it, and must
+                # surface as HbmBudgetError carrying the plan in effect
+                plan = planner.Plan(0, "none", 1, source="unconstrained",
+                                    fits=True)
+                planner._record(plan, [plan], f"bench/{name}")
+                raise RuntimeError(
+                    f"RESOURCE_EXHAUSTED: forced OOM in section {name!r} "
+                    f"(PDTPU_BENCH_FORCE_OOM)")
+            if name == "nmt_big":
+                rate, ms, mfu, nb, shapes, sp_speedup = bench_nmt(on_tpu)
+                result = {"rate": rate, "ms": ms, "mfu": mfu, "n_shapes": nb,
+                          "shapes": shapes, "sparse_speedup": sp_speedup}
+            elif name == "ring_attn":
+                extras = {}
+                speedup = _bench_ring_attn(extras) if on_tpu else None
+                result = {"speedup": speedup, "extras": extras}
+            elif name == "dygraph":
+                dy = plan_dict = None
+                if on_tpu:
+                    from paddle_tpu import planner as _pl
+                    from paddle_tpu.tools.op_bench import bench_dygraph_mlp
+                    # batch ladder: the MLP arms are raw arrays, not a
+                    # Program, so the footprint planner picks the largest
+                    # batch whose analytic bytes fit the HBM budget
+                    cands = [(planner.Plan(0, "none", K),
+                              _dygraph_footprint_bytes(64 // K))
+                             for K in (1, 2, 4)]
+                    plan = _pl.plan_for_footprint(cands,
+                                                  where="bench/dygraph")
+                    plan_dict = plan.to_dict()
+                    dy = bench_dygraph_mlp(steps=20,
+                                           batch=max(1, 64 // plan.microbatch))
+                result = {"dy": dy, "hbm_plan": plan_dict}
+            else:
+                raise ValueError(f"unknown bench section {name!r}")
+    except planner.HbmBudgetError as e:
+        # structured OOM record for the parent: the active plan and the
+        # full HbmBudgetError text (which names it) — the parent merges
+        # in the flight-dump path. Re-raised so in-process callers (tests)
+        # see the exception and the subprocess exits nonzero.
+        print("BENCH_SECTION_ERROR " + json.dumps({
+            "error": f"HbmBudgetError: {str(e)[:500]}",
+            "plan": e.plan.to_dict() if e.plan is not None else None,
+        }), flush=True)
+        raise
     print("BENCH_SECTION_JSON " + json.dumps(
         {"result": result, "memory": _device_memory_snapshot()}))
+
+
+def _dygraph_footprint_bytes(batch, width=256, depth=4):
+    """Analytic live-bytes estimate for one dygraph MLP train step:
+    params + grads + optimizer state f32, plus ~6 activation copies per
+    layer (fwd save + bwd) — deliberately conservative."""
+    params = (depth + 1) * width * width + 2 * depth * width
+    acts = 6 * (depth + 2) * batch * width
+    return 4 * (3 * params + acts)
 
 
 def _run_section_subprocess(name, extras, timeout=2400):
@@ -169,13 +200,18 @@ def _run_section_subprocess(name, extras, timeout=2400):
     except subprocess.TimeoutExpired:
         return None, {"error": f"section timed out after {timeout}s",
                       "flight_dump": None}
-    payload = None
+    payload = err_payload = None
     for line in (proc.stdout or "").splitlines():
         if line.startswith("BENCH_SECTION_JSON "):
             try:
                 payload = json.loads(line[len("BENCH_SECTION_JSON "):])
             except json.JSONDecodeError:
                 payload = None
+        elif line.startswith("BENCH_SECTION_ERROR "):
+            try:
+                err_payload = json.loads(line[len("BENCH_SECTION_ERROR "):])
+            except json.JSONDecodeError:
+                err_payload = None
     if payload is not None:
         extras.setdefault("section_memory", {})[name] = payload.get("memory")
         extras.setdefault("section_peak_bytes", {})[name] = (
@@ -185,11 +221,19 @@ def _run_section_subprocess(name, extras, timeout=2400):
     new_dumps = sorted(
         set(glob.glob(os.path.join(flight_dir, "flight_*.json"))) - before,
         key=os.path.getmtime)
+    dump = new_dumps[-1] if new_dumps else None
+    if err_payload is not None:
+        # structured HbmBudgetError from the child: the record names the
+        # plan that was active when HBM ran out, never a bare
+        # RESOURCE_EXHAUSTED string
+        err_payload["flight_dump"] = dump
+        err_payload.setdefault("error", "HbmBudgetError (no detail)")
+        return None, err_payload
     tail = [ln for ln in (proc.stderr or "").strip().splitlines() if ln]
     return None, {
         "error": f"exit {proc.returncode}: "
                  f"{tail[-1][:160] if tail else 'no stderr'}",
-        "flight_dump": new_dumps[-1] if new_dumps else None}
+        "flight_dump": dump}
 
 
 def _time_steps(exe, prog, feed, loss, iters):
@@ -260,18 +304,44 @@ def bench_resnet(on_tpu, calib=None):
     from paddle_tpu.models import resnet
 
     batch, hw, classes = (128, 224, 1000) if on_tpu else (2, 32, 10)
-    main_prog = fluid.Program()
-    startup = fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        img = fluid.layers.data("img", [3, hw, hw])
-        label = fluid.layers.data("label", [1], dtype="int64")
-        logits = resnet.resnet(img, 50, classes, stem_s2d=on_tpu)
-        loss = fluid.layers.mean(
-            fluid.layers.softmax_with_cross_entropy(logits, label))
-        from paddle_tpu.contrib import mixed_precision as mp
-        opt = mp.decorate(fluid.optimizer.Momentum(0.1, 0.9),
-                          dtype="bfloat16", use_dynamic_loss_scaling=False)
-        opt.minimize(loss)
+
+    def _build(fusion_mode):
+        """Build the train program with conv+BN fusion on/off. resnet.py
+        reads PDTPU_CONV_BN_FUSION at graph-build time, so the env must
+        bracket the build, not just the run."""
+        prev = os.environ.get("PDTPU_CONV_BN_FUSION")
+        if fusion_mode is None:
+            os.environ.pop("PDTPU_CONV_BN_FUSION", None)
+        else:
+            os.environ["PDTPU_CONV_BN_FUSION"] = fusion_mode
+        try:
+            main_prog = fluid.Program()
+            startup = fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                img = fluid.layers.data("img", [3, hw, hw])
+                label = fluid.layers.data("label", [1], dtype="int64")
+                logits = resnet.resnet(img, 50, classes, stem_s2d=on_tpu)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits, label))
+                from paddle_tpu.contrib import mixed_precision as mp
+                opt = mp.decorate(fluid.optimizer.Momentum(0.1, 0.9),
+                                  dtype="bfloat16",
+                                  use_dynamic_loss_scaling=False)
+                opt.minimize(loss)
+            return main_prog, startup, loss
+        finally:
+            if prev is None:
+                os.environ.pop("PDTPU_CONV_BN_FUSION", None)
+            else:
+                os.environ["PDTPU_CONV_BN_FUSION"] = prev
+
+    # kernel-campaign headline arm: Pallas conv+BN epilogue fusion on TPU,
+    # the bitwise XLA composition of the same fused op on CPU. The env
+    # override lets a run force either arm for triage.
+    fusion_mode = os.environ.get("PDTPU_CONV_BN_FUSION",
+                                 "pallas" if on_tpu else "xla")
+    main_prog, startup, loss = _build(fusion_mode)
+    unfused = _build(None)
 
     exe = fluid.Executor(fluid.TPUPlace())
     # own scope: params/optimizer state free when the bench returns —
@@ -302,6 +372,13 @@ def bench_resnet(on_tpu, calib=None):
                                     fetch_list=[loss]), floors)
             except Exception as e:  # trace plumbing must not kill the bench
                 per_kernel = {"error": str(e)[:120]}
+    # A/B arm: same graph without the fused conv+BN op (seed lowering).
+    # Fresh scope so the arms don't share optimizer state.
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(unfused[1])
+        dt_unfused = _time_steps(exe, unfused[0], feed, unfused[2],
+                                 20 if on_tpu else 2)
+    fusion_speedup = round(dt_unfused / dt, 4) if dt > 0 else None
     imgs_per_sec = batch / dt
     # ResNet-50 @224²: ~4.1 GFLOP fwd; fwd+bwd ≈ 3×
     flops_per_img = 3 * 4.1e9 if hw == 224 else 3 * 4.1e9 * (hw / 224) ** 2
@@ -350,6 +427,9 @@ def bench_resnet(on_tpu, calib=None):
         "attribution": {k: (round(v, 4) if isinstance(v, float) else v)
                         for k, v in att.items()},
         "per_kernel": per_kernel,
+        "conv_fusion_mode": fusion_mode,
+        "conv_fusion_speedup": fusion_speedup,
+        "step_ms_unfused": round(dt_unfused * 1e3, 2),
     }
     return (round(imgs_per_sec, 2), round(mfu, 4), round(dt * 1e3, 2),
             roofline)
@@ -1108,7 +1188,11 @@ def bench_nmt(on_tpu):
 
     exe = fluid.Executor(fluid.TPUPlace())
 
-    def run_shape(T, B, n_batches):
+    def _opt_factory():
+        return mp.decorate(fluid.optimizer.Adam(1e-4), dtype="bfloat16",
+                           use_dynamic_loss_scaling=False)
+
+    def run_shape(T, B, n_batches, ab=False):
         Ts = Tt = T
         rng = np.random.RandomState(0)
 
@@ -1122,11 +1206,27 @@ def bench_nmt(on_tpu):
                 yield (src, tgt)
 
         packer = preader.pack_by_tokens(sample_stream, Ts, Tt)
+        # kernel campaign: the headline arm feeds the block-sparse packed
+        # flash-attention kernels the compact [B, T] segment rows instead
+        # of materialized [B, T, T] masks; PDTPU_NMT_ATTN=dense reverts.
+        attn_mode = os.environ.get("PDTPU_NMT_ATTN", "sparse")
         main_p, startup, feeds, loss = nmt.build_train_program(
-            cfg, Ts, Tt, packed=True, optimizer_factory=lambda: mp.decorate(
-                fluid.optimizer.Adam(1e-4), dtype="bfloat16",
-                use_dynamic_loss_scaling=False))
+            cfg, Ts, Tt, packed=True, attn=attn_mode,
+            optimizer_factory=_opt_factory)
         exe.run(startup)
+
+        def to_feed(stack, mode):
+            feed = {"src_ids": stack["src_ids"], "tgt_ids": stack["tgt_ids"],
+                    "lbl_ids": stack["lbl_ids"][..., None],
+                    "src_pos": stack["src_pos"], "tgt_pos": stack["tgt_pos"]}
+            if mode == "sparse":
+                feed["src_seg"] = stack["src_seg"]
+                feed["tgt_seg"] = stack["tgt_seg"]
+            else:
+                em, dm, cm = preader.packed_attention_masks(
+                    stack["src_seg"], stack["tgt_seg"])
+                feed.update(src_mask=em, tgt_mask=dm, cross_mask=cm)
+            return feed
 
         def make_batches():
             rows = []
@@ -1137,19 +1237,16 @@ def bench_nmt(on_tpu):
                     rows = []
 
         batches = []
+        first_stack = None
         fill_tgt = fill_src = 0
         for rows in make_batches():
             stack = {k: np.stack([r[k] for r in rows]) for k in rows[0]}
-            em, dm, cm = preader.packed_attention_masks(stack["src_seg"],
-                                                        stack["tgt_seg"])
+            if first_stack is None:
+                first_stack = stack
             non_pad = int((stack["lbl_ids"] != 0).sum())
             fill_tgt += int((stack["tgt_seg"] != 0).sum())
             fill_src += int((stack["src_seg"] != 0).sum())
-            feed = {"src_ids": stack["src_ids"], "tgt_ids": stack["tgt_ids"],
-                    "lbl_ids": stack["lbl_ids"][..., None],
-                    "src_mask": em, "tgt_mask": dm, "cross_mask": cm,
-                    "src_pos": stack["src_pos"], "tgt_pos": stack["tgt_pos"]}
-            batches.append((feed, non_pad))
+            batches.append((to_feed(stack, attn_mode), non_pad))
             if len(batches) >= n_batches:
                 break
 
@@ -1198,7 +1295,34 @@ def bench_nmt(on_tpu):
         from paddle_tpu.observability import perf
         calib = _calibration(on_tpu)
         att = perf.attribute(flops=total_flops, seconds=dt, calib=calib)
+        per_kernel = None
+        if on_tpu:
+            try:
+                from paddle_tpu.tools.roofline import capture_kernel_table
+                per_kernel = capture_kernel_table(
+                    lambda: exe.run(prog, feed=staged[0][0][0],
+                                    fetch_list=[loss]), calib.floors)
+            except Exception as e:  # trace plumbing must not kill the bench
+                per_kernel = {"error": str(e)[:120]}
+        # dense-mask vs block-sparse A/B on the same packed batch — both
+        # arms run the plain (unplanned) program so the comparison isolates
+        # the attention lowering, not the planner's remat/microbatch choice
+        sparse_speedup = None
+        if ab:
+            ab_ms = {}
+            for mode in ("dense", "sparse"):
+                p2, s2, _, l2 = nmt.build_train_program(
+                    cfg, Ts, Tt, packed=True, attn=mode,
+                    optimizer_factory=_opt_factory)
+                f2 = {k: jnp.asarray(v)
+                      for k, v in to_feed(first_stack, mode).items()}
+                with fluid.scope_guard(fluid.Scope()):
+                    exe.run(s2)
+                    ab_ms[mode] = _time_steps(exe, p2, f2, l2,
+                                              6 if on_tpu else 2)
+            sparse_speedup = round(ab_ms["dense"] / ab_ms["sparse"], 4)
         return {"T": T, "batch": B,
+                "attn": attn_mode,
                 "hbm_plan": plan.to_dict(),
                 "tokens_per_sec": round(total_tok / dt, 1),
                 "step_ms": round(dt / n * 1e3, 2),
@@ -1206,12 +1330,14 @@ def bench_nmt(on_tpu):
                 "roofline_frac": round(att["roofline_fraction"], 4),
                 "calibration_source": calib.source,
                 "fill_rate_tgt": round(fill_tgt / (n * B * Tt), 4),
-                "fill_rate_src": round(fill_src / (n * B * Ts), 4)}
+                "fill_rate_src": round(fill_src / (n * B * Ts), 4),
+                "per_kernel": per_kernel,
+                "sparse_speedup": sparse_speedup}
 
-    results = [run_shape(*s) for s in shapes]
+    results = [run_shape(*s, ab=(i == 0)) for i, s in enumerate(shapes)]
     best = results[0]
     return (best["tokens_per_sec"], best["step_ms"], best["mfu"],
-            len(results), results)
+            len(results), results, best.get("sparse_speedup"))
 
 
 def _bench_ring_attn(extras2):
@@ -1225,11 +1351,25 @@ def _bench_ring_attn(extras2):
     import jax as _jax
     import jax.numpy as _jnp
     from jax.sharding import Mesh as _Mesh
+    from paddle_tpu import planner as _planner
     _RA = importlib.import_module(
         "paddle_tpu.parallel.ring_attention")
+    # batch ladder under the footprint planner: prefer the full 4-row
+    # batch, halve until the analytic live-bytes estimate fits the HBM
+    # budget. The chosen plan rides in the doc (and in any OOM record the
+    # section guard emits) so a residual RESOURCE_EXHAUSTED names it.
+    _cands = []
+    for _K in (1, 2, 4):
+        _b = max(1, 4 // _K)
+        _per_buf = _b * 16 * 4096 * 64 * 2   # one bf16 [b, 16, 4096, 64]
+        # q/k/v + their grads + out + saved fwd residuals + working copies
+        _cands.append((_planner.Plan(0, "none", _K), 12 * _per_buf))
+    _plan = _planner.plan_for_footprint(_cands, where="bench/ring_attn")
+    _B = max(1, 4 // _plan.microbatch)
+    extras2["ring_attn_hbm_plan"] = _plan.to_dict()
     _mesh1 = _Mesh(np.array(_jax.devices()[:1]), ("sp",))
     _key = _jax.random.PRNGKey(0)
-    _q, _k, _v = (_jax.random.normal(kk, (4, 16, 4096, 64),
+    _q, _k, _v = (_jax.random.normal(kk, (_B, 16, 4096, 64),
                                      _jnp.bfloat16)
                   for kk in _jax.random.split(_key, 3))
     _fns = {impl: _jax.jit(
@@ -2283,6 +2423,39 @@ def bench_slo_alerting(on_tpu):
                 os.environ[k] = v
 
 
+def _roofline_diff_vs_baseline(base, rn_roofline, nmt_shapes):
+    """Per-kernel roofline diff (tools/roofline.diff_tables) of this run's
+    live traces vs the baseline doc's recorded tables. Sections without a
+    table on BOTH sides (CPU runs, truncated baselines) are skipped and
+    named in `missing` so absence reads as absence, not as 'no movement'."""
+    from paddle_tpu.tools.roofline import diff_tables
+
+    def _table(d):
+        pk = (d or {}).get("per_kernel")
+        return pk if isinstance(pk, dict) and "kernels" in pk else None
+
+    bex = (base or {}).get("extra") or {}
+    b_shapes = bex.get("nmt_big_shapes") or []
+    pairs = {
+        "resnet50": (_table(bex.get("resnet50_roofline")),
+                     _table(rn_roofline)),
+        "nmt_big": (_table(b_shapes[0] if b_shapes else None),
+                    _table(nmt_shapes[0] if nmt_shapes else None)),
+    }
+    out = {"sections": {}, "missing": []}
+    for name, (old, new) in pairs.items():
+        if old is None or new is None:
+            out["missing"].append(
+                f"{name}: {'baseline' if old is None else 'fresh'}"
+                " table absent")
+            continue
+        try:
+            out["sections"][name] = diff_tables(old, new)
+        except Exception as e:  # diff must not kill the bench
+            out["missing"].append(f"{name}: diff failed: {str(e)[:80]}")
+    return out
+
+
 def main(gate_against=None, recalibrate=False):
     import jax
 
@@ -2378,12 +2551,16 @@ def main(gate_against=None, recalibrate=False):
     # subprocess isolation: the child's allocator (and any OOM ceiling it
     # hit) dies with it, so this section cannot poison the later ones
     res, errrec = _run_section_subprocess("nmt_big", extras2)
+    nmt_sparse_speedup = None
     if res is not None:
         rate, ms, nmt_mfu = res["rate"], res["ms"], res["mfu"]
         nb, nmt_shapes = res["n_shapes"], res["shapes"]
+        nmt_sparse_speedup = res.get("sparse_speedup")
     else:
         err = errrec["error"]
         extras2["nmt_big_flight_dump"] = errrec["flight_dump"]
+        if errrec.get("plan") is not None:
+            extras2["nmt_big_oom_plan"] = errrec["plan"]
     # Pallas ring attention evidence (VERDICT r3 #5, protocol per r4 #7):
     # fwd speedup over the jnp-oracle ring at T=4096 causal on this chip
     # (sp=1 ring — the kernel is the variable; multi-chip ICI isn't
@@ -2399,6 +2576,8 @@ def main(gate_against=None, recalibrate=False):
         else:
             extras2["ring_attn_error"] = errrec["error"]
             extras2["ring_attn_flight_dump"] = errrec["flight_dump"]
+            if errrec.get("plan") is not None:
+                extras2["ring_attn_oom_plan"] = errrec["plan"]
     extras2["ring_attn_pallas_speedup_t4k"] = ring_speedup
 
     # dygraph PreparedOp jit-cache evidence (VERDICT r3 #9): transformer-
@@ -2408,9 +2587,12 @@ def main(gate_against=None, recalibrate=False):
         res, errrec = _run_section_subprocess("dygraph", extras2)
         if res is not None:
             dy = res["dy"]
+            extras2["dygraph_hbm_plan"] = res.get("hbm_plan")
         else:
             extras2["dygraph_bench_error"] = errrec["error"]
             extras2["dygraph_flight_dump"] = errrec["flight_dump"]
+            if errrec.get("plan") is not None:
+                extras2["dygraph_oom_plan"] = errrec["plan"]
     extras2["dygraph_jit_cache_speedup"] = (dy or {}).get("speedup")
     extras2["dygraph_step_ms"] = (dy or {}).get("cached_ms")
     if dy:
@@ -2508,7 +2690,36 @@ def main(gate_against=None, recalibrate=False):
 
     extras2["nmt_big_roofline_frac"] = (nmt_shapes[0].get("roofline_frac")
                                         if nmt_shapes else None)
+    extras2["nmt_big_attn"] = (nmt_shapes[0].get("attn")
+                               if nmt_shapes else None)
+    extras2["nmt_big_sparse_speedup"] = nmt_sparse_speedup
+    extras2["resnet50_conv_fusion_speedup"] = (
+        (rn_roofline or {}).get("conv_fusion_speedup"))
     extras2["calibration"] = calib.to_dict()
+
+    # kernel-campaign sidecar: per-kernel roofline diff of this run's
+    # traces vs the pre-campaign baseline doc (when it carries tables) —
+    # the before/after evidence for the fused conv+BN and block-sparse
+    # attention kernels lands next to BENCH_r0x, not buried in prose
+    base = base_err = None
+    if gate_against:
+        from paddle_tpu.tools.perf_gate import load_doc
+        try:
+            base = load_doc(gate_against)
+        except (OSError, ValueError) as e:
+            base_err = str(e)
+    rdiff = _roofline_diff_vs_baseline(base, rn_roofline, nmt_shapes)
+    if gate_against:
+        stem = os.path.splitext(os.path.basename(gate_against))[0]
+        sidecar = f"ROOFLINE_DIFF_vs_{stem}.json"
+        try:
+            with open(sidecar, "w") as f:
+                json.dump({"baseline": gate_against, "diff": rdiff}, f,
+                          indent=1, sort_keys=True)
+            rdiff = dict(rdiff, sidecar=sidecar)
+        except OSError:
+            pass
+    extras2["roofline_diff"] = rdiff
 
     doc = {
         "metric": "ernie_base_pretrain_tokens_per_sec_per_chip",
@@ -2535,11 +2746,9 @@ def main(gate_against=None, recalibrate=False):
     # the single JSON line the driver parses; the exit code carries the
     # verdict (0 pass, 1 regression, 2 unusable baseline).
     if gate_against:
-        from paddle_tpu.tools.perf_gate import gate, load_doc
-        try:
-            base = load_doc(gate_against)
-        except (OSError, ValueError) as e:
-            print(f"perf_gate: {e}", file=sys.stderr)
+        from paddle_tpu.tools.perf_gate import gate
+        if base is None:
+            print(f"perf_gate: {base_err}", file=sys.stderr)
             return 2
         return gate(doc, base, out=sys.stderr)
     return 0

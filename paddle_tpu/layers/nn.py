@@ -551,6 +551,86 @@ def flash_attention(q: Variable, k: Variable, v: Variable,
     return out
 
 
+def fused_conv_bn(input: Variable, num_filters: int, stride: int = 1,
+                  act: Optional[str] = None,
+                  residual: Optional[Variable] = None,
+                  is_test: bool = False, momentum: float = 0.9,
+                  epsilon: float = 1e-5, param_attr=None, bn_param_attr=None,
+                  bn_bias_attr=None, moving_mean_name=None,
+                  moving_variance_name=None, name=None) -> Variable:
+    """Fused 1×1 conv (no bias) + batch_norm (+relu, +residual) as ONE op.
+
+    The training analog of the inference conv_bn_fuse pass, for the resnet
+    bottleneck tail where conv→BN→(+shortcut)→relu dominates HBM traffic;
+    lowered to the Pallas conv+BN kernel on TPU and to a bitwise-equal XLA
+    composition elsewhere (ops/pallas_kernels/fused_bn.py). Used by
+    models/resnet.py when ``PDTPU_CONV_BN_FUSION`` is enabled."""
+    helper = LayerHelper("fused_conv_bn", name=name)
+    num_channels = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, num_channels, 1, 1],
+        dtype=input.dtype,
+        default_initializer=NormalInitializer(
+            0.0, (2.0 / num_channels) ** 0.5))
+    scale = helper.create_parameter(
+        bn_param_attr, shape=[num_filters], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bn_bias_attr, shape=[num_filters],
+                                   dtype=input.dtype, is_bias=True)
+    mean = helper.create_global_variable(
+        [num_filters], input.dtype, name=moving_mean_name,
+        initializer=ConstantInitializer(0.0))
+    var = helper.create_global_variable(
+        [num_filters], input.dtype, name=moving_variance_name,
+        initializer=ConstantInitializer(1.0))
+    out_shape = None
+    if input.shape is not None and len(input.shape) == 4:
+        out_shape = (input.shape[0], num_filters,
+                     _conv_out_dim(input.shape[2], 1, 0, stride),
+                     _conv_out_dim(input.shape[3], 1, 0, stride))
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    saved_mean = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    ins = {"Input": [input.name], "Filter": [w.name], "Scale": [scale.name],
+           "Bias": [bias.name], "Mean": [mean.name], "Variance": [var.name]}
+    if residual is not None:
+        ins["Residual"] = [residual.name]
+    helper.append_op(
+        type="fused_conv_bn", inputs=ins,
+        outputs={"Y": [out.name], "MeanOut": [mean.name],
+                 "VarianceOut": [var.name], "SavedMean": [saved_mean.name],
+                 "SavedVariance": [saved_var.name]},
+        attrs={"stride": int(stride), "epsilon": epsilon,
+               "momentum": momentum, "act": act or "", "is_test": is_test})
+    return out
+
+
+def flash_attention_sparse(q: Variable, k: Variable, v: Variable,
+                           num_heads: int, q_seg: Variable, k_seg: Variable,
+                           causal: bool = False, dropout_prob: float = 0.0,
+                           is_test: bool = False, name=None) -> Variable:
+    """Block-sparse packed-segment attention on [B, T, H·D] rows.
+
+    Instead of a dense additive [B, 1, Tq, Tk] mask this takes the packed
+    segment-id rows themselves (reader.pack_by_tokens layout: 1-based
+    contiguous ids, 0 = pad tail); visibility is carried as a compact
+    per-row k-range descriptor and fully-masked key blocks are skipped in
+    both forward and backward grids — work scales with real tokens, not
+    padding. See ops/pallas_kernels/flash_attention.py."""
+    helper = LayerHelper("flash_attention_sparse", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    helper.append_op(
+        type="flash_attention_sparse",
+        inputs={"Q": [q.name], "K": [k.name], "V": [v.name],
+                "QSeg": [q_seg.name], "KSeg": [k_seg.name]},
+        outputs={"Out": [out.name]},
+        attrs={"num_heads": int(num_heads), "causal": causal,
+               "dropout_prob": dropout_prob, "is_test": is_test})
+    return out
+
+
 def moe_ffn(input: Variable, num_experts: int, hidden_size: int, k: int = 2,
             capacity_factor: float = 1.25, act: str = "gelu",
             ep_axis: str = "ep", param_attr=None, name=None):

@@ -2,26 +2,61 @@
 conv-heavy MXU workload)."""
 from __future__ import annotations
 
+import os
+
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.param_attr import ParamAttr
 
 
-def conv_bn(x, filters, ksize, stride=1, act=None, name="conv", is_test=False):
+def _fusion_on() -> bool:
+    """PDTPU_CONV_BN_FUSION routes the bottleneck 1×1 conv+BN(+residual+relu)
+    tails through the fused op ("pallas" or "xla" picks its lowering; unset
+    keeps the historical unfused graph)."""
+    return os.environ.get("PDTPU_CONV_BN_FUSION", "") not in ("", "0", "off")
+
+
+def conv_bn(x, filters, ksize, stride=1, act=None, name="conv", is_test=False,
+            residual=None):
+    if ksize == 1 and _fusion_on():
+        return layers.fused_conv_bn(
+            x, filters, stride=stride, act=act, residual=residual,
+            is_test=is_test, param_attr=ParamAttr(name=f"{name}.w"),
+            bn_param_attr=ParamAttr(name=f"{name}.bn.scale"),
+            bn_bias_attr=ParamAttr(name=f"{name}.bn.bias"),
+            moving_mean_name=f"{name}.bn.mean",
+            moving_variance_name=f"{name}.bn.var")
     conv = layers.conv2d(x, filters, ksize, stride=stride,
                          padding=(ksize - 1) // 2, bias_attr=False,
                          param_attr=ParamAttr(name=f"{name}.w"))
-    return layers.batch_norm(conv, act=act, is_test=is_test,
-                             param_attr=ParamAttr(name=f"{name}.bn.scale"),
-                             bias_attr=ParamAttr(name=f"{name}.bn.bias"),
-                             moving_mean_name=f"{name}.bn.mean",
-                             moving_variance_name=f"{name}.bn.var")
+    bn = layers.batch_norm(conv, act=act if residual is None else None,
+                           is_test=is_test,
+                           param_attr=ParamAttr(name=f"{name}.bn.scale"),
+                           bias_attr=ParamAttr(name=f"{name}.bn.bias"),
+                           moving_mean_name=f"{name}.bn.mean",
+                           moving_variance_name=f"{name}.bn.var")
+    if residual is None:
+        return bn
+    out = layers.elementwise_add(bn, residual)
+    return layers.relu(out) if act == "relu" else out
 
 
 def bottleneck(x, filters, stride, name, is_test=False):
     shortcut = x
     in_c = x.shape[1]
     out_c = filters * 4
+    if _fusion_on():
+        # the shortcut is built first so the `.c` fused op can fold the
+        # residual add + relu into its epilogue (one HBM pass for the tail)
+        if stride != 1 or in_c != out_c:
+            shortcut = conv_bn(x, out_c, 1, stride=stride, name=f"{name}.sc",
+                               is_test=is_test)
+        y = conv_bn(x, filters, 1, act="relu", name=f"{name}.a",
+                    is_test=is_test)
+        y = conv_bn(y, filters, 3, stride=stride, act="relu",
+                    name=f"{name}.b", is_test=is_test)
+        return conv_bn(y, out_c, 1, act="relu", name=f"{name}.c",
+                       is_test=is_test, residual=shortcut)
     y = conv_bn(x, filters, 1, act="relu", name=f"{name}.a", is_test=is_test)
     y = conv_bn(y, filters, 3, stride=stride, act="relu", name=f"{name}.b", is_test=is_test)
     y = conv_bn(y, out_c, 1, name=f"{name}.c", is_test=is_test)

@@ -38,7 +38,8 @@ def _attr(name):
     return ParamAttr(name=name, initializer=NormalInitializer(0.0, 0.02))
 
 
-def _mha(cfg, q_in, kv_in, mask, name, is_test=False, cache=None):
+def _mha(cfg, q_in, kv_in, mask, name, is_test=False, cache=None, seg=None,
+         causal=False):
     d, nh, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
     q = layers.fc(q_in, d, num_flatten_dims=2, param_attr=_attr(f"{name}.q.w"),
                   bias_attr=False)
@@ -46,6 +47,18 @@ def _mha(cfg, q_in, kv_in, mask, name, is_test=False, cache=None):
                   bias_attr=False)
     v = layers.fc(kv_in, d, num_flatten_dims=2, param_attr=_attr(f"{name}.v.w"),
                   bias_attr=False)
+
+    if seg is not None:
+        # block-sparse packed-segment path: visibility comes from the
+        # segment-id rows themselves (seg = (q_seg, k_seg)) instead of the
+        # dense additive [B,1,Tq,Tk] mask — fully-padded key blocks are
+        # skipped in the kernel grids (ops/pallas_kernels/flash_attention.py)
+        q_seg, k_seg = seg
+        out = layers.flash_attention_sparse(
+            q, k, v, nh, q_seg, k_seg, causal=causal,
+            dropout_prob=cfg.dropout, is_test=is_test)
+        return layers.fc(out, d, num_flatten_dims=2,
+                         param_attr=_attr(f"{name}.o.w"), bias_attr=False)
 
     def heads(t):
         return layers.transpose(layers.reshape(t, [0, -1, nh, hd]), [0, 2, 1, 3])
@@ -127,29 +140,36 @@ def _embed(cfg, ids, vocab, name, is_test=False, pos=None):
     return emb
 
 
-def encoder(cfg, src_ids, src_mask, is_test=False, pos=None):
+def encoder(cfg, src_ids, src_mask, is_test=False, pos=None, seg=None):
     from ..core.program import remat_unit
     x = _embed(cfg, src_ids, cfg.src_vocab, "src_embedding", is_test, pos=pos)
+    self_seg = (seg, seg) if seg is not None else None
     for i in range(cfg.n_enc):
         name = f"enc_{i}"
         # one remat unit per encoder layer (remat_policy "minimal"/"full")
         with remat_unit(name):
-            x = _ln(_residual(cfg, x, _mha(cfg, x, x, src_mask, f"{name}.self", is_test),
+            x = _ln(_residual(cfg, x, _mha(cfg, x, x, src_mask, f"{name}.self", is_test,
+                                           seg=self_seg),
                               is_test), f"{name}.ln1")
             x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln2")
     return x
 
 
 def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False,
-            pos=None):
+            pos=None, tgt_seg=None, src_seg=None):
     from ..core.program import remat_unit
     x = _embed(cfg, tgt_ids, cfg.tgt_vocab, "tgt_embedding", is_test, pos=pos)
+    sparse = tgt_seg is not None
+    self_seg = (tgt_seg, tgt_seg) if sparse else None
+    cross_seg = (tgt_seg, src_seg) if sparse else None
     for i in range(cfg.n_dec):
         name = f"dec_{i}"
         with remat_unit(name):
-            x = _ln(_residual(cfg, x, _mha(cfg, x, x, self_mask, f"{name}.self", is_test),
+            x = _ln(_residual(cfg, x, _mha(cfg, x, x, self_mask, f"{name}.self", is_test,
+                                           seg=self_seg, causal=sparse),
                               is_test), f"{name}.ln1")
-            x = _ln(_residual(cfg, x, _mha(cfg, x, enc_out, cross_mask, f"{name}.cross", is_test),
+            x = _ln(_residual(cfg, x, _mha(cfg, x, enc_out, cross_mask, f"{name}.cross", is_test,
+                                           seg=cross_seg),
                               is_test), f"{name}.ln2")
             x = _ln(_residual(cfg, x, _ffn(cfg, x, name, is_test), is_test), f"{name}.ln3")
     return layers.fc(x, cfg.tgt_vocab, num_flatten_dims=2,
@@ -158,7 +178,7 @@ def decoder(cfg, tgt_ids, enc_out, self_mask, cross_mask, is_test=False,
 
 def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
                         lr=1e-3, is_test=False, optimizer_factory=None,
-                        packed=False):
+                        packed=False, attn="dense"):
     """Masks are fed as additive float tensors (0 keep / -1e4 drop).
 
     Bucketed (default): src_mask [B,1,1,Ts] (pad); tgt self-mask
@@ -168,14 +188,32 @@ def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
     sentences share a row, so every mask is segment-block-diagonal and
     FULL rank: src_mask [B,1,Ts,Ts], tgt_mask [B,1,Tt,Tt], a separate
     cross_mask [B,1,Tt,Ts], plus per-token position ids (positions
-    restart at each packed sentence) fed as src_pos/tgt_pos."""
+    restart at each packed sentence) fed as src_pos/tgt_pos.
+
+    ``attn="sparse"`` (packed only): the dense masks never exist — the
+    segment-id rows themselves are fed (src_seg/tgt_seg [B,T] int32) and
+    attention runs through the block-sparse flash kernels, which skip
+    fully-padded key blocks in the fwd and bwd grids. Hard segment masking
+    (exact zeros) instead of additive -1e4."""
+    if attn not in ("dense", "sparse"):
+        raise ValueError(f"attn must be 'dense' or 'sparse', got {attn!r}")
+    if attn == "sparse" and not packed:
+        raise ValueError("attn='sparse' requires packed=True (the segment "
+                         "descriptor comes from pack_by_tokens rows)")
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
         src = layers.data("src_ids", [src_len], dtype="int64")
         tgt = layers.data("tgt_ids", [tgt_len], dtype="int64")
         lbl = layers.data("lbl_ids", [tgt_len, 1], dtype="int64")
-        if packed:
+        src_seg = tgt_seg = None
+        if packed and attn == "sparse":
+            src_seg = layers.data("src_seg", [src_len], dtype="int32")
+            tgt_seg = layers.data("tgt_seg", [tgt_len], dtype="int32")
+            src_mask = tgt_mask = cross_mask = None
+            src_pos = layers.data("src_pos", [src_len], dtype="int64")
+            tgt_pos = layers.data("tgt_pos", [tgt_len], dtype="int64")
+        elif packed:
             src_mask = layers.data("src_mask", [1, src_len, src_len])
             tgt_mask = layers.data("tgt_mask", [1, tgt_len, tgt_len])
             cross_mask = layers.data("cross_mask", [1, tgt_len, src_len])
@@ -185,9 +223,10 @@ def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
             src_mask = layers.data("src_mask", [1, 1, src_len])
             tgt_mask = layers.data("tgt_mask", [1, tgt_len, tgt_len])
             cross_mask, src_pos, tgt_pos = src_mask, None, None
-        enc_out = encoder(cfg, src, src_mask, is_test, pos=src_pos)
+        enc_out = encoder(cfg, src, src_mask, is_test, pos=src_pos,
+                          seg=src_seg)
         logits = decoder(cfg, tgt, enc_out, tgt_mask, cross_mask, is_test,
-                         pos=tgt_pos)
+                         pos=tgt_pos, tgt_seg=tgt_seg, src_seg=src_seg)
         loss_tok = layers.softmax_with_cross_entropy(logits, lbl, ignore_index=0)
         valid = layers.cast(layers.not_equal(
             lbl, layers.fill_constant([1], "int64", 0)), "float32")
@@ -197,9 +236,13 @@ def build_train_program(cfg: TransformerConfig, src_len: int, tgt_len: int,
         opt = (optimizer_factory() if optimizer_factory
                else fluid.optimizer.Adam(lr))
         opt.minimize(loss)
-    feeds = ["src_ids", "tgt_ids", "lbl_ids", "src_mask", "tgt_mask"]
-    if packed:
-        feeds += ["cross_mask", "src_pos", "tgt_pos"]
+    feeds = ["src_ids", "tgt_ids", "lbl_ids"]
+    if packed and attn == "sparse":
+        feeds += ["src_seg", "tgt_seg", "src_pos", "tgt_pos"]
+    elif packed:
+        feeds += ["src_mask", "tgt_mask", "cross_mask", "src_pos", "tgt_pos"]
+    else:
+        feeds += ["src_mask", "tgt_mask"]
     return main, startup, feeds, loss
 
 

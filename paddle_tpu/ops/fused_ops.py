@@ -44,6 +44,67 @@ def _fused_fc(ctx, inputs, attrs):
     return one(out.reshape(lead + (w.shape[-1],)))
 
 
+@register_op("fused_conv_bn", nondiff_inputs=["Mean", "Variance"])
+def _fused_conv_bn(ctx, inputs, attrs):
+    """1×1-conv + batch_norm (+relu, +residual) as one op — the training
+    analog of the inference conv_bn_fuse pass, for the resnet bottleneck
+    tail. Pallas on TPU (or under FORCE_PALLAS_INTERPRET); otherwise an
+    XLA composition with the exact math of the separate conv2d +
+    batch_norm("xla1") (+elementwise_add+relu) lowerings, bitwise-equal
+    end to end. ``PDTPU_CONV_BN_FUSION=xla`` forces the composition."""
+    import os
+
+    from jax import lax
+
+    from .pallas_kernels import fused_bn
+
+    (x,) = inputs["Input"]
+    (w,) = inputs["Filter"]
+    (scale,) = inputs["Scale"]
+    (bias,) = inputs["Bias"]
+    (mean,) = inputs["Mean"]
+    (var,) = inputs["Variance"]
+    residual = opt_input(inputs, "Residual")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    act = attrs.get("act", "")
+    stride = int(attrs.get("stride", 1))
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    mode = os.environ.get("PDTPU_CONV_BN_FUSION", "pallas")
+    if w.dtype != x.dtype:
+        # AMP casts the activations at the op boundary but doesn't know
+        # this op's Filter slot; round the f32 master weight to the
+        # compute dtype here — same rounding the unfused conv2d path gets
+        # from its inserted cast op (scale/bias/stats stay f32)
+        w = w.astype(x.dtype)
+
+    if is_test:
+        y, _, _ = fused_bn.conv_bn_xla(x, w, scale, bias, eps, act, stride,
+                                       residual, use_mean=mean, use_var=var)
+        return {"Y": [y], "MeanOut": [mean], "VarianceOut": [var],
+                "SavedMean": [mean], "SavedVariance": [var]}
+
+    use_pallas = (mode != "xla"
+                  and fused_bn.conv_bn_supports(x.shape, w.shape, stride)
+                  and (fused_bn._on_tpu() or fused_bn.FORCE_PALLAS_INTERPRET))
+    if use_pallas:
+        y, bmean, bvar = fused_bn.fused_conv_bn_act(
+            x, w, scale, bias, eps, act, stride, residual is not None,
+            residual)
+    else:
+        y, bmean, bvar = fused_bn.conv_bn_xla(x, w, scale, bias, eps, act,
+                                              stride, residual)
+    mean_out = momentum * mean + (1.0 - momentum) * bmean
+    var_out = momentum * var + (1.0 - momentum) * bvar
+    return {
+        "Y": [y],
+        "MeanOut": [lax.stop_gradient(mean_out)],
+        "VarianceOut": [lax.stop_gradient(var_out)],
+        "SavedMean": [lax.stop_gradient(bmean)],
+        "SavedVariance": [lax.stop_gradient(bvar)],
+    }
+
+
 @register_op("flash_attention", nondiff_inputs=["BiasQK"])
 def _flash_attention(ctx, inputs, attrs):
     """Memory-efficient fused attention (Pallas on TPU, blockwise JAX
@@ -77,4 +138,30 @@ def _flash_attention(ctx, inputs, attrs):
             dropout_rate=0.0 if is_test else rate, dropout_key=key))
     return one(_fa.flash_attention(
         q, k, v, bias=bias, causal=attrs.get("causal", False),
+        dropout_rate=0.0 if is_test else rate, dropout_key=key))
+
+
+@register_op("flash_attention_sparse", nondiff_inputs=["QSeg", "KSeg"])
+def _flash_attention_sparse(ctx, inputs, attrs):
+    """Block-sparse packed-segment attention: visibility travels as the
+    packed segment-id rows instead of a dense [B, 1, Tq, Tk] additive mask,
+    and fully-masked K blocks are skipped in the fwd and bwd kernel grids.
+    See the block-sparse section of ops/pallas_kernels/flash_attention.py."""
+    import importlib
+    _fa = importlib.import_module(
+        "paddle_tpu.ops.pallas_kernels.flash_attention")
+
+    (q,) = inputs["Q"]
+    (k,) = inputs["K"]
+    (v,) = inputs["V"]
+    (q_seg,) = inputs["QSeg"]
+    (k_seg,) = inputs["KSeg"]
+    rate = attrs.get("dropout_prob", 0.0)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    key = None
+    if rate > 0.0 and not is_test:
+        key = ctx.rng()
+    return one(_fa.flash_attention_packed_sparse(
+        q, k, v, attrs["num_heads"], q_seg, k_seg,
+        causal=attrs.get("causal", False),
         dropout_rate=0.0 if is_test else rate, dropout_key=key))

@@ -66,6 +66,11 @@ except Exception:  # pragma: no cover
     pl = pltpu = None
     _HAVE_PALLAS = False
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or getattr(pltpu, "TPUCompilerParams", None)
+                   if _HAVE_PALLAS else None)
+
 
 # ---------------------------------------------------------------------------
 # In-kernel dropout: per-(b, q-block, k-block) reseed of the core PRNG, so
@@ -230,7 +235,7 @@ def _flash_fwd_pallas(q, k, v, bias, sm_scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, *args)
@@ -335,7 +340,7 @@ def _flash_fwd_pallas_onepass(q, k, v, bias, sm_scale, causal, group,
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(seed, *args)
@@ -464,7 +469,7 @@ def _flash_bwd_pallas_onepass(q, k, v, bias, g, lse, out, sm_scale, causal,
             out_specs=out_specs,
         ),
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(seed, *args)
@@ -720,7 +725,7 @@ def _flash_bwd_pallas(q, k, v, bias, g, lse, out, sm_scale, causal,
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, *args)
@@ -796,7 +801,7 @@ def _flash_bwd_pallas(q, k, v, bias, g, lse, out, sm_scale, causal,
             scratch_shapes=scratch2,
         ),
         out_shape=out_shape2,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, *args2)
@@ -1011,7 +1016,10 @@ def _interpret_arg(dropout_rate: float):
         return False
     # dropout kernels call pltpu.prng_*, which only the TPU-semantics
     # interpreter accepts (it returns zero bits — numerics are TPU-only)
-    return pltpu.InterpretParams() if dropout_rate > 0.0 else True
+    if dropout_rate > 0.0:
+        ip = getattr(pltpu, "InterpretParams", None)
+        return ip() if ip is not None else True
+    return True
 
 
 def _flash_bwd_block_dispatch(q, k, v, g, lse, out, sm_scale, causal):
@@ -1091,6 +1099,621 @@ def _flash_core_bwd(sm_scale, causal, dropout_rate, res, g):
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Block-sparse packed-segment attention.
+#
+# Bucketed-length batches (reader.pack_by_tokens) carried a dense additive
+# [B, 1, Tq, Tk] mask through the dense kernels — every fully-padded K block
+# still paid its MXU matmul and its HBM DMA. Here visibility travels as a
+# COMPACT PER-ROW DESCRIPTOR instead: segment ids are 1-based, contiguous and
+# ascending within a packed row (0 = pad tail), so each query token sees
+# exactly one contiguous [start, end) range of K positions — two uint16s,
+# packed into one int32 as (start << 16) | end. The descriptor is 2·T bytes
+# per row instead of Tq·Tk·4 of bias.
+#
+# From the descriptor the wrapper derives a per-(q-block, k-block) visibility
+# table [B, nq, nk] which rides the scalar-prefetch channel; kernels wrap
+# their body in `pl.when(vis > 0)`, so fully-masked K blocks are SKIPPED in
+# the fwd grid and in both bwd grids — work scales with real tokens, not
+# padding. Skipping is numerically invisible by construction: masked
+# probabilities are zeroed exactly (`p = where(mask, p, 0)`), so a processed
+# fully-masked block contributes exactly 0 to acc/l and leaves the running
+# max untouched — bit-identical to never visiting it (the vis table may even
+# be all-ones and nothing changes; tests pin this contract). Fully-masked
+# rows produce out = 0, lse = −1e30 and zero gradients. Dropout streams are
+# keyed by the logical (b, q-block, k-block) index exactly like the dense
+# kernels, so masks are identical regardless of skipping and across fwd/bwd.
+#
+# In-kernel the element mask needs no K-side array at all: k positions are
+# an iota, q rows read their packed range from the descriptor (fed
+# lane-replicated [B, Tq, 128] — the same trick the lse/delta residuals use —
+# and indexed by `b // nh`, so it is stored once per batch row, not per
+# head).
+# ---------------------------------------------------------------------------
+
+def _pack_se(q_seg, k_seg):
+    """[B, Tq], [B, Tk] segment-id rows (1-based contiguous ascending,
+    0 = pad) → packed per-q-row K ranges [B, Tq] int32, (start << 16) | end.
+    Pad rows get the empty range [0, 0)."""
+    if k_seg.shape[1] >= (1 << 15):
+        raise ValueError(
+            f"block-sparse flash_attention: Tk={k_seg.shape[1]} overflows "
+            f"the 16-bit packed range descriptor")
+    q_seg = q_seg.astype(jnp.int32)
+    k_seg = k_seg.astype(jnp.int32)
+    # pad keys (0) must sort AFTER every real segment id
+    kk = jnp.where(k_seg > 0, k_seg, jnp.int32(1 << 30))
+    start = jax.vmap(
+        lambda a, v: jnp.searchsorted(a, v, side="left"))(kk, q_seg)
+    end = jax.vmap(
+        lambda a, v: jnp.searchsorted(a, v, side="right"))(kk, q_seg)
+    start = jnp.where(q_seg > 0, start, 0).astype(jnp.int32)
+    end = jnp.where(q_seg > 0, end, 0).astype(jnp.int32)
+    return (start << 16) | end
+
+
+def _compute_block_vis(se, tq, tk, block_q, block_k, causal):
+    """Per-(q-block, k-block) visibility [B, nq, nk] int32 from the packed
+    descriptor — conservative: a false-positive visible block is numerically
+    invisible (the kernels re-apply the element mask and zero masked
+    probabilities), so correctness never depends on this table. Tests
+    monkeypatch it to all-ones to pin the skip-is-bitwise-free contract."""
+    b = se.shape[0]
+    nq, nk = tq // block_q, tk // block_k
+    start = se >> 16
+    end = se & 0xFFFF
+    has = start < end
+    sblk = jnp.where(has, start, tk).reshape(b, nq, block_q).min(axis=-1)
+    eblk = jnp.where(has, end, 0).reshape(b, nq, block_q).max(axis=-1)
+    k0 = jnp.arange(nk, dtype=jnp.int32) * block_k                 # [nk]
+    vis = ((sblk[:, :, None] < k0[None, None, :] + block_k)
+           & (eblk[:, :, None] > k0[None, None, :]))
+    if causal:
+        # same block-level test as the dense kernels' causal skip
+        q_end = jnp.arange(nq, dtype=jnp.int32) * block_q + block_q - 1
+        vis &= k0[None, None, :] <= q_end[None, :, None]
+    return vis.astype(jnp.int32)
+
+
+def _sparse_elem_mask(se_ref, iq, ik, block_q, block_k, causal):
+    """[bq, bk] bool element mask from the lane-replicated descriptor
+    block (k positions are a global iota — no K-side array)."""
+    se = se_ref[0]                                        # [bq, 128] int32
+    start = lax.shift_right_logical(se, 16)[:, :1]        # [bq, 1]
+    end = (se & 0xFFFF)[:, :1]
+    k_pos = ik * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = (k_pos >= start) & (k_pos < end)
+    if causal:
+        q_pos = iq * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask &= q_pos >= k_pos
+    return mask
+
+
+def _fwd_kernel_sparse(seed_ref, vis_ref, q_ref, k_ref, v_ref, se_ref, o_ref,
+                       lse_ref, acc_ref, m_ref, l_ref, *, sm_scale, causal,
+                       block_q, block_k, dropout_rate, nh):
+    b, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nq, nk = pl.num_programs(1), pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        mask = _sparse_elem_mask(se_ref, iq, ik, block_q, block_k, causal)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # zeroing (not just −1e30) keeps masked columns exact even while m
+        # is still at its −1e30 init (exp(0) = 1 would otherwise leak) —
+        # this is what makes block skipping bit-identical to processing
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref, _block_index(b, iq, ik, nq, nk),
+                              (block_q, block_k), dropout_rate)
+            p_v = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+        else:
+            p_v = p
+        v_blk = v_ref[0]
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p_v.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(vis_ref[((b // nh) * nq + iq) * nk + ik] > 0)
+    def _():
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd_pallas_sparse(q, k, v, se_rep, vis, nh, sm_scale, causal,
+                             block_q, block_k, interpret=False,
+                             dropout_rate=0.0, seed=None):
+    """q, k, v: [B·nh, Tq/Tk, D] folded; se_rep: [B, Tq, 128]
+    lane-replicated packed descriptor; vis: flat [B·nq·nk] int32.
+    Returns (out, lse [B·nh, Tq])."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // block_q, tk // block_k
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+
+    kernel = functools.partial(_fwd_kernel_sparse, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, dropout_rate=dropout_rate,
+                               nh=nh)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, i, j, *_: (b // nh, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, i, j, *_: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, _LANES), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, vis, q, k, v, se_rep)
+    return out, lse[:, :, 0]
+
+
+def _bwd_dq_kernel_sparse(seed_ref, vis_ref, q_ref, k_ref, v_ref, se_ref,
+                          g_ref, lse_ref, delta_ref, dq_ref, dq_acc, *,
+                          sm_scale, causal, block_q, block_k, dropout_rate,
+                          nh):
+    b, iq, ik = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nq, nk = pl.num_programs(1), pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        mask = _sparse_elem_mask(se_ref, iq, ik, block_q, block_k, causal)
+        lse = lse_ref[0][:, :1]
+        # fully-masked rows have lse = −1e30 → exp(s − lse) would be
+        # exp(0) = 1; the zeroing is load-bearing, same as forward
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref, _block_index(b, iq, ik, nq, nk),
+                              (block_q, block_k), dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta)
+        ds_c = ds.astype(k.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds_c, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(vis_ref[((b // nh) * nq + iq) * nk + ik] > 0)
+    def _():
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_sparse(seed_ref, vis_ref, q_ref, k_ref, v_ref, se_ref,
+                           g_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc,
+                           dv_acc, *, sm_scale, causal, block_q, block_k,
+                           dropout_rate, nh):
+    # grid is (bh, nk, nq): k-block outer, q-block inner
+    b, ik, iq = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk, nq = pl.num_programs(1), pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        mask = _sparse_elem_mask(se_ref, iq, ik, block_q, block_k, causal)
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # same (b, iq, ik) index as the fwd/dq kernels → identical mask
+            keep = _keep_mask(seed_ref, _block_index(b, iq, ik, nq, nk),
+                              (block_q, block_k), dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            p_v = p
+        dv_acc[...] += jax.lax.dot_general(
+            p_v.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta)
+        ds_c = ds.astype(q.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds_c, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(vis_ref[((b // nh) * nq + iq) * nk + ik] > 0)
+    def _():
+        _body()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas_sparse(q, k, v, se_rep, vis, nh, g, lse, out, sm_scale,
+                             causal, block_q, block_k, dropout_rate=0.0,
+                             seed=None, interpret=False):
+    """Returns (dq, dk, dv); same skip table as forward steers both grids."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // block_q, tk // block_k
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    gf, lse_r, delta_r = _bwd_host_prep(q, g, lse, out)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel_sparse, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate, nh=nh)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, i, j, *_: (b // nh, i, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, i, j, *_: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda b, i, j, *_: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, vis, q, k, v, se_rep, gf, lse_r, delta_r)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel_sparse, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate, nh=nh)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, j, i, *_: (b // nh, i, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, j, i, *_: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, vis, q, k, v, se_rep, gf, lse_r, delta_r)
+    return dq, dk, dv
+
+
+# ---- blockwise JAX path (CPU tests / fallback), same masked math ----------
+
+def _sparse_mask_block(start, end, j0, bk, tq, causal):
+    """[BH, Tq, bk] bool mask for the K block at offset j0. start/end:
+    [BH, Tq] (per-head-repeated descriptor halves)."""
+    k_pos = j0 + lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
+    mask = ((k_pos[None] >= start[:, :, None])
+            & (k_pos[None] < end[:, :, None]))
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
+        mask &= q_pos[None] >= k_pos[None]
+    return mask
+
+
+def _flash_fwd_jax_sparse(q, k, v, start, end, sm_scale, causal, block_k,
+                          dropout_rate=0.0, dropout_key=None):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nk = tk // block_k
+
+    def scores(j):
+        k_blk = lax.dynamic_slice_in_dim(k, j * block_k, block_k, 1)
+        s = jnp.einsum("bqd,bkd->bqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * sm_scale
+        mask = _sparse_mask_block(start, end, j * block_k, block_k, tq,
+                                  causal)
+        return jnp.where(mask, s, _NEG_INF), mask
+
+    def pass1(carry, j):
+        m, l = carry
+        s, mask = scores(j)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l = l * jnp.exp(m - m_new) + jnp.sum(p, -1, keepdims=True)
+        return (m_new, l), None
+
+    m0 = jnp.full((bh, tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, tq, 1), jnp.float32)
+    (m, l), _ = lax.scan(pass1, (m0, l0), jnp.arange(nk))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    lse = (m + jnp.log(l_safe))[..., 0]
+
+    def pass2(acc, j):
+        s, mask = scores(j)
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+        p = _apply_dropout(p, dropout_rate, dropout_key, j)
+        v_blk = lax.dynamic_slice_in_dim(v, j * block_k, block_k, 1)
+        acc = acc + jnp.einsum("bqk,bkd->bqd", p.astype(v_blk.dtype), v_blk,
+                               preferred_element_type=jnp.float32)
+        return acc, None
+
+    out, _ = lax.scan(pass2, jnp.zeros((bh, tq, d), jnp.float32),
+                      jnp.arange(nk))
+    return out.astype(q.dtype), lse
+
+
+def _flash_bwd_jax_sparse(res, g, *, sm_scale, causal, block_k,
+                          dropout_rate):
+    q, k, v, start, end, dropout_key, out, lse = res
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nk = tk // block_k
+    cdt = q.dtype
+    gc = g.astype(cdt)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def step(dq, j):
+        j0 = j * block_k
+        k_blk = lax.dynamic_slice_in_dim(k, j0, block_k, 1)
+        v_blk = lax.dynamic_slice_in_dim(v, j0, block_k, 1)
+        s = jnp.einsum("bqd,bkd->bqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * sm_scale
+        mask = _sparse_mask_block(start, end, j0, block_k, tq, causal)
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+        p_d = _apply_dropout(p, dropout_rate, dropout_key, j)
+        dv_j = jnp.einsum("bqk,bqd->bkd", p_d.astype(cdt), gc,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqd,bkd->bqk", gc, v_blk.astype(cdt),
+                        preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0 and dropout_key is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(dropout_key, j), 1.0 - dropout_rate,
+                p.shape)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta)
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds.astype(cdt), q.astype(cdt),
+                          preferred_element_type=jnp.float32) * sm_scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds.astype(cdt),
+                             k_blk.astype(cdt),
+                             preferred_element_type=jnp.float32) * sm_scale
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((bh, tq, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(step, dq0, jnp.arange(nk))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, tk, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, tk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---- dispatch + custom_vjp ------------------------------------------------
+
+def _sparse_pallas_ok(tq: int, tk: int, d: int,
+                      dropout_rate: float = 0.0) -> bool:
+    bq, _ = _pick_blocks(tq)
+    bk, _ = _pick_blocks(tk)
+    if dropout_rate > 0.0 and not _on_tpu() and not hasattr(
+            pltpu, "InterpretParams"):
+        # the dropout kernels call pltpu.prng_*, which off-TPU needs the
+        # TPU-semantics interpreter; older jax doesn't expose it — use the
+        # jax fallback there (fwd and bwd agree: both see dropout_rate)
+        return False
+    return (_HAVE_PALLAS and (_on_tpu() or FORCE_PALLAS_INTERPRET)
+            and bq is not None and bk is not None
+            and bq >= 64 and bk >= 64 and d % 64 == 0)
+
+
+def _se_halves_folded(se, nh):
+    """Descriptor halves as per-head-repeated [B·nh, Tq] arrays for the jax
+    fallback (folded layout is batch-major: index = b·nh + h)."""
+    start = jnp.repeat(se >> 16, nh, axis=0)
+    end = jnp.repeat(se & 0xFFFF, nh, axis=0)
+    return start, end
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_sparse_core(q, k, v, se, dropout_key, nh, sm_scale, causal,
+                       dropout_rate):
+    out, _ = _flash_sparse_fwd_dispatch(q, k, v, se, dropout_key, nh,
+                                        sm_scale, causal, dropout_rate)
+    return out
+
+
+def _flash_sparse_fwd_dispatch(q, k, v, se, dropout_key, nh, sm_scale,
+                               causal, dropout_rate):
+    tq, d = q.shape[1], q.shape[2]
+    tk = k.shape[1]
+    bq, _ = _pick_blocks(tq)
+    bk, _ = _pick_blocks(tk)
+    if _sparse_pallas_ok(tq, tk, d, dropout_rate):
+        vis = _compute_block_vis(se, tq, tk, bq, bk, causal).reshape(-1)
+        se_rep = jnp.broadcast_to(se[:, :, None],
+                                  (se.shape[0], tq, _LANES))
+        seed = (_seed_from_key(dropout_key) if dropout_rate > 0.0 else None)
+        return _flash_fwd_pallas_sparse(
+            q, k, v, se_rep, vis, nh, sm_scale, causal, bq, bk,
+            interpret=_interpret_arg(dropout_rate),
+            dropout_rate=dropout_rate, seed=seed)
+    if bk is None:
+        raise ValueError(
+            f"flash_attention_sparse: seq len {tk} has no power-of-two "
+            f"block divisor ≥8; pad the sequence")
+    start, end = _se_halves_folded(se, nh)
+    key = dropout_key if dropout_rate > 0.0 else None
+    return _flash_fwd_jax_sparse(q, k, v, start, end, sm_scale, causal, bk,
+                                 dropout_rate, key)
+
+
+def _flash_sparse_core_fwd(q, k, v, se, dropout_key, nh, sm_scale, causal,
+                           dropout_rate):
+    out, lse = _flash_sparse_fwd_dispatch(q, k, v, se, dropout_key, nh,
+                                          sm_scale, causal, dropout_rate)
+    key = dropout_key if dropout_rate > 0.0 else None
+    return out, (q, k, v, se, key, out, lse)
+
+
+def _flash_sparse_core_bwd(nh, sm_scale, causal, dropout_rate, res, g):
+    q, k, v, se, key, out, lse = res
+    tq, d = q.shape[1], q.shape[2]
+    tk = k.shape[1]
+    bq, _ = _pick_blocks(tq)
+    bk, _ = _pick_blocks(tk)
+    if _sparse_pallas_ok(tq, tk, d, dropout_rate):
+        vis = _compute_block_vis(se, tq, tk, bq, bk, causal).reshape(-1)
+        se_rep = jnp.broadcast_to(se[:, :, None],
+                                  (se.shape[0], tq, _LANES))
+        seed = (_seed_from_key(key) if dropout_rate > 0.0 else None)
+        dq, dk, dv = _flash_bwd_pallas_sparse(
+            q, k, v, se_rep, vis, nh, g, lse, out, sm_scale, causal, bq, bk,
+            dropout_rate=dropout_rate, seed=seed,
+            interpret=_interpret_arg(dropout_rate))
+    else:
+        start, end = _se_halves_folded(se, nh)
+        dq, dk, dv = _flash_bwd_jax_sparse(
+            (q, k, v, start, end, key, out, lse), g, sm_scale=sm_scale,
+            causal=causal, block_k=bk, dropout_rate=dropout_rate)
+    dse = np.zeros(np.shape(se), jax.dtypes.float0)
+    dkey = (None if key is None
+            else np.zeros(np.shape(key), jax.dtypes.float0))
+    return dq, dk, dv, dse, dkey
+
+
+_flash_sparse_core.defvjp(_flash_sparse_core_fwd, _flash_sparse_core_bwd)
+
+
+def flash_attention_packed_sparse(q, k, v, num_heads: int, q_seg, k_seg,
+                                  causal: bool = False,
+                                  sm_scale: Optional[float] = None,
+                                  dropout_rate: float = 0.0,
+                                  dropout_key=None):
+    """Block-sparse packed-segment attention on [B, T, H] tensors.
+
+    q_seg/k_seg are the packed segment-id rows (reader.pack_by_tokens
+    layout: 1-based contiguous ascending ids, 0 = pad tail) — the dense
+    additive [B, 1, Tq, Tk] mask never exists. Supports self attention
+    (q_seg is k_seg, optionally causal) and cross attention (Tq ≠ Tk).
+    Fully-masked rows (pad queries) return exactly 0. Returns [B, T, H]."""
+    b_, tq, hdim = q.shape
+    tk = k.shape[1]
+    if hdim % num_heads:
+        raise ValueError(f"hidden {hdim} not divisible by heads {num_heads}")
+    d = hdim // num_heads
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(
+            f"flash_attention_sparse: dropout_rate must be in [0, 1), got "
+            f"{dropout_rate}")
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError(
+            "flash_attention_sparse: dropout_rate > 0 requires a "
+            "dropout_key; pass one or set dropout_rate=0 for inference")
+    if causal and tq != tk:
+        raise ValueError("flash_attention_sparse: causal requires Tq == Tk")
+    if q_seg.shape != (b_, tq) or k_seg.shape != (b_, tk):
+        raise ValueError(
+            f"flash_attention_sparse: seg shapes {q_seg.shape}/"
+            f"{k_seg.shape} do not match q/k [{b_}, {tq}]/[{b_}, {tk}]")
+    if dropout_rate == 0.0:
+        dropout_key = None
+    se = _pack_se(q_seg, k_seg)
+    qf, kf, vf = (_pack_to_folded(x, num_heads) for x in (q, k, v))
+    out = _flash_sparse_core(qf, kf, vf, se, dropout_key, num_heads,
+                             float(sm_scale), bool(causal),
+                             float(dropout_rate))
+    return _folded_to_pack(out, b_)
 
 
 def flash_attention(q, k, v, bias=None, causal: bool = False,

@@ -276,17 +276,15 @@ def _fused_fwd(x, scale, bias, eps, act, residual_tag, residual=None):
     return (y, mean, var), (x, scale, mean, inv, saved_y)
 
 
-def _fused_bwd(eps, act, residual_tag, saved, cots):
-    x, scale, mean, inv, saved_y = saved
-    dy, _dmean, _dvar = cots  # mean/var feed stop-gradient running stats
-    interpret = FORCE_PALLAS_INTERPRET
-    n, c, h, w = x.shape
-    k = _fold(c)
-    m = float(n * h * w)
-    x2 = _nhwc_2d(x)
-    dy2 = _nhwc_2d(dy)
+def _bn_bwd_2d(dy2, x2, y2, mean, inv, scale, act, has_res, m, k, interpret):
+    """2-D core of the fused-BN backward on the channel-minor (M/k, k·C)
+    view: one reduction pass (dbeta, dgamma), one dx pass (+dresidual when
+    the residual add was fused). mean/inv/scale are per-channel f32 [C];
+    returns (dx2, dgamma, dbeta, dres2_or_None). Shared by the plain
+    fused-BN vjp and the conv+BN vjp (where x2 is the conv output)."""
     mk, ck = x2.shape
-    bm = _pick_bm(mk, ck, x.dtype.itemsize)
+    c = ck // k
+    bm = _pick_bm(mk, ck, x2.dtype.itemsize)
     vec = pl.BlockSpec((1, ck), lambda mb: (0, 0))
     big = pl.BlockSpec((bm, ck), lambda mb: (mb, 0))
 
@@ -296,7 +294,7 @@ def _fused_bwd(eps, act, residual_tag, saved, cots):
     args = [dy2, x2]
     in_specs = [big, big]
     if act == "relu":
-        args.append(_nhwc_2d(saved_y))
+        args.append(y2)
         in_specs.append(big)
     args += [meanv, invv]
     in_specs += [vec, vec]
@@ -313,17 +311,16 @@ def _fused_bwd(eps, act, residual_tag, saved, cots):
     dbeta = dbeta2.reshape(k, c).sum(axis=0)
     dgamma = dgamma2.reshape(k, c).sum(axis=0)
 
-    has_res = residual_tag
     isc = inv * scale.astype(jnp.float32)
     args2 = args + [jnp.tile(isc, k).reshape(1, ck),
                     jnp.tile(dbeta, k).reshape(1, ck),
                     jnp.tile(dgamma, k).reshape(1, ck)]
     in_specs2 = in_specs + [vec, vec, vec]
     out_specs = [big]
-    out_shape = [jax.ShapeDtypeStruct((mk, ck), x.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((mk, ck), x2.dtype)]
     if has_res:
         out_specs.append(big)
-        out_shape.append(jax.ShapeDtypeStruct((mk, ck), x.dtype))
+        out_shape.append(jax.ShapeDtypeStruct((mk, ck), x2.dtype))
     outs = pl.pallas_call(
         functools.partial(_bwd_dx_kernel, act=act, has_res=has_res, m=m),
         grid=(mk // bm,),
@@ -332,11 +329,248 @@ def _fused_bwd(eps, act, residual_tag, saved, cots):
         out_shape=out_shape,
         interpret=interpret,
     )(*args2)
-    dx = _un_nhwc(outs[0], x.shape)
+    return outs[0], dgamma, dbeta, (outs[1] if has_res else None)
+
+
+def _fused_bwd(eps, act, residual_tag, saved, cots):
+    x, scale, mean, inv, saved_y = saved
+    dy, _dmean, _dvar = cots  # mean/var feed stop-gradient running stats
+    interpret = FORCE_PALLAS_INTERPRET
+    n, c, h, w = x.shape
+    k = _fold(c)
+    m = float(n * h * w)
+    y2 = _nhwc_2d(saved_y) if act == "relu" else None
+    dx2, dgamma, dbeta, dres2 = _bn_bwd_2d(
+        _nhwc_2d(dy), _nhwc_2d(x), y2, mean, inv, scale, act,
+        residual_tag, m, k, interpret)
+    dx = _un_nhwc(dx2, x.shape)
     dscale = dgamma.astype(scale.dtype)
     dbias = dbeta.astype(scale.dtype)
-    dres = _un_nhwc(outs[1], x.shape) if has_res else None
+    dres = _un_nhwc(dres2, x.shape) if residual_tag else None
     return dx, dscale, dbias, dres
 
 
 fused_bn_act.defvjp(_fused_fwd, _fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused 1×1-conv + BN (+relu, +residual): the bottleneck epilogue kernels
+# ---------------------------------------------------------------------------
+# A 1×1 conv with stride s is subsample-then-matmul, so on the channel-minor
+# (M, C) view the whole bottleneck tail `conv1x1 → BN → (+residual) → relu`
+# is one MXU matmul whose statistics ride along in the same streaming pass.
+# HBM sees x once and the conv output twice (stats-producing write + apply
+# read) instead of the unfused 4–5 passes, and — unlike a standalone Pallas
+# BN, which measured 2× WORSE from layout round-trips — the matmul itself
+# lives in the kernel, so no transpose traffic is ever materialized.
+#
+# Layout/padding rules that make this fast (and which `conv_bn_supports`
+# enforces): channels ride the lane axis as the full minor dimension of the
+# block (always Mosaic-legal; C < 128 merely wastes lanes — only the first
+# bottleneck's C=64 input hits this), rows are 8×-tiled on the sublane axis,
+# and the weight matrix stays resident in VMEM across the whole grid.
+
+# The (Ci, Co) weight panel must fit VMEM alongside the streaming blocks;
+# resnet50's largest is 2048×512 (4 MB f32).
+_MAX_W_BYTES = 8 * 1024 * 1024
+
+
+def conv_bn_supports(x_shape, w_shape, stride) -> bool:
+    """Static gate for the fused conv+BN pallas path: 1×1 kernel, stride
+    1/2, lane-friendly channel counts, enough output rows to tile."""
+    if not _HAVE_PALLAS:
+        return False
+    if len(x_shape) != 4 or len(w_shape) != 4:
+        return False
+    n, ci, h, w = x_shape
+    co, wci, kh, kw = w_shape
+    if (kh, kw) != (1, 1) or wci != ci or stride not in (1, 2):
+        return False
+    if ci < 8 or co < 8 or ci > 8192 or co > 8192 or ci % 8 or co % 8:
+        return False
+    if ci * co * 4 > _MAX_W_BYTES:
+        return False
+    m = n * -(-h // stride) * -(-w // stride)
+    return m >= 1024 and m % 8 == 0
+
+
+def _to2d(x):
+    """(N, C, H, W) → (M, C) channel-minor view, no lane fold (the conv
+    kernels need C intact as the contraction/output axis)."""
+    n, c, h, w = x.shape
+    return jnp.transpose(x, (0, 2, 3, 1)).reshape(n * h * w, c)
+
+
+def _from2d(y2, shape):
+    n, c, h, w = shape
+    return jnp.transpose(y2.reshape(n, h, w, c), (0, 3, 1, 2))
+
+
+def _conv_stats_kernel(x_ref, w_ref, y_ref, sum_ref, ssq_ref):
+    """One grid step: yf = x·w on the MXU (f32 accumulation), stored in
+    activation dtype, with the BN statistics accumulated from the *stored*
+    values — matching an unfused conv→BN chain that reads the rounded
+    activation back from HBM."""
+    mb = pl.program_id(0)
+
+    @pl.when(mb == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        ssq_ref[...] = jnp.zeros_like(ssq_ref)
+
+    yf = lax.dot_general(x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    yc = yf.astype(y_ref.dtype)
+    y_ref[...] = yc
+    ys = yc.astype(jnp.float32)
+    sum_ref[...] += jnp.sum(ys, axis=0, keepdims=True)
+    ssq_ref[...] += jnp.sum(ys * ys, axis=0, keepdims=True)
+
+
+def _conv_stats(x2, w2, out_dtype, interpret):
+    """(M, Ci) @ (Ci, Co) with per-channel (mean, var) of the result in the
+    same pass. Returns (y2, mean, var)."""
+    mk, ci = x2.shape
+    co = w2.shape[1]
+    bm = _pick_bm(mk, max(ci, co), max(x2.dtype.itemsize, 2))
+    y2, s, ss = pl.pallas_call(
+        _conv_stats_kernel,
+        grid=(mk // bm,),
+        in_specs=[pl.BlockSpec((bm, ci), lambda mb: (mb, 0)),
+                  pl.BlockSpec((ci, co), lambda mb: (0, 0))],
+        out_specs=[pl.BlockSpec((bm, co), lambda mb: (mb, 0)),
+                   pl.BlockSpec((1, co), lambda mb: (0, 0)),
+                   pl.BlockSpec((1, co), lambda mb: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((mk, co), out_dtype),
+                   jax.ShapeDtypeStruct((1, co), jnp.float32),
+                   jax.ShapeDtypeStruct((1, co), jnp.float32)],
+        interpret=interpret,
+    )(x2, w2)
+    mean = s[0] / mk
+    var = jnp.maximum(ss[0] / mk - mean * mean, 0.0)
+    return y2, mean, var
+
+
+def _apply2d(x2, mean, inv, scale, bias, act, res2, interpret):
+    """BN apply (+act, +residual) on an (M, C) view — reuses the fused-BN
+    apply kernel with no lane fold."""
+    mk, c = x2.shape
+    bm = _pick_bm(mk, c, x2.dtype.itemsize)
+    vec = pl.BlockSpec((1, c), lambda mb: (0, 0))
+    big = pl.BlockSpec((bm, c), lambda mb: (mb, 0))
+    isc = inv * scale.astype(jnp.float32)
+    args = [x2, mean.reshape(1, c), isc.reshape(1, c),
+            bias.astype(jnp.float32).reshape(1, c)]
+    in_specs = [big, vec, vec, vec]
+    if res2 is not None:
+        args.append(res2)
+        in_specs.append(big)
+    return pl.pallas_call(
+        functools.partial(_apply_kernel, act=act, has_res=res2 is not None),
+        grid=(mk // bm,),
+        in_specs=in_specs,
+        out_specs=big,
+        out_shape=jax.ShapeDtypeStruct((mk, c), x2.dtype),
+        interpret=interpret,
+    )(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def fused_conv_bn_act(x, w, scale, bias, eps, act, stride, residual_tag,
+                      residual=None):
+    """Fused 1×1-conv + training BN: y = act(BN(conv(x, w)) [+ residual]).
+
+    x is NCHW, w is OIHW with a 1×1 kernel; returns (y, mean, var) with
+    mean/var the f32 batch statistics of the conv output (for the
+    running-stat update). `residual_tag` statically records whether a
+    residual is fused."""
+    y, mean, var, _ = _conv_bn_fwd_impl(x, w, scale, bias, eps, act, stride,
+                                        residual)
+    return y, mean, var
+
+
+def _conv_bn_fwd_impl(x, w, scale, bias, eps, act, stride, residual):
+    interpret = FORCE_PALLAS_INTERPRET
+    co = w.shape[0]
+    xs = x[:, :, ::stride, ::stride] if stride > 1 else x
+    n, _, hs, ws = xs.shape
+    x2 = _to2d(xs)
+    w2 = jnp.transpose(w.reshape(co, w.shape[1]))
+    yc2, mean, var = _conv_stats(x2, w2, x.dtype, interpret)
+    inv = lax.rsqrt(var + eps)
+    res2 = _to2d(residual) if residual is not None else None
+    y2 = _apply2d(yc2, mean, inv, scale, bias, act, res2, interpret)
+    y = _from2d(y2, (n, co, hs, ws))
+    return y, mean, var, (x2, yc2, y2, inv)
+
+
+def _conv_bn_fwd(x, w, scale, bias, eps, act, stride, residual_tag,
+                 residual=None):
+    y, mean, var, (x2, yc2, y2, inv) = _conv_bn_fwd_impl(
+        x, w, scale, bias, eps, act, stride, residual)
+    saved_y2 = y2 if act == "relu" else None
+    return (y, mean, var), (x, w, scale, mean, inv, yc2, saved_y2)
+
+
+def _conv_bn_bwd(eps, act, stride, residual_tag, saved, cots):
+    x, w, scale, mean, inv, yc2, saved_y2 = saved
+    dy, _dmean, _dvar = cots
+    interpret = FORCE_PALLAS_INTERPRET
+    co = w.shape[0]
+    dy2 = _to2d(dy)
+    m = float(yc2.shape[0])
+    # BN half: grads w.r.t. the conv output (and the free residual grad)
+    dyc2, dgamma, dbeta, dres2 = _bn_bwd_2d(
+        dy2, yc2, saved_y2, mean, inv, scale, act, residual_tag, m, 1,
+        interpret)
+    # matmul half: XLA's dots are already MXU-shaped — the fusion win is
+    # the BN/elementwise traffic, not the gemm, so these stay plain
+    xs = x[:, :, ::stride, ::stride] if stride > 1 else x
+    x2 = _to2d(xs)
+    w2 = jnp.transpose(w.reshape(co, w.shape[1]))
+    dx2 = lax.dot_general(dyc2, w2, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+    dw2 = lax.dot_general(x2, dyc2, (((0,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    dx_sub = _from2d(dx2, xs.shape)
+    if stride > 1:
+        dx = jnp.zeros(x.shape, x.dtype).at[:, :, ::stride, ::stride].set(
+            dx_sub)
+    else:
+        dx = dx_sub
+    dw = jnp.transpose(dw2).reshape(w.shape).astype(w.dtype)
+    dres = _from2d(dres2, dy.shape) if residual_tag else None
+    return (dx, dw, dgamma.astype(scale.dtype), dbeta.astype(scale.dtype),
+            dres)
+
+
+fused_conv_bn_act.defvjp(_conv_bn_fwd, _conv_bn_bwd)
+
+
+def conv_bn_xla(x, w, scale, bias, eps, act, stride, residual=None,
+                use_mean=None, use_var=None):
+    """XLA fallback/reference composition with the exact math of the
+    separate conv2d + batch_norm("xla1") (+ elementwise_add + relu)
+    lowerings — bitwise-equal end to end, which is what makes the fused op
+    safe to enable per-model. `use_mean`/`use_var` switch to frozen
+    (inference) statistics. Returns (y, mean, var)."""
+    y = lax.conv_general_dilated(
+        x, w, (stride, stride), [(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    xf = y.astype(jnp.float32)
+    if use_mean is None:
+        mean = jnp.mean(xf, axis=(0, 2, 3))
+        var = jnp.maximum(jnp.mean(xf * xf, axis=(0, 2, 3)) - mean * mean,
+                          0.0)
+    else:
+        mean = use_mean.astype(jnp.float32)
+        var = use_var.astype(jnp.float32)
+    shp = (1, -1, 1, 1)
+    inv = lax.rsqrt(var.reshape(shp) + eps)
+    out = ((xf - mean.reshape(shp)) * inv * scale.reshape(shp)
+           + bias.reshape(shp)).astype(x.dtype)
+    if residual is not None:
+        out = out + residual
+    if act == "relu":
+        out = jax.nn.relu(out)
+    return out, mean, var

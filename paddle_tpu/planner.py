@@ -41,6 +41,7 @@ __all__ = [
     "default_candidates",
     "estimate_plan",
     "plan_for",
+    "plan_for_footprint",
     "guard",
     "last_plan",
 ]
@@ -342,6 +343,44 @@ def plan_for(program, feed: Dict[str, np.ndarray], loss_name: str,
         f"no (sharding, remat, microbatch) candidate fits the HBM budget "
         f"of {_fmt_bytes(budget_bytes)}/device — best found: "
         f"{best.describe() if best else 'none'} [{lines}]",
+        plan=best, candidates=evaluated)
+
+
+def plan_for_footprint(candidates: Sequence, where: str = "planner",
+                       budget_bytes: Optional[int] = None) -> Plan:
+    """`plan_for` for workloads that are raw jnp arrays rather than a
+    Program (op microbenches, the ring-attention bench): each candidate is
+    a ``(Plan, est_bytes)`` pair with a caller-computed analytic footprint
+    instead of a compiled estimate. Picks the first fitting plan and
+    records it through the same observability path (`planner/*` gauges,
+    flight event, ``hbm_plan`` dump section), so a later `guard`-caught
+    OOM names it. Raises `HbmBudgetError` when nothing fits."""
+    if not candidates:
+        raise ValueError("plan_for_footprint: empty candidate list")
+    if budget_bytes is None:
+        budget_bytes = resolve_budget_bytes()
+    evaluated: List[Plan] = []
+    for plan, est in candidates:
+        plan.est_bytes_per_device = int(est)
+        plan.budget_bytes = budget_bytes
+        evaluated.append(plan)
+        if budget_bytes is None:
+            plan.source = "unconstrained"
+            plan.fits = True
+            _record(plan, evaluated, where)
+            return plan
+        plan.source = "analytic"
+        plan.fits = plan.est_bytes_per_device <= budget_bytes
+        if plan.fits:
+            _record(plan, evaluated, where)
+            return plan
+    best = min(evaluated, key=lambda p: p.est_bytes_per_device)
+    _record(best, evaluated, where)
+    lines = "; ".join(p.describe() for p in evaluated)
+    raise HbmBudgetError(
+        f"{where}: no candidate footprint fits the HBM budget of "
+        f"{_fmt_bytes(budget_bytes)}/device — best found: "
+        f"{best.describe()} [{lines}]",
         plan=best, candidates=evaluated)
 
 

@@ -201,11 +201,187 @@ def bench_dygraph_mlp(steps: int = 50, batch: int = 64, width: int = 256,
             "speedup": round(uncached / cached, 2)}
 
 
+def _interleaved_ab(arms: Dict[str, callable], n_seg: int = 5,
+                    seg_iters: int = 5) -> dict:
+    """Shared A/B protocol: all arms pre-compiled, then INTERLEAVED
+    timed segments with per-arm medians + IQR — back-to-back A/B runs
+    are meaningless under drifting dispatch latency."""
+    import statistics
+
+    import jax
+
+    for f in arms.values():  # compile off the clock
+        np.asarray(jax.tree_util.tree_leaves(f())[0]).ravel()[:1]
+
+    def _seg(f):
+        t0 = time.perf_counter()
+        for _ in range(seg_iters):
+            o = f()
+        np.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[:1]
+        return (time.perf_counter() - t0) / seg_iters * 1e3
+
+    times = {k: [] for k in arms}
+    for _ in range(n_seg):
+        for k, f in arms.items():
+            times[k].append(_seg(f))
+
+    def _iqr(xs):
+        qs = statistics.quantiles(xs, n=4) if len(xs) >= 2 else [0, 0, 0]
+        return round(qs[2] - qs[0], 3)
+
+    return {k: {"median_ms": round(statistics.median(v), 3),
+                "iqr_ms": _iqr(v), "n_segments": n_seg}
+            for k, v in times.items()}
+
+
+def bench_fused_conv_bn(batch: int = 8, ci: int = 64, co: int = 256,
+                        hw: int = 32, stride: int = 1, n_seg: int = 5):
+    """Standalone A/B cell for the fused 1×1-conv+BN(+relu+residual)
+    Pallas kernel vs the exact XLA composition it replaces
+    (ops/pallas_kernels/fused_bn.py): fwd and fwd+bwd arms, interleaved
+    segments. On CPU the Pallas arm runs the interpreter (parity, not
+    speed); the TPU numbers are the campaign evidence."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import fused_bn
+
+    on_tpu = fused_bn._on_tpu()
+    old_force = fused_bn.FORCE_PALLAS_INTERPRET
+    if not on_tpu:  # CPU: run the Pallas arm through the interpreter
+        fused_bn.FORCE_PALLAS_INTERPRET = True
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, ci, hw, hw), jnp.float32)
+    w = jnp.asarray(rng.randn(co, ci, 1, 1) * 0.1, jnp.float32)
+    scale = jnp.asarray(rng.rand(co) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(co) * 0.1, jnp.float32)
+    eps = 1e-5
+
+    def fused(x, w, scale, bias):
+        y, _, _ = fused_bn.fused_conv_bn_act(x, w, scale, bias, eps, "relu",
+                                             stride, False, None)
+        return y
+
+    def unfused(x, w, scale, bias):
+        y, _, _ = fused_bn.conv_bn_xla(x, w, scale, bias, eps, "relu",
+                                       stride, None)
+        return y
+
+    f_p = jax.jit(fused)
+    f_x = jax.jit(unfused)
+    g_p = jax.jit(jax.grad(lambda *a: jnp.sum(fused(*a) ** 2), (0, 1, 2, 3)))
+    g_x = jax.jit(jax.grad(lambda *a: jnp.sum(unfused(*a) ** 2),
+                           (0, 1, 2, 3)))
+    args = (x, w, scale, bias)
+    try:
+        res = _interleaved_ab({
+            "pallas_fwd": lambda: f_p(*args), "xla_fwd": lambda: f_x(*args),
+            "pallas_bwd": lambda: g_p(*args), "xla_bwd": lambda: g_x(*args),
+        }, n_seg=n_seg)
+    finally:
+        fused_bn.FORCE_PALLAS_INTERPRET = old_force
+    return {"bench": "fused_conv_bn",
+            "shape": [batch, ci, hw, hw], "co": co, "stride": stride,
+            "interpret": not on_tpu,
+            "arms": res,
+            "fwd_speedup": round(res["xla_fwd"]["median_ms"]
+                                 / res["pallas_fwd"]["median_ms"], 2),
+            "bwd_speedup": round(res["xla_bwd"]["median_ms"]
+                                 / res["pallas_bwd"]["median_ms"], 2)}
+
+
+def bench_block_sparse_attn(batch: int = 2, t: int = 512, hidden: int = 256,
+                            num_heads: int = 4, avg_sent: int = 48,
+                            n_seg: int = 5):
+    """Standalone A/B cell for block-sparse packed-segment attention vs
+    the dense-additive-mask flash path on the same packed batch
+    (ops/pallas_kernels/flash_attention.py): fwd and fwd+bwd arms,
+    interleaved segments. The dense arm pays every K block; the sparse
+    arm skips fully-masked ones, so the gap scales with pad/pack waste."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    _fa = importlib.import_module(
+        "paddle_tpu.ops.pallas_kernels.flash_attention")
+
+    on_tpu = _fa._on_tpu()
+    old_force = _fa.FORCE_PALLAS_INTERPRET
+    if not on_tpu:  # CPU: run the Pallas arms through the interpreter
+        _fa.FORCE_PALLAS_INTERPRET = True
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(batch, t, hidden), jnp.float32)
+    k = jnp.asarray(rng.randn(batch, t, hidden), jnp.float32)
+    v = jnp.asarray(rng.randn(batch, t, hidden), jnp.float32)
+    seg_np = np.zeros((batch, t), "int32")
+    for b in range(batch):
+        p, sid = 0, 1
+        while p < t - 4:
+            ln = min(int(rng.randint(avg_sent // 2, avg_sent * 2)), t - p)
+            seg_np[b, p:p + ln] = sid
+            p += ln
+            sid += 1
+            if rng.rand() < 0.3:  # leave a pad tail on some rows
+                break
+    seg = jnp.asarray(seg_np)
+    neg = jnp.where((seg[:, :, None] == seg[:, None, :])
+                    & (seg[:, :, None] > 0), 0.0, -1e30).astype(jnp.float32)
+
+    def sparse(q, k, v):
+        return _fa.flash_attention_packed_sparse(q, k, v, num_heads, seg,
+                                                 seg)
+
+    def dense(q, k, v):
+        # the dense [B, 1, Tq, Tk] additive segment mask through the
+        # 4D bias path — what the packed NMT model fed before the
+        # descriptor existed
+        d = hidden // num_heads
+
+        def heads(x):
+            return x.reshape(batch, t, num_heads, d).transpose(0, 2, 1, 3)
+
+        o = _fa.flash_attention(heads(q), heads(k), heads(v),
+                                bias=neg[:, None])
+        return o.transpose(0, 2, 1, 3).reshape(batch, t, hidden)
+
+    f_s = jax.jit(sparse)
+    f_d = jax.jit(dense)
+    g_s = jax.jit(jax.grad(lambda *a: jnp.sum(sparse(*a) ** 2), (0, 1, 2)))
+    g_d = jax.jit(jax.grad(lambda *a: jnp.sum(dense(*a) ** 2), (0, 1, 2)))
+    args = (q, k, v)
+    try:
+        res = _interleaved_ab({
+            "sparse_fwd": lambda: f_s(*args), "dense_fwd": lambda: f_d(*args),
+            "sparse_bwd": lambda: g_s(*args), "dense_bwd": lambda: g_d(*args),
+        }, n_seg=n_seg)
+    finally:
+        _fa.FORCE_PALLAS_INTERPRET = old_force
+    fill = float((seg_np > 0).mean())
+    return {"bench": "block_sparse_attn",
+            "shape": [batch, t, hidden], "num_heads": num_heads,
+            "fill_rate": round(fill, 4),
+            "interpret": not on_tpu,
+            "arms": res,
+            "fwd_speedup": round(res["dense_fwd"]["median_ms"]
+                                 / res["sparse_fwd"]["median_ms"], 2),
+            "bwd_speedup": round(res["dense_bwd"]["median_ms"]
+                                 / res["sparse_bwd"]["median_ms"], 2)}
+
+
 def main(argv: Optional[List[str]] = None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--dygraph", action="store_true",
                     help="run the dygraph MLP step bench (eager jit cache "
                          "on vs off)")
+    ap.add_argument("--fused-conv-bn", action="store_true",
+                    help="A/B the fused conv+BN Pallas kernel vs its XLA "
+                         "composition")
+    ap.add_argument("--block-sparse-attn", action="store_true",
+                    help="A/B block-sparse packed-segment attention vs the "
+                         "dense-mask flash path")
     ap.add_argument("--op")
     ap.add_argument("--input", action="append", default=[],
                     help="SLOT=shape[:dtype], e.g. X=256x256:float32")
@@ -232,10 +408,18 @@ def main(argv: Optional[List[str]] = None):
         specs.append({"op": args.op, "inputs": inputs,
                       "attrs": json.loads(args.attrs),
                       "outputs": outputs or None, "repeat": args.repeat})
+    ran_cell = False
     if args.dygraph:
         print(json.dumps(bench_dygraph_mlp()))
-        if not specs:
-            return
+        ran_cell = True
+    if args.fused_conv_bn:
+        print(json.dumps(bench_fused_conv_bn()))
+        ran_cell = True
+    if args.block_sparse_attn:
+        print(json.dumps(bench_block_sparse_attn()))
+        ran_cell = True
+    if ran_cell and not specs:
+        return
     if not specs:
         ap.error("need --op or --config")
 

@@ -362,3 +362,262 @@ def test_flash_grads_multi_kblock(causal):
                 err_msg=f"{name} mismatch at t={t} (multi-block)")
     finally:
         fa_mod.FORCE_PALLAS_INTERPRET = old
+
+
+# ---------------------------------------------------------------------------
+# block-sparse packed-segment attention (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def _seg_mask(q_seg, k_seg, causal):
+    """Dense boolean visibility the compact descriptor must reproduce:
+    same (non-pad) segment, optionally global-position causal."""
+    m = ((q_seg[:, :, None] == k_seg[:, None, :])
+         & (q_seg[:, :, None] > 0) & (k_seg[:, None, :] > 0))
+    if causal:
+        tq, tk = q_seg.shape[1], k_seg.shape[1]
+        m = m & (np.arange(tk)[None, None, :] <= np.arange(tq)[None, :, None])
+    return m
+
+
+def _ref_sparse(q, k, v, nh, q_seg, k_seg, causal):
+    """Dense-mask reference on the [B, T, H] packed layout; fully-masked
+    query rows (pad) produce exactly 0, matching the kernel contract."""
+    import jax.numpy as jnp
+
+    b, tq, hd = q.shape
+    tk = k.shape[1]
+    d = hd // nh
+    qh = q.reshape(b, tq, nh, d).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, tk, nh, d).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, tk, nh, d).transpose(0, 2, 1, 3)
+    mask = jnp.asarray(_seg_mask(q_seg, k_seg, causal))[:, None]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(d)
+    s = jnp.where(mask, s, -1e30)
+    p = jnp.where(mask, jnp.exp(s - jnp.max(s, -1, keepdims=True)), 0.0)
+    l = jnp.sum(p, -1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p / jnp.maximum(l, 1e-30), vh)
+    return o.transpose(0, 2, 1, 3).reshape(b, tq, hd)
+
+
+def _uneven_segs(b, t, rng, max_seg=4, pad_last=True):
+    """Packed rows with uneven bucket boundaries; row b-1 gets a long pad
+    tail, row 0 is entirely pad (a fully-masked query/key row)."""
+    segs = np.zeros((b, t), np.int32)
+    for i in range(1, b):
+        pos = 0
+        for sid in range(1, max_seg + 1):
+            ln = int(rng.randint(3, max(4, t // max_seg)))
+            if pos + ln > t or (sid == max_seg and pad_last and i == b - 1):
+                break
+            segs[i, pos:pos + ln] = sid
+            pos += ln
+    return segs
+
+
+def _sparse_mod():
+    import importlib
+    return importlib.import_module(
+        "paddle_tpu.ops.pallas_kernels.flash_attention")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sparse_matches_dense_reference(causal):
+    """jax fallback path (blocks < 64): uneven buckets incl. a fully
+    pad row, fwd + all three grads vs the dense boolean-mask reference."""
+    import jax
+    import jax.numpy as jnp
+    fa = _sparse_mod()
+
+    b, t, nh, d = 3, 48, 2, 16
+    rng = np.random.RandomState(0)
+    seg = _uneven_segs(b, t, rng)
+    q, k, v = (jnp.asarray(_rand((b, t, nh * d), i)) for i in range(3))
+
+    got = fa.flash_attention_packed_sparse(q, k, v, nh, seg, seg,
+                                           causal=causal)
+    ref = _ref_sparse(q, k, v, nh, seg, seg, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # pad queries: exactly zero, not just close
+    assert not np.asarray(got)[0].any()
+
+    dy = jnp.asarray(_rand((b, t, nh * d), 7))
+    gg = jax.grad(lambda *a: jnp.sum(
+        fa.flash_attention_packed_sparse(*a, nh, seg, seg, causal=causal)
+        * dy), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(
+        _ref_sparse(*a, nh, seg, seg, causal) * dy),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, r, nm in zip(gg, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=2e-4, err_msg=nm)
+        # grads flowing into the pad row are exactly zero
+        assert not np.asarray(a)[0].any(), nm
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sparse_pallas_interpret_matches_reference(causal):
+    """Pallas grid path (interpret mode, T=128 ≥ block minimum): fwd +
+    grads vs the dense reference on uneven buckets."""
+    import jax
+    import jax.numpy as jnp
+    fa = _sparse_mod()
+
+    b, t, nh, d = 2, 128, 2, 64
+    rng = np.random.RandomState(1)
+    seg = _uneven_segs(b, t, rng, max_seg=3)
+    q, k, v = (jnp.asarray(_rand((b, t, nh * d), i)) for i in range(3))
+
+    fa.FORCE_PALLAS_INTERPRET = True
+    try:
+        assert fa._sparse_pallas_ok(t, t, d)
+        got = fa.flash_attention_packed_sparse(q, k, v, nh, seg, seg,
+                                               causal=causal)
+        dy = jnp.asarray(_rand((b, t, nh * d), 9))
+        gg = jax.grad(lambda *a: jnp.sum(
+            fa.flash_attention_packed_sparse(*a, nh, seg, seg,
+                                             causal=causal) * dy),
+            argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa.FORCE_PALLAS_INTERPRET = False
+    ref = _ref_sparse(q, k, v, nh, seg, seg, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    gr = jax.grad(lambda *a: jnp.sum(
+        _ref_sparse(*a, nh, seg, seg, causal) * dy),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, r, nm in zip(gg, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=2e-4, err_msg=nm)
+
+
+@pytest.mark.parametrize("dropout", [0.0, 0.15])
+def test_sparse_block_skip_is_bitwise_invisible(dropout, monkeypatch):
+    """The whole point of the packed descriptor: skipping a fully-masked
+    KV block must be BITWISE identical to processing it (the masked lanes
+    contribute exact zeros). Compare computed block visibility vs a
+    monkeypatched all-visible grid, fwd and bwd, with dropout on."""
+    import jax
+    import jax.numpy as jnp
+    fa = _sparse_mod()
+
+    b, t, nh, d = 2, 128, 2, 64
+    rng = np.random.RandomState(2)
+    seg = _uneven_segs(b, t, rng, max_seg=3)
+    q, k, v = (jnp.asarray(_rand((b, t, nh * d), i)) for i in range(3))
+    key = jax.random.PRNGKey(11) if dropout else None
+    dy = jnp.asarray(_rand((b, t, nh * d), 5))
+
+    def run():
+        def loss(q, k, v):
+            return jnp.sum(fa.flash_attention_packed_sparse(
+                q, k, v, nh, seg, seg, causal=True,
+                dropout_rate=dropout, dropout_key=key) * dy)
+        out = fa.flash_attention_packed_sparse(
+            q, k, v, nh, seg, seg, causal=True,
+            dropout_rate=dropout, dropout_key=key)
+        return (out,) + jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    fa.FORCE_PALLAS_INTERPRET = True
+    try:
+        skipping = run()
+        monkeypatch.setattr(
+            fa, "_compute_block_vis",
+            lambda se, tq, tk, bq, bk, causal: jnp.ones(
+                (se.shape[0], -(-tq // bq), -(-tk // bk)), jnp.int32))
+        dense_grid = run()
+    finally:
+        fa.FORCE_PALLAS_INTERPRET = False
+    for a, r, nm in zip(skipping, dense_grid, ("out", "dq", "dk", "dv")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(r),
+                                      err_msg=nm)
+
+
+def test_sparse_cross_attention_uneven_lengths():
+    """Cross attention, Tq != Tk: decoder rows attend their own source
+    segment only."""
+    import jax
+    import jax.numpy as jnp
+    fa = _sparse_mod()
+
+    b, tq, tk, nh, d = 2, 40, 56, 2, 16
+    rng = np.random.RandomState(4)
+    q_seg = _uneven_segs(b, tq, rng, max_seg=3)
+    k_seg = _uneven_segs(b, tk, rng, max_seg=3)
+    q = jnp.asarray(_rand((b, tq, nh * d), 0))
+    k = jnp.asarray(_rand((b, tk, nh * d), 1))
+    v = jnp.asarray(_rand((b, tk, nh * d), 2))
+
+    got = fa.flash_attention_packed_sparse(q, k, v, nh, q_seg, k_seg)
+    ref = _ref_sparse(q, k, v, nh, q_seg, k_seg, False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    dy = jnp.asarray(_rand((b, tq, nh * d), 8))
+    gg = jax.grad(lambda *a: jnp.sum(fa.flash_attention_packed_sparse(
+        *a, nh, q_seg, k_seg) * dy), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(
+        _ref_sparse(*a, nh, q_seg, k_seg, False) * dy),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, r, nm in zip(gg, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=2e-4, err_msg=nm)
+
+
+def test_sparse_dropout_deterministic_and_scaled():
+    """Dropout keyed by logical block index: same key -> bitwise same,
+    different key -> different, and the kept mass is 1/(1-rate) scaled."""
+    import jax
+    import jax.numpy as jnp
+    fa = _sparse_mod()
+
+    b, t, nh, d = 2, 48, 2, 16
+    rng = np.random.RandomState(6)
+    seg = _uneven_segs(b, t, rng)
+    q, k, v = (jnp.asarray(_rand((b, t, nh * d), i)) for i in range(3))
+    k1, k2 = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+
+    a1 = fa.flash_attention_packed_sparse(q, k, v, nh, seg, seg,
+                                          dropout_rate=0.3, dropout_key=k1)
+    a2 = fa.flash_attention_packed_sparse(q, k, v, nh, seg, seg,
+                                          dropout_rate=0.3, dropout_key=k1)
+    a3 = fa.flash_attention_packed_sparse(q, k, v, nh, seg, seg,
+                                          dropout_rate=0.3, dropout_key=k2)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert np.abs(np.asarray(a1) - np.asarray(a3)).max() > 1e-4
+    with pytest.raises(ValueError):
+        fa.flash_attention_packed_sparse(q, k, v, nh, seg, seg,
+                                         dropout_rate=0.3)
+
+
+def test_sparse_op_and_layer():
+    """flash_attention_sparse as a program op: lowering matches the direct
+    kernel call on the same inputs."""
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    fa = _sparse_mod()
+
+    b, t, nh, d = 2, 32, 2, 8
+    rng = np.random.RandomState(5)
+    seg = _uneven_segs(b, t, rng, max_seg=2)
+    q, k, v = (_rand((b, t, nh * d), i) for i in range(3))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        qv = layers.data("q", [t, nh * d])
+        kv = layers.data("k", [t, nh * d])
+        vv = layers.data("v", [t, nh * d])
+        qs = layers.data("q_seg", [t], dtype="int32")
+        ks = layers.data("k_seg", [t], dtype="int32")
+        out = layers.flash_attention_sparse(qv, kv, vv, nh, qs, ks,
+                                            causal=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got = exe.run(main, feed={"q": q, "k": k, "v": v,
+                                  "q_seg": seg, "k_seg": seg},
+                      fetch_list=[out])[0]
+    ref = fa.flash_attention_packed_sparse(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), nh, seg, seg,
+        causal=True)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-5, atol=2e-5)

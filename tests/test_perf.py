@@ -459,3 +459,37 @@ def test_gate_reads_real_bench_r05_baseline():
         os.path.abspath(__file__))), "BENCH_r05.json")
     doc = perf_gate.load_doc(path)
     assert doc["extra"]["deepfm_rate"] == pytest.approx(268244.1)
+    # the context fields the rate is gated under survive truncation too
+    assert doc["extra"]["deepfm_roofline"]["vocab"] == 33554432
+
+
+def test_gate_context_mismatch_skips_raw_rates_not_normalized(tmp_path):
+    """A TPU-recorded throughput baseline vs a CPU smoke run of the toy
+    config: raw hardware rates are skipped with the mismatch named, but
+    self-normalized metrics (MFU) still gate."""
+    base, fresh = tmp_path / "base.json", tmp_path / "fresh.json"
+    bdoc = _bench_doc()
+    bdoc["extra"]["device"] = "TPU v5 lite0"
+    bdoc["extra"]["deepfm_roofline"] = {"vocab": 33554432}
+    base.write_text(json.dumps(bdoc))
+
+    fdoc = _bench_doc(**{"extra.deepfm_rate": 13000.0})  # 15x "drop"
+    fdoc["extra"]["device"] = "TFRT_CPU_0"
+    fdoc["extra"]["deepfm_roofline"] = {"vocab": 10000}
+    fresh.write_text(json.dumps(fdoc))
+    assert perf_gate.main([str(fresh), str(base)]) == 0
+    rep = perf_gate.compare(fdoc, bdoc)
+    reasons = {e["path"]: e["reason"] for e in rep["skipped"]}
+    assert "context mismatch" in reasons["extra.deepfm_rate"]
+
+    # same drop with MATCHING context is a real regression
+    fdoc["extra"]["device"] = "TPU v5 lite0"
+    fdoc["extra"]["deepfm_roofline"] = {"vocab": 33554432}
+    fresh.write_text(json.dumps(fdoc))
+    assert perf_gate.main([str(fresh), str(base)]) == 1
+
+    # a context-mismatched run can't dodge self-normalized metrics
+    fdoc["extra"]["device"] = "TFRT_CPU_0"
+    fdoc["extra"]["mfu"] = 0.10  # vs 0.40 baseline
+    fresh.write_text(json.dumps(fdoc))
+    assert perf_gate.main([str(fresh), str(base)]) == 1

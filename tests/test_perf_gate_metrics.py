@@ -1,0 +1,95 @@
+"""Gate-coverage contract (ISSUE 19): every metric bench.py emits is either
+in the perf_gate METRICS/INVARIANTS tables or explicitly listed as ungated.
+
+A new `extras2["..."]` in bench.py without a matching gate entry fails here —
+the campaign's numbers stay locked because forgetting the table is a test
+failure, not a silent hole in the regression gate.
+"""
+import ast
+import os
+
+from paddle_tpu.tools import perf_gate
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _emitted_extra_keys():
+    """Static scan of bench.py: extras2[...] / extras[...] assignment and
+    setdefault targets, plus the literal keys of the doc's "extra" dict."""
+    with open(_BENCH) as f:
+        tree = ast.parse(f.read())
+    keys = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in ("extras", "extras2")
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)):
+                    keys.add(t.slice.value)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setdefault"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("extras", "extras2")
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            keys.add(node.args[0].value)
+        if isinstance(node, ast.Dict):
+            for kk, vv in zip(node.keys, node.values):
+                if (isinstance(kk, ast.Constant) and kk.value == "extra"
+                        and isinstance(vv, ast.Dict)):
+                    for k2 in vv.keys:
+                        if (isinstance(k2, ast.Constant)
+                                and isinstance(k2.value, str)):
+                            keys.add(k2.value)
+    return keys
+
+
+def _gated_flat_names():
+    """Flat extra-dict keys covered by METRICS (gated scalars) and
+    INVARIANTS (exact-match fields like hbm_plan.fits)."""
+    names = set()
+    for entry in perf_gate.METRICS:
+        name = entry[0]
+        if name.startswith("extra."):
+            names.add(name[len("extra."):].split(".")[0])
+    for name in perf_gate.INVARIANTS:
+        if name.startswith("extra."):
+            names.add(name[len("extra."):].split(".")[0])
+    return names
+
+
+def test_every_emitted_metric_is_gated_or_explicitly_ungated():
+    emitted = _emitted_extra_keys()
+    assert emitted, "scan found no extras — bench.py layout changed?"
+    covered = _gated_flat_names() | set(perf_gate.UNGATED)
+    missing = sorted(emitted - covered)
+    assert not missing, (
+        f"bench.py emits extra keys with no gate coverage: {missing} — add "
+        f"each to perf_gate.METRICS (with a noise margin) or, if it is "
+        f"diagnostics-only, to perf_gate.UNGATED")
+
+
+def test_ungated_list_is_disjoint_from_gated():
+    overlap = sorted(_gated_flat_names() & set(perf_gate.UNGATED))
+    assert not overlap, (
+        f"keys listed both in METRICS/INVARIANTS and UNGATED: {overlap}")
+
+
+def test_campaign_metrics_present():
+    """The ISSUE-19 kernel-campaign outputs are gated scalars, not
+    diagnostics: their regressions must fail the gate."""
+    names = {m[0] for m in perf_gate.METRICS}
+    for required in ("extra.resnet50_conv_fusion_speedup",
+                     "extra.nmt_big_sparse_speedup",
+                     "extra.nmt_big_roofline_frac",
+                     "extra.ring_attn_pallas_speedup_t4k",
+                     "extra.ring_attn_bwd_pallas_speedup_t4k",
+                     "extra.dygraph_jit_cache_speedup"):
+        assert required in names, required
+    for inv in ("extra.nmt_big_hbm_plan.fits",
+                "extra.ring_attn_hbm_plan.fits",
+                "extra.dygraph_hbm_plan.fits"):
+        assert inv in perf_gate.INVARIANTS, inv
