@@ -2423,6 +2423,201 @@ def bench_slo_alerting(on_tpu):
                 os.environ[k] = v
 
 
+def bench_root_cause(on_tpu):
+    """Continuous-profiling chaos cell (ISSUE 20): the full anomaly →
+    attribution loop with zero human-in-the-loop steps. A tiny jitted
+    train program establishes a healthy step baseline and a golden
+    kernel table, a `MetricsHistory` ring records every scrape sweep,
+    then a `delay_ms` fault at ``exec.dispatch`` slows every step. The
+    StepProfiler's MAD detector flags the straggler, the
+    `ProfileTrigger` auto-captures a bounded trace and diffs it against
+    the golden, and the SLO engine's anomaly-ratio page must arrive
+    ALREADY annotated with >=1 named culprit kernel and a ``/history``
+    window covering the anomaly. `tools/postmortem` then renders the
+    bundle, and the history ring's memory estimate must stay under its
+    configured cap for the whole run."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import faults, layers
+    from paddle_tpu.observability import (AlertManager, FederatedScraper,
+                                          MetricsHistory, ProfileTrigger,
+                                          ScrapeTarget, SloEngine, SloSpec,
+                                          install_alert_manager,
+                                          install_history, install_scraper,
+                                          install_trigger, record_golden)
+    from paddle_tpu.observability.steps import get_step_profiler
+    from paddle_tpu.tools import postmortem
+
+    sweep_s = 0.25
+    window_scale = 1.0 / 720.0   # page windows compress to ~5 s
+    healthy_steps = 48           # > min_samples so the baseline is live
+    delay_ms = 60.0              # ~20x a healthy CPU step: unambiguous
+    history_cap_mb = 2.0
+
+    env_keys = ["PDTPU_FLIGHT_DIR", "PDTPU_GOLDEN_DIR",
+                "PDTPU_HISTORY_DIR", "PDTPU_PROFILE_ON_ANOMALY",
+                "PDTPU_PROFILE_COOLDOWN_S", "PDTPU_PROFILE_MAX_CAPTURES"]
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    workdir = tempfile.mkdtemp(prefix="pdtpu_bench_rootcause_")
+    os.environ["PDTPU_FLIGHT_DIR"] = os.path.join(workdir, "flight")
+    os.environ["PDTPU_GOLDEN_DIR"] = os.path.join(workdir, "golden")
+    os.environ["PDTPU_HISTORY_DIR"] = os.path.join(workdir, "history")
+    os.environ["PDTPU_PROFILE_ON_ANOMALY"] = "1"
+    # short cooldown: the page's enrichment may legitimately re-arm
+    os.environ["PDTPU_PROFILE_COOLDOWN_S"] = "2"
+    os.environ["PDTPU_PROFILE_MAX_CAPTURES"] = "4"
+
+    steps_prof = get_step_profiler()
+    steps_prof.reset()
+
+    # enough real math (matmul + tanh) that the trace has named kernels
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", [64], dtype="float32")
+        h = layers.fc(x, size=64, act="tanh")
+        loss = layers.reduce_mean(h * h)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    feed = {"x": np.ones((8, 64), dtype=np.float32)}
+    exe = fluid.Executor(fluid.TPUPlace() if on_tpu else fluid.CPUPlace())
+
+    scraper = trig = None
+    hist_bytes_max = [0]
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+
+            def run_step():
+                exe.run(main_p, feed=feed, fetch_list=[loss])
+
+            run_step()
+            run_step()   # compile + warm before the golden capture
+            golden = record_golden(run_step, steps=2)
+
+            am = AlertManager(for_s=0.0, resolved_hold_s=600.0)
+            install_alert_manager(am)
+            events = []   # (wall_t, event) timeline from the sink
+            am.add_sink(lambda ev: events.append((time.time(), ev)))
+
+            hist = MetricsHistory(max_mb=history_cap_mb)
+            install_history(hist)
+            trig = ProfileTrigger(window_steps=2)
+            install_trigger(trig)
+            trig.attach(steps_prof, am)
+
+            scraper = FederatedScraper([ScrapeTarget.local()],
+                                       interval_s=sweep_s, timeout=0.5)
+            hist.attach(scraper)
+            scraper.add_sweep_listener(
+                lambda doc: hist_bytes_max.__setitem__(
+                    0, max(hist_bytes_max[0],
+                           hist.stats()["est_bytes"])))
+            engine = SloEngine(
+                [SloSpec.ratio("StepAnomalyRatio", "steps/anomalies",
+                               "steps/total", objective=0.99,
+                               description="step straggler ratio")],
+                alert_manager=am, window_scale=window_scale)
+            engine.attach(scraper)
+            install_scraper(scraper)
+            scraper.start()
+
+            for _ in range(healthy_steps):
+                run_step()
+                time.sleep(0.01)
+            time.sleep(2 * sweep_s)   # healthy ratio sweeps on record
+
+            fault_t = time.time()
+            faults.install("exec.dispatch", "delay_ms", delay_ms)
+
+            # keep stepping THROUGH the fault: the trigger's capture
+            # window closes on live steps, and enrichment blocks the
+            # sweep thread until the attribution exists
+            def enriched_page():
+                for t, ev in events:
+                    if (ev["event"] == "firing"
+                            and ev["severity"] == "page"
+                            and (ev.get("annotations") or {}).get(
+                                "culprit_kernels")):
+                        return t, ev
+                return None
+
+            page = None
+            deadline = time.time() + 30.0
+            while time.time() < deadline and page is None:
+                run_step()
+                page = enriched_page()
+            faults.clear()
+            trig.wait_idle(10.0)
+            assert page is not None, (
+                f"no enriched page within 30 s; events="
+                f"{[e for _, e in events]} "
+                f"last_attr={trig.last_attribution()}")
+            page_t, page_ev = page
+            ann = page_ev["annotations"]
+            culprits = ann["culprit_kernels"]
+            culprit_named = bool(culprits and culprits[0].get("kernel"))
+            assert culprit_named, f"no named culprit: {culprits}"
+            assert ann.get("history"), (
+                f"page lacks a /history window: {sorted(ann)}")
+            hwin = ann["history"]
+            assert hwin.get("series"), "history window carried no series"
+
+            # a few healthy sweeps so the postmortem shows the recovery
+            for _ in range(10):
+                run_step()
+                time.sleep(0.02)
+            time.sleep(2 * sweep_s)
+
+            report = postmortem.build_report(center_t=fault_t)
+            md = postmortem.render_markdown(report)
+            assert culprits[0]["kernel"] in md, (
+                "postmortem does not name the culprit kernel")
+
+            cap_bytes = hist.max_bytes
+            history_under_cap = 0 < hist_bytes_max[0] <= cap_bytes
+            return {
+                "sweep_s": sweep_s, "window_scale": window_scale,
+                "delay_ms": delay_ms, "healthy_steps": healthy_steps,
+                "page_fire_after_fault_ms": round(
+                    (page_t - fault_t) * 1e3, 1),
+                "culprit_named": culprit_named,
+                "culprit_kernels": [c.get("kernel") for c in culprits],
+                "culprit_reasons": [c.get("why") for c in culprits
+                                    if c.get("why")],
+                "history_window_series": len(hwin["series"]),
+                "history_under_cap": history_under_cap,
+                "history_est_bytes_max": int(hist_bytes_max[0]),
+                "history_cap_bytes": int(cap_bytes),
+                "history_stats": hist.stats(),
+                "golden_path": golden,
+                "attribution_trigger": ann.get("attribution_trigger"),
+                "postmortem_md_chars": len(md),
+                "alert_events": len(events),
+            }
+    finally:
+        faults.clear()
+        try:
+            if scraper is not None:
+                scraper.stop()
+        except Exception:
+            pass
+        install_scraper(None)
+        install_alert_manager(None)
+        install_history(None)
+        install_trigger(None)
+        if trig is not None:
+            steps_prof.remove_listener(trig.on_record)
+            steps_prof.remove_listener(trig.on_anomaly)
+        steps_prof.reset()
+        shutil.rmtree(workdir, ignore_errors=True)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _roofline_diff_vs_baseline(base, rn_roofline, nmt_shapes):
     """Per-kernel roofline diff (tools/roofline.diff_tables) of this run's
     live traces vs the baseline doc's recorded tables. Sections without a
@@ -2676,6 +2871,16 @@ def main(gate_against=None, recalibrate=False):
     except Exception as e:  # pragma: no cover
         extras2["slo_alerting"] = {"error": str(e)[:120]}
     _end_section(extras2, "slo_alerting")
+
+    # Root-cause chaos cell (ISSUE 20): inject a delay_ms fault at
+    # exec.dispatch — the anomaly-ratio page must arrive already
+    # annotated with named culprit kernels from the auto-captured trace
+    # diff plus a /history window, and the postmortem renders the bundle
+    try:
+        extras2["root_cause"] = bench_root_cause(on_tpu)
+    except Exception as e:  # pragma: no cover
+        extras2["root_cause"] = {"error": str(e)[:120]}
+    _end_section(extras2, "root_cause")
 
     extras2["nmt_big_rate"] = rate            # NON-PAD target tokens/s
     extras2["nmt_big_step_ms"] = ms

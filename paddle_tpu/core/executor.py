@@ -1176,11 +1176,10 @@ class Executor:
         point. Results are bitwise-identical to return_numpy=True."""
         from .compiler import CompiledProgram
 
-        # chaos probe: one hit per training-step dispatch, so a spec like
-        # exec.dispatch:crash@7 kills the process at exactly step 7
-        fault_point("exec.dispatch")
-
         if isinstance(program, CompiledProgram):
+            # chaos probe: one hit per training-step dispatch — a spec
+            # like exec.dispatch:crash@7 kills exactly step 7's dispatch
+            fault_point("exec.dispatch")
             out = program._run(self, feed, fetch_list, scope,
                                return_numpy and not return_handle)
             # maintenance epilogues must fire under the mesh too — the
@@ -1249,6 +1248,11 @@ class Executor:
                            sig=_sig_digest(feed_sig), compiling=compiling), \
                 trace_span("executor/compile+run" if compiling
                            else "executor/run", sig=_sig_digest(feed_sig)):
+            # chaos probe: one hit per training-step dispatch
+            # (exec.dispatch:crash@7 kills exactly step 7). Inside the
+            # timed region on purpose — a delay_ms fault here IS a slow
+            # step, so the StepProfiler's straggler detector must see it
+            fault_point("exec.dispatch")
             fetches, new_state, new_key = fn(state, feed_vals, key)
         dt_ms = (time.perf_counter() - t0) * 1e3
         if compiling:

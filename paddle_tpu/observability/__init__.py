@@ -50,6 +50,15 @@ capability along its natural seam:
   ``ALERTS{alertname,severity,alertstate}``, pluggable sinks (file /
   webhook / callback — the autoscaler hook), an ``/alerts`` endpoint,
   an ``alerts`` health check, and alert-triggered flight dumps.
+- **MetricsHistory / ProfileTrigger** (history.py / profile_trigger.py)
+  — the root-cause loop: a bounded ring TSDB recording every scraper
+  sweep (raw + 10 s + 120 s tiers, LRU memory cap, ``/history``
+  endpoint, optional JSONL spill via ``PDTPU_HISTORY_DIR``), and an
+  anomaly-triggered profiler that captures a bounded trace window on
+  ``slow_step``/``recompile``/page events, diffs the per-kernel table
+  against a recorded golden, and enriches the firing alert with the
+  culprit kernels + the surrounding history window.
+  ``tools/postmortem.py`` bundles all of it into one report.
 
 Quick start::
 
@@ -76,6 +85,8 @@ from .federate import (FederatedScraper, ScrapeTarget,  # noqa: F401
 from .flight import (FlightRecorder, get_flight_recorder,  # noqa: F401
                      is_oom, register_dump_section,
                      unregister_dump_section)
+from .history import (MetricsHistory, get_history,  # noqa: F401
+                      install_history)
 from .http import (IntrospectionServer, maybe_serve_from_env,  # noqa: F401
                    register_health_check, run_health_checks,
                    serve_introspection, stop_introspection,
@@ -83,6 +94,9 @@ from .http import (IntrospectionServer, maybe_serve_from_env,  # noqa: F401
 from .memory import (device_memory_stats,  # noqa: F401
                      per_device_state_bytes, record_state_memory)
 from .perf import CostLedger, ProgramCost, attribute, get_ledger  # noqa: F401
+from .profile_trigger import (ProfileTrigger, get_trigger,  # noqa: F401
+                              golden_path, install_trigger,
+                              record_golden)
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
                        get_registry, render_prometheus)
 from .slo import (BURN_RATE_WINDOWS, SloEngine, SloSpec,  # noqa: F401
@@ -113,4 +127,7 @@ __all__ = [
     "SloSpec", "SloEngine", "default_slos", "BURN_RATE_WINDOWS",
     "Alert", "AlertManager", "AlertFiringError", "FileSink",
     "WebhookSink", "install_alert_manager", "get_alert_manager",
+    "MetricsHistory", "install_history", "get_history",
+    "ProfileTrigger", "install_trigger", "get_trigger",
+    "golden_path", "record_golden",
 ]

@@ -20,6 +20,7 @@ injected clock (`now=`).
 """
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
@@ -28,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 from .flight import get_flight_recorder
 from .registry import Registry, get_registry
+from .tracer import get_tracer
 
 __all__ = ["Alert", "AlertManager", "AlertFiringError", "FileSink",
            "WebhookSink", "install_alert_manager", "get_alert_manager"]
@@ -139,8 +141,10 @@ class AlertManager:
         self.resolved_hold_s = float(resolved_hold_s)
         self.flight_dump_severities = tuple(flight_dump_severities)
         self._sinks: List[Callable[[dict], None]] = list(sinks)
+        self._enrichers: List[Callable[[Alert], Optional[dict]]] = []
         self._lock = threading.Lock()
         self._alerts: Dict[tuple, Alert] = {}
+        self._recent: collections.deque = collections.deque(maxlen=256)
         self._reg = registry if registry is not None else get_registry()
         self._c_sink_err = self._reg.counter("alerts/sink_errors")
 
@@ -153,7 +157,18 @@ class AlertManager:
         self._sinks.append(sink)
         return sink
 
+    def add_enricher(self, fn: Callable[[Alert], Optional[dict]]) -> Callable:
+        """Register a callable receiving every newly-FIRING Alert before
+        sinks and the flight dump run: a returned dict merges into the
+        alert's annotations, so the firing event ships with it. This is
+        how the ProfileTrigger attaches culprit kernels + the /history
+        window to a page (root-cause loop); enricher exceptions are
+        swallowed — attribution is best-effort, paging is not."""
+        self._enrichers.append(fn)
+        return fn
+
     def _emit(self, event: dict) -> None:
+        self._recent.append(dict(event))
         for sink in list(self._sinks):
             try:
                 sink(dict(event))
@@ -175,6 +190,7 @@ class AlertManager:
         key = (name, severity, _label_items(labels))
         fired: Optional[Alert] = None
         events: List[dict] = []
+        went_pending = False
         with self._lock:
             a = self._alerts.get(key)
             if a is None and active:
@@ -182,6 +198,7 @@ class AlertManager:
                 self._alerts[key] = a
                 self._set_state_gauge(a, None)
                 self._reg.counter("alerts/transitions", to="pending").inc()
+                went_pending = True
             if a is not None:
                 if value is not None:
                     a.value = value
@@ -212,6 +229,29 @@ class AlertManager:
                         events.append(self._event(a, "resolved", now))
             self._prune_locked(now)
             live = self._alerts.get(key)
+        # alert timeline in merged fleet traces: one instant per state
+        # transition, right next to the spans that explain it
+        tracer = get_tracer()
+        if went_pending:
+            tracer.instant("alerts/pending",
+                           {"alert": name, "severity": severity})
+        for ev in events:
+            tracer.instant(f"alerts/{ev['event']}",
+                           {"alert": ev["name"],
+                            "severity": ev["severity"]})
+        if fired is not None and self._enrichers:
+            # root-cause enrichment BEFORE the dump and the sinks, so
+            # both carry the attribution
+            for fn in list(self._enrichers):
+                try:
+                    extra = fn(fired)
+                except Exception:
+                    extra = None
+                if extra:
+                    fired.annotations.update(extra)
+            for ev in events:
+                if ev["event"] == "firing" and ev["name"] == fired.name:
+                    ev["annotations"] = dict(fired.annotations)
         if (fired is not None
                 and fired.severity in self.flight_dump_severities
                 and fired.dump_path is None):
@@ -287,6 +327,12 @@ class AlertManager:
     def firing(self, severity: Optional[str] = None) -> List[Alert]:
         return self.alerts(state="firing", severity=severity)
 
+    def recent_events(self, n: int = 64) -> List[dict]:
+        """Most recent fire/resolve events, oldest first — the alert
+        timeline `tools/postmortem.py` bundles."""
+        out = list(self._recent)
+        return out[-int(n):] if n else out
+
     def doc(self) -> dict:
         """The ``/alerts`` endpoint document."""
         with self._lock:
@@ -297,7 +343,8 @@ class AlertManager:
                 "firing": sum(1 for d in alerts if d["state"] == "firing"),
                 "pending": sum(1 for d in alerts if d["state"] == "pending"),
                 "resolved": sum(
-                    1 for d in alerts if d["state"] == "resolved")}
+                    1 for d in alerts if d["state"] == "resolved"),
+                "recent_events": self.recent_events(32)}
 
     def health_check(self):
         """/healthz ``alerts`` check: failing while any page-severity

@@ -233,7 +233,8 @@ class FederatedScraper:
                 "scrape_ms": (time.perf_counter() - s0) * 1e3,
                 "series": series,
             })
-        doc = {"targets": results,
+        doc = {"t": time.time(),
+               "targets": results,
                "ok": all(r["ok"] for r in results),
                "signals": self._signals(results)}
         self._h_scrape.observe((time.perf_counter() - t0) * 1e3)

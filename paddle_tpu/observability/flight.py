@@ -17,6 +17,12 @@ Dump destination is ``PDTPU_FLIGHT_DIR``; without it the dump is kept
 in memory only (``last_dump``) and still served at ``/debug/flight``.
 Ring sizes: ``PDTPU_FLIGHT_STEPS`` (default 64) step records, 128
 events.
+
+The dump directory itself is capped: alert-triggered dumps (PR 17) made
+writes routine, so after each write the recorder deletes oldest-first
+past ``PDTPU_FLIGHT_MAX_DUMPS`` (default 32) files or
+``PDTPU_FLIGHT_MAX_MB`` (default 256) total, counting deletions in
+``flight/dumps_pruned``. The dump just written is never pruned.
 """
 from __future__ import annotations
 
@@ -174,6 +180,7 @@ class FlightRecorder:
             path = os.path.join(flight_dir, fname)
             with open(path, "w") as f:
                 json.dump(dump, f, indent=2, default=str)
+            self._prune_dumps(flight_dir, keep=path)
         with self._lock:
             self.last_dump = dump
             self.last_dump_path = path
@@ -185,6 +192,45 @@ class FlightRecorder:
             path or "kept in memory (set PDTPU_FLIGHT_DIR to persist)",
             len(steps), len(events))
         return path
+
+    def _prune_dumps(self, flight_dir: str, keep: str) -> None:
+        """Oldest-first retention over the dump directory: alert-driven
+        dumps must not fill the disk over a long incident. Never touches
+        `keep` (the dump just written); failures are swallowed."""
+        try:
+            max_dumps = max(1, int(
+                os.environ.get("PDTPU_FLIGHT_MAX_DUMPS", "32")))
+            max_bytes = int(float(
+                os.environ.get("PDTPU_FLIGHT_MAX_MB", "256")) * 1024 * 1024)
+            entries = []
+            for f in os.listdir(flight_dir):
+                if not (f.startswith("flight_") and f.endswith(".json")):
+                    continue
+                p = os.path.join(flight_dir, f)
+                try:
+                    st = os.stat(p)
+                    entries.append((st.st_mtime, p, st.st_size))
+                except OSError:
+                    continue
+            entries.sort()  # oldest first (pid in the name breaks lexical)
+            total = sum(sz for _, _, sz in entries)
+            pruned = 0
+            for _, p, sz in entries:
+                if len(entries) - pruned <= max_dumps and total <= max_bytes:
+                    break
+                if p == keep:
+                    continue
+                try:
+                    os.unlink(p)
+                    pruned += 1
+                    total -= sz
+                except OSError:
+                    pass
+            if pruned:
+                from .registry import get_registry
+                get_registry().counter("flight/dumps_pruned").inc(pruned)
+        except Exception:
+            pass
 
     @contextlib.contextmanager
     def guard(self, where: str, **ctx):

@@ -16,6 +16,11 @@ flight-recorder contents:
                          otherwise, 503 when any target is unreachable)
     GET /alerts          live alert states (requires an installed
                          alerts.AlertManager; 404 otherwise)
+    GET /history         windowed metrics history (requires an installed
+                         history.MetricsHistory; 404 otherwise) —
+                         ?prefix= series-name prefix, ?start=/?end= unix
+                         seconds, ?window=SECS (newest window shortcut),
+                         ?tier=raw|mid|long, ?max_points=N
     GET /healthz         named health checks, ok/degraded/failing
                          aggregation (200 for ok/degraded, 503 for failing)
     GET /debug/steps     recent StepProfiler records (?n=50 to limit)
@@ -153,6 +158,39 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                                "text/plain")
                 else:
                     self._send_json(200, mgr.doc())
+            elif path == "/history":
+                from . import history as history_mod  # deferred import
+                hist = history_mod.get_history()
+                if hist is None:
+                    self._send(404, "no MetricsHistory installed "
+                                    "(observability.history."
+                                    "install_history)\n", "text/plain")
+                else:
+                    qs = urllib.parse.parse_qs(parsed.query)
+
+                    def _qf(key):
+                        try:
+                            return float(qs[key][0])
+                        except (KeyError, ValueError, IndexError):
+                            return None
+
+                    start, end = _qf("start"), _qf("end")
+                    window = _qf("window")
+                    if window is not None and start is None:
+                        import time as _time
+                        start = _time.time() - window
+                    tier = (qs.get("tier", ["raw"])[0] or "raw")
+                    mp = _qf("max_points")
+                    try:
+                        series = hist.query(
+                            prefix=qs.get("prefix", [""])[0],
+                            start=start, end=end, tier=tier,
+                            max_points=int(mp) if mp else 512)
+                    except ValueError as ve:
+                        self._send(400, f"{ve}\n", "text/plain")
+                    else:
+                        self._send_json(200, {"stats": hist.stats(),
+                                              "series": series})
             elif path == "/healthz":
                 overall, detail = run_health_checks()
                 code = 200 if overall in ("ok", "degraded") else 503
@@ -172,7 +210,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             elif path == "/":
                 self._send(200, "paddle_tpu introspection: /metrics "
                                 "/metrics.json /metrics/series /fleet "
-                                "/alerts /healthz /debug/steps "
+                                "/alerts /history /healthz /debug/steps "
                                 "/debug/flight\n", "text/plain")
             else:
                 self._send(404, f"no such endpoint: {path}\n", "text/plain")

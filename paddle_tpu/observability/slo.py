@@ -484,6 +484,14 @@ def default_slos(serving_p99_ms: float = 50.0,
             staleness_budget_ms, group_by="table", objective=0.999,
             description="train-to-serve delta visibility within the "
                         "staleness budget"),
+        # the root-cause loop's paging signal: when straggler steps blow
+        # this budget, the page arrives pre-annotated with culprit
+        # kernels by the installed ProfileTrigger (see
+        # docs/migration.md "The root-cause loop")
+        SloSpec.ratio(
+            "StepAnomalyRatio", "steps/anomalies", "steps/total",
+            objective=0.99,
+            description="straggler-step ratio within budget"),
     ]
     if step_time_ms is not None:
         specs.append(SloSpec.latency(

@@ -81,6 +81,32 @@ class StepProfiler:
             1, int(os.environ.get("PDTPU_STEP_SAMPLE_EVERY", "16")))
         self._sample_tick = 0
         self._env_cache: dict = {}
+        # subscription points (ProfileTrigger): called OUTSIDE the lock
+        self._listeners: list = []
+        self._anomaly_listeners: list = []
+
+    def add_listener(self, fn) -> "StepProfiler":
+        """Call ``fn(rec)`` after every record (outside the lock).
+        Listener exceptions are swallowed — observability plumbing must
+        not kill the hot loop."""
+        self._listeners.append(fn)
+        return self
+
+    def add_anomaly_listener(self, fn) -> "StepProfiler":
+        """Call ``fn(rec, reason)`` on every slow_step/recompile anomaly
+        (outside the lock; exceptions swallowed) — the ProfileTrigger's
+        arming signal."""
+        self._anomaly_listeners.append(fn)
+        return self
+
+    def remove_listener(self, fn) -> "StepProfiler":
+        """Detach `fn` from both listener lists (missing is fine) — the
+        teardown half of add_listener/add_anomaly_listener for harnesses
+        that wire a ProfileTrigger temporarily."""
+        for lst in (self._listeners, self._anomaly_listeners):
+            while fn in lst:
+                lst.remove(fn)
+        return self
 
     # -- environment sampling ---------------------------------------------
     def _sample_environment(self, rec: dict) -> None:
@@ -213,6 +239,18 @@ class StepProfiler:
                 iargs["deviation"] = round(anomaly[3], 1)
             get_tracer().instant(f"steps/{reason}", iargs)
         get_flight_recorder().note_step(rec)
+        if anomaly is not None and self._anomaly_listeners:
+            for fn in list(self._anomaly_listeners):
+                try:
+                    fn(rec, anomaly[0])
+                except Exception:
+                    pass
+        if self._listeners:
+            for fn in list(self._listeners):
+                try:
+                    fn(rec)
+                except Exception:
+                    pass
         return rec
 
     # -- reading -----------------------------------------------------------

@@ -1,12 +1,14 @@
 """Live ops console: one terminal view of the whole fleet + its alerts.
 
-A stdlib-only (urllib + ANSI) dashboard over the two introspection
+A stdlib-only (urllib + ANSI) dashboard over the introspection
 documents the coordinator already serves — ``/fleet`` (per-process
-reachability, scrape latency, queue depth, pull p99) and ``/alerts``
-(the SLO engine's live pending/firing/resolved set) — refreshed in
-place every ``--interval`` seconds. Firing alerts render on top in
-red, because when an operator opens this screen something is usually
-already paging.
+reachability, scrape latency, queue depth, pull p99), ``/alerts``
+(the SLO engine's live pending/firing/resolved set), and ``/history``
+(the ring TSDB: each autoscaler signal row gains a unicode sparkline of
+its last five minutes, so a spike reads as a shape, not a number) —
+refreshed in place every ``--interval`` seconds. Firing alerts render
+on top in red, because when an operator opens this screen something is
+usually already paging.
 
 CLI::
 
@@ -69,8 +71,11 @@ def gather(base: str, timeout: float = 2.0) -> dict:
     "reachable"}``. Never raises — an unreachable coordinator comes back
     as ``reachable: False`` with the error in notes."""
     notes = []
-    out = {"fleet": None, "alerts": None, "notes": notes, "reachable": True}
-    for key, path in (("fleet", "/fleet"), ("alerts", "/alerts")):
+    out = {"fleet": None, "alerts": None, "history": None,
+           "notes": notes, "reachable": True}
+    for key, path in (("fleet", "/fleet"), ("alerts", "/alerts"),
+                      ("history", "/history?prefix=autoscale/"
+                                  "&window=300&max_points=64")):
         try:
             doc, note = _fetch(base, path, timeout)
         except Exception as e:
@@ -80,6 +85,29 @@ def gather(base: str, timeout: float = 2.0) -> dict:
         out[key] = doc
         if note:
             notes.append(note)
+    return out
+
+
+def _spark_map(history_doc) -> dict:
+    """(series_name, sub_label) -> sparkline over the /history window.
+    sub_label is the shard for per-shard series, the process for
+    per-process series, None for scalar signals."""
+    out: dict = {}
+    if not isinstance(history_doc, dict):
+        return out
+    from .postmortem import sparkline
+    for s in history_doc.get("series", ()):
+        name = s.get("name", "")
+        labels = s.get("labels") or {}
+        if name == "autoscale/ps_pull_p99_ms":
+            sub = labels.get("shard")
+        elif name == "autoscale/queue_depth":
+            sub = labels.get("process")
+        else:
+            sub = None
+        vals = [p[1] for p in s.get("points", ()) if len(p) > 1]
+        if vals:
+            out[(name, sub)] = sparkline(vals)
     return out
 
 
@@ -176,8 +204,21 @@ def render(frame: dict, color: bool = True, now: float = None) -> str:
         sig = fdoc.get("signals") or {}
         if sig:
             lines.append("")
-            lines.append(_c("signals: " + json.dumps(sig, sort_keys=True),
-                            _DIM, color))
+            sparks = _spark_map(frame.get("history"))
+            for key in sorted(sig):
+                val = sig[key]
+                nm = f"autoscale/{key}"
+                if isinstance(val, dict):
+                    # per-label signal (pull p99 by shard, queue by proc)
+                    for sub in sorted(val):
+                        label = f"{key}[{sub}]"
+                        spark = sparks.get((nm, str(sub)), "")
+                        lines.append(f"  {label:<26}{val[sub]:>10.1f}  "
+                                     + _c(spark, _DIM, color))
+                else:
+                    spark = sparks.get((nm, None), "")
+                    lines.append(f"  {key:<26}{float(val):>10.1f}  "
+                                 + _c(spark, _DIM, color))
 
     for n in frame.get("notes", ()):
         lines.append(_c(f"note: {n}", _DIM, color))

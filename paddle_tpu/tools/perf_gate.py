@@ -47,7 +47,8 @@ import re
 import sys
 from typing import Any, Optional
 
-__all__ = ["load_doc", "compare", "gate", "main", "METRICS", "INVARIANTS"]
+__all__ = ["load_doc", "compare", "gate", "main", "METRICS", "INVARIANTS",
+           "PRESENCE_INVARIANTS"]
 
 # (path, relative margin, direction[, context paths]). Margins are
 # per-metric noise allowances from the spread observed across
@@ -81,6 +82,13 @@ METRICS = [
     ("extra.ring_attn_pallas_speedup_t4k", 0.20, "higher"),
     ("extra.ring_attn_bwd_pallas_speedup_t4k", 0.20, "higher"),
     ("extra.dygraph_jit_cache_speedup", 0.25, "higher"),
+    # observability-loop latencies (PR 17/20 chaos cells): how long after
+    # the injected fault the page fired. Quantized by the 0.25 s sweep
+    # interval, hence the generous margins — what the gate protects is
+    # the order of magnitude, not the sweep jitter.
+    ("extra.slo_alerting.avail_fire_after_kill_ms", 0.75, "lower"),
+    ("extra.slo_alerting.stale_fire_after_kill_ms", 0.75, "lower"),
+    ("extra.root_cause.page_fire_after_fault_ms", 0.75, "lower"),
 ]
 # Absolute slack for "lower" metrics whose baseline is ~0 (a pct that
 # moves 0.1 -> 0.3 is noise, not a 3x regression).
@@ -97,6 +105,22 @@ INVARIANTS = [
     "extra.nmt_big_hbm_plan.fits",
     "extra.ring_attn_hbm_plan.fits",
     "extra.dygraph_hbm_plan.fits",
+    # root-cause chaos cell (PR 20): the page must arrive already naming
+    # a culprit kernel, and the history ring must stay under its cap
+    "extra.root_cause.culprit_named",
+    "extra.root_cause.history_under_cap",
+]
+
+# Presence invariants: paths that are null/absent when a section ran
+# clean and carry a post-mortem payload when it OOM'd. A baseline that
+# ran clean followed by a fresh run that emits the payload IS the
+# regression (the *_oom_plan fields were UNGATED diagnostics before
+# this: a section could silently start OOMing without failing the
+# gate, as long as the planner limped it through).
+PRESENCE_INVARIANTS = [
+    "extra.nmt_big_oom_plan",
+    "extra.ring_attn_oom_plan",
+    "extra.dygraph_oom_plan",
 ]
 
 # Metrics bench.py emits that are DELIBERATELY not gated: diagnostics,
@@ -117,8 +141,7 @@ UNGATED = [
     # error / post-mortem records
     "resnet50_error", "deepfm_error", "nmt_big_error", "ring_attn_error",
     "dygraph_bench_error", "nmt_big_flight_dump", "ring_attn_flight_dump",
-    "dygraph_flight_dump", "nmt_big_oom_plan", "ring_attn_oom_plan",
-    "dygraph_oom_plan",
+    "dygraph_flight_dump",
     # raw section payloads (gated scalars are lifted out of them; payloads
     # that carry a nested gated metric or invariant — dispatch_overhead,
     # ps_embedding, the *_hbm_plan dicts — are covered by THAT entry and
@@ -128,7 +151,7 @@ UNGATED = [
     "section_peak_bytes", "section_rss_mb",
     "input_pipeline", "ckpt_integrity", "ps_fault",
     "serving_fleet", "inference_compiler", "online_learning",
-    "slo_alerting", "roofline_diff",
+    "roofline_diff",
     # *_vs_baseline ratios are derived from gated metrics
     "resnet50_vs_baseline", "nmt_big_vs_baseline", "deepfm_vs_baseline",
 ]
@@ -262,6 +285,17 @@ def compare(fresh: dict, base: dict, margin_scale: float = 1.0) -> dict:
         elif bv is not None and fv is not None:
             checked.append({"path": path, "base": bv, "fresh": fv,
                             "limit": True, "direction": "invariant"})
+    for path in PRESENCE_INVARIANTS:
+        bv, fv = _lookup(base, path), _lookup(fresh, path)
+        if bv is None and fv is not None:
+            regressions.append({"path": path, "base": None, "fresh": fv,
+                                "limit": None,
+                                "reason": "section OOM'd (baseline ran "
+                                          "clean, fresh run emitted a "
+                                          "post-mortem payload)"})
+        elif fv is None:
+            checked.append({"path": path, "base": bv, "fresh": None,
+                            "limit": None, "direction": "invariant"})
     return {"checked": checked, "skipped": skipped,
             "regressions": regressions, "improvements": improvements}
 

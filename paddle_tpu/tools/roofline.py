@@ -239,6 +239,17 @@ def main(argv=None) -> int:
     p.add_argument("--diff", metavar="OTHER",
                    help="second trace: report per-kernel deltas "
                         "(OTHER - trace)")
+    p.add_argument("--save-golden", action="store_true",
+                   help="persist this trace's kernel table as the "
+                        "golden for this (device kind, host) — the "
+                        "baseline ProfileTrigger diffs captures against")
+    p.add_argument("--diff-golden", action="store_true",
+                   help="diff this trace against the recorded golden "
+                        "(trace - golden)")
+    p.add_argument("--golden-path", default=None,
+                   help="override the golden cache file "
+                        "(default: PDTPU_GOLDEN_DIR keyed like "
+                        "calibrate.py)")
     p.add_argument("--topk", type=int, default=20)
     p.add_argument("--cutoff-ms", type=float, default=0.5)
     p.add_argument("--steps", type=int, default=1,
@@ -259,6 +270,30 @@ def main(argv=None) -> int:
     mm, st, source = _resolve_floors(args)
     tab = kernel_table(tr, (mm, st), steps=args.steps,
                        cutoff_ms=args.cutoff_ms, topk=args.topk)
+    if args.save_golden:
+        from ..observability import profile_trigger
+        if "error" in tab:
+            print(f"roofline: not saving golden: {tab['error']}",
+                  file=sys.stderr)
+            return 2
+        path = profile_trigger.save_golden(tab, path=args.golden_path,
+                                           note=args.trace)
+        print(f"golden saved: {path}")
+        return 0
+    if args.diff_golden:
+        from ..observability import profile_trigger
+        golden = profile_trigger.load_golden(args.golden_path)
+        if golden is None:
+            print("roofline: no golden recorded (run --save-golden on a "
+                  "healthy trace first)", file=sys.stderr)
+            return 2
+        d = diff_tables(golden["table"], tab, topk=args.topk)
+        if args.as_json:
+            print(json.dumps({"golden": golden["table"], "trace": tab,
+                              "diff": d}))
+        else:
+            _print_diff(d)
+        return 0
     if args.diff:
         try:
             tr2 = load_trace(args.diff)

@@ -71,6 +71,48 @@ def test_lint_skips_pycache_and_itself(tmp_path):
     assert lint_source_tree(str(tmp_path)) == []
 
 
+def test_history_segment_lint_flags_each_contract_break(tmp_path):
+    import json
+
+    from paddle_tpu.tools.metrics_lint import lint_history_segments
+
+    ok = {"t": 1.0, "series": [
+        {"name": "a/b", "labels": {"p": "w0"}, "field": "value", "v": 1}]}
+    (tmp_path / "history_1_00001.jsonl").write_text(
+        json.dumps(ok) + "\n"
+        + "not json\n"                                      # torn? no: mid
+        + json.dumps({"t": 0.5, "series": []}) + "\n"       # t backwards
+        + json.dumps({"t": 2.0, "series": [
+            {"name": "has-dash", "field": "value", "v": 1},
+            {"name": "a/b", "field": "p17", "v": 1},
+            {"name": "a/b", "field": "p99", "v": "x"},
+            {"name": "a/b", "field": "p50", "v": 1,
+             "labels": {"p": 3}}]}) + "\n")
+    problems = lint_history_segments(str(tmp_path))
+    assert any("not valid JSON" in p for p in problems)
+    assert any("backwards" in p for p in problems)
+    assert any("has-dash" in p for p in problems)
+    assert any("p17" in p for p in problems)
+    assert any("non-numeric" in p for p in problems)
+    assert any("str->str" in p for p in problems)
+    # a torn FINAL line of the NEWEST segment is the crash contract
+    (tmp_path / "history_1_00002.jsonl").write_text(
+        json.dumps(ok) + "\n" + '{"t": 3.0, "ser')
+    assert not any("00002" in p
+                   for p in lint_history_segments(str(tmp_path)))
+
+
+def test_cli_history_mode(tmp_path, capsys):
+    import json
+
+    (tmp_path / "history_1_00001.jsonl").write_text(
+        json.dumps({"t": 1.0, "series": []}) + "\n")
+    assert main(["--history", str(tmp_path)]) == 0
+    assert "history segments clean" in capsys.readouterr().out
+    (tmp_path / "history_1_00001.jsonl").write_text("garbage\ngarbage\n")
+    assert main(["--history", str(tmp_path)]) == 1
+
+
 def test_cli_exit_codes(tmp_path, capsys):
     assert main([str(tmp_path)]) == 0
     assert "clean" in capsys.readouterr().out

@@ -48,14 +48,16 @@ def _emitted_extra_keys():
 
 
 def _gated_flat_names():
-    """Flat extra-dict keys covered by METRICS (gated scalars) and
-    INVARIANTS (exact-match fields like hbm_plan.fits)."""
+    """Flat extra-dict keys covered by METRICS (gated scalars),
+    INVARIANTS (exact-match fields like hbm_plan.fits), and
+    PRESENCE_INVARIANTS (must-stay-absent payloads like *_oom_plan)."""
     names = set()
     for entry in perf_gate.METRICS:
         name = entry[0]
         if name.startswith("extra."):
             names.add(name[len("extra."):].split(".")[0])
-    for name in perf_gate.INVARIANTS:
+    for name in (list(perf_gate.INVARIANTS)
+                 + list(perf_gate.PRESENCE_INVARIANTS)):
         if name.startswith("extra."):
             names.add(name[len("extra."):].split(".")[0])
     return names
@@ -93,3 +95,38 @@ def test_campaign_metrics_present():
                 "extra.ring_attn_hbm_plan.fits",
                 "extra.dygraph_hbm_plan.fits"):
         assert inv in perf_gate.INVARIANTS, inv
+
+
+def test_observability_loop_metrics_present():
+    """The PR 17/20 observability chaos cells are gated, not
+    diagnostics: the page-fire latencies are scalars with margins, the
+    root-cause verdicts are invariants, and the *_oom_plan payloads are
+    presence invariants (emitting one after a clean baseline IS the
+    regression)."""
+    names = {m[0] for m in perf_gate.METRICS}
+    for required in ("extra.slo_alerting.avail_fire_after_kill_ms",
+                     "extra.slo_alerting.stale_fire_after_kill_ms",
+                     "extra.root_cause.page_fire_after_fault_ms"):
+        assert required in names, required
+    for inv in ("extra.root_cause.culprit_named",
+                "extra.root_cause.history_under_cap"):
+        assert inv in perf_gate.INVARIANTS, inv
+    for pres in ("extra.nmt_big_oom_plan", "extra.ring_attn_oom_plan",
+                 "extra.dygraph_oom_plan"):
+        assert pres in perf_gate.PRESENCE_INVARIANTS, pres
+
+
+def test_presence_invariant_semantics():
+    """clean->payload is a regression; payload->payload and
+    clean->clean are not."""
+    base = {"extra": {}}
+    fresh = {"extra": {"nmt_big_oom_plan": {"fits": False}}}
+    rep = perf_gate.compare(fresh, base)
+    assert any(r["path"] == "extra.nmt_big_oom_plan"
+               for r in rep["regressions"])
+    rep2 = perf_gate.compare(fresh, fresh)
+    assert not any(r["path"] == "extra.nmt_big_oom_plan"
+                   for r in rep2["regressions"])
+    rep3 = perf_gate.compare(base, base)
+    assert not any(r["path"] == "extra.nmt_big_oom_plan"
+                   for r in rep3["regressions"])

@@ -1,0 +1,321 @@
+"""Continuous profiling & root-cause loop (ISSUE 20): the MetricsHistory
+ring TSDB under concurrent write/read load with a tracemalloc-audited
+memory bound, the ``/history`` HTTP endpoint, and the ProfileTrigger's
+gating semantics (kill switch, busy, cooldown, hourly cap, bounded
+window) against a stubbed profiler backend — no JAX tracing involved.
+"""
+import json
+import os
+import threading
+import time
+import tracemalloc
+
+import pytest
+
+import paddle_tpu as fluid  # noqa: F401  (backend init)
+from paddle_tpu.observability.history import (MetricsHistory, get_history,
+                                              install_history)
+from paddle_tpu.observability.profile_trigger import ProfileTrigger
+from paddle_tpu.observability.registry import Registry
+
+
+def sweep_doc(t, series, process="w0", role="worker", shard=None):
+    tgt = {"ok": True, "process": process, "role": role, "series": series}
+    if shard is not None:
+        tgt["shard"] = shard
+    return {"t": t, "targets": [tgt]}
+
+
+def g(name, value, **labels):
+    return {"name": name, "type": "gauge", "labels": labels,
+            "value": float(value)}
+
+
+def summ(name, **fields):
+    return {"name": name, "type": "summary", "labels": {},
+            "summary": dict(fields)}
+
+
+# -- MetricsHistory ---------------------------------------------------------
+
+def test_history_records_and_windows():
+    h = MetricsHistory(raw_points=64, max_mb=4, registry=Registry())
+    t0 = 1000.0
+    for i in range(30):
+        h.observe_sweep(sweep_doc(t0 + i, [
+            g("steps/wall_ms_gauge", i),
+            summ("ps/shard_pull_ms", p50=1.0 + i, p99=5.0 + i, count=i),
+        ]))
+    series = h.query(prefix="ps/")
+    fields = {s["field"] for s in series}
+    assert fields == {"p50", "p99", "count"}
+    # the scrape-target labels ride along
+    assert all(s["labels"]["process"] == "w0" for s in series)
+    pts = [s for s in series if s["field"] == "p99"][0]["points"]
+    assert [p[1] for p in pts] == [5.0 + i for i in range(30)]
+    # a window centred mid-run covers only its half-width
+    win = h.window(t0 + 15, half_width_s=5)
+    for s in win["series"]:
+        for t, _ in s["points"]:
+            assert t0 + 10 <= t <= t0 + 20
+    with pytest.raises(ValueError):
+        h.query(tier="bogus")
+
+
+def test_history_series_own_labels_beat_target_labels():
+    h = MetricsHistory(registry=Registry())
+    h.observe_sweep(sweep_doc(1.0, [
+        g("autoscale/queue_depth", 7, process="trainer-3")]))
+    s = h.query(prefix="autoscale/")[0]
+    assert s["labels"]["process"] == "trainer-3"
+
+
+def test_history_concurrent_sweeps_and_queries_stay_under_cap():
+    """Writers hammer observe_sweep while readers query: no exceptions,
+    no torn reads (points stay time-ordered), the byte estimate honors
+    the cap, and REAL memory (tracemalloc) stays within a small
+    multiple of that estimate."""
+    cap_mb = 1.0
+    reg = Registry()
+    tracemalloc.start()
+    try:
+        before, _ = tracemalloc.get_traced_memory()
+        h = MetricsHistory(raw_points=256, max_mb=cap_mb,
+                           max_series=512, registry=reg)
+        errors = []
+        stop = threading.Event()
+
+        def writer(wid):
+            try:
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    h.observe_sweep(sweep_doc(
+                        time.time(),
+                        [g(f"load/sig_{wid}_{i % 40}", i)]
+                        + [summ("load/lat_ms", p50=i, p99=2 * i,
+                                count=i)],
+                        process=f"w{wid}"))
+            except Exception as e:  # pragma: no cover
+                errors.append(f"writer: {type(e).__name__}: {e}")
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    for s in h.query(prefix="load/", max_points=128):
+                        ts = [p[0] for p in s["points"]]
+                        assert ts == sorted(ts), "torn read"
+                    h.stats()
+            except Exception as e:  # pragma: no cover
+                errors.append(f"reader: {type(e).__name__}: {e}")
+
+        threads = ([threading.Thread(target=writer, args=(i,))
+                    for i in range(3)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors
+        st = h.stats()
+        assert st["sweeps"] > 50, "writers barely ran"
+        assert 0 < st["est_bytes"] <= h.max_bytes
+        current, _ = tracemalloc.get_traced_memory()
+        actual = current - before
+        # the estimate is intentionally conservative; real usage must
+        # not dwarf it (that would make the cap meaningless)
+        assert actual < 6 * h.max_bytes, (
+            f"history holds ~{actual} real bytes against a "
+            f"{h.max_bytes} cap (est {st['est_bytes']})")
+    finally:
+        tracemalloc.stop()
+
+
+def test_history_evicts_oldest_series_first():
+    # max_series clamps to a floor of 16
+    h = MetricsHistory(raw_points=16, max_mb=4, max_series=16,
+                       registry=Registry())
+    for i in range(40):
+        h.observe_sweep(sweep_doc(float(i), [g(f"n/s{i}", i)]))
+    names = {s["name"] for s in h.query()}
+    assert len(names) <= 16
+    assert "n/s39" in names and "n/s0" not in names
+
+
+def test_history_jsonl_spill_rotates_and_lints(tmp_path, monkeypatch):
+    monkeypatch.setenv("PDTPU_HISTORY_SEGMENT_MB", "0.001")  # ~1 KB
+    monkeypatch.setenv("PDTPU_HISTORY_MAX_SEGMENTS", "3")
+    h = MetricsHistory(raw_points=32, spill_dir=str(tmp_path),
+                       registry=Registry())
+    for i in range(200):
+        h.observe_sweep(sweep_doc(float(i), [
+            g("spill/a", i), summ("spill/b", p50=i, p99=i, count=i)]))
+    h.stop()
+    segs = sorted(p for p in os.listdir(tmp_path)
+                  if p.endswith(".jsonl"))
+    assert 1 <= len(segs) <= 3, segs
+    from paddle_tpu.tools.metrics_lint import lint_history_segments
+    assert lint_history_segments(str(tmp_path)) == []
+    # every line replays as a sweep (the postmortem's offline path)
+    from paddle_tpu.tools.postmortem import load_history_segments
+    sweeps = load_history_segments(str(tmp_path))
+    assert sweeps and all("t" in d and "series" in d for d in sweeps)
+
+
+# -- /history endpoint ------------------------------------------------------
+
+def _http_get(url):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def introspection():
+    from paddle_tpu.observability import http as ihttp
+    srv = ihttp.IntrospectionServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+def test_history_endpoint(introspection):
+    code, _ = _http_get(introspection.url + "/history")
+    assert code == 404  # nothing installed yet
+    h = MetricsHistory(registry=Registry())
+    install_history(h)
+    try:
+        now = time.time()
+        for i in range(5):
+            h.observe_sweep(sweep_doc(now - 4 + i, [g("ep/x", i)]))
+        code, body = _http_get(introspection.url
+                               + "/history?prefix=ep/&window=60")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["stats"]["sweeps"] == 5
+        (s,) = doc["series"]
+        assert s["name"] == "ep/x" and len(s["points"]) == 5
+        code, _ = _http_get(introspection.url + "/history?tier=bogus")
+        assert code == 400
+    finally:
+        install_history(None)
+    assert get_history() is None
+
+
+# -- ProfileTrigger gating --------------------------------------------------
+
+class StubProfiler:
+    """Records start/stop; optionally blocks stop until released."""
+
+    def __init__(self):
+        self.starts = []
+        self.stops = 0
+
+    def start(self, logdir):
+        self.starts.append(logdir)
+
+    def stop(self):
+        self.stops += 1
+
+
+def mk_trigger(**kw):
+    reg = Registry()
+    prof = StubProfiler()
+    kw.setdefault("window_steps", 2)
+    kw.setdefault("window_s", 0.2)   # stub writes no trace: self-close
+    kw.setdefault("cooldown_s", 60.0)
+    kw.setdefault("max_captures_per_h", 12)
+    trig = ProfileTrigger(profiler=prof, registry=reg, **kw)
+    return reg, prof, trig
+
+
+def skipped(reg, reason):
+    return reg.counter("profiler/skipped", reason=reason).value
+
+
+def test_trigger_kill_switch(monkeypatch):
+    reg, prof, trig = mk_trigger()
+    monkeypatch.setenv("PDTPU_PROFILE_ON_ANOMALY", "0")
+    assert trig.arm("slow_step") is None
+    assert not prof.starts
+    assert skipped(reg, "disabled") == 1
+    monkeypatch.setenv("PDTPU_PROFILE_ON_ANOMALY", "1")
+    t = trig.arm("slow_step")
+    assert t is not None
+    trig.wait_idle(5)
+
+
+def test_trigger_busy_and_window_close_on_steps(monkeypatch):
+    monkeypatch.setenv("PDTPU_PROFILE_ON_ANOMALY", "1")
+    reg, prof, trig = mk_trigger(window_s=30.0)   # only steps close it
+    t = trig.arm("slow_step")
+    assert t is not None
+    # a second arm while capturing is a busy skip, not a second trace
+    assert trig.arm("slow_step") is None
+    assert skipped(reg, "busy") == 1
+    deadline = time.time() + 5
+    while not prof.starts and time.time() < deadline:
+        time.sleep(0.01)   # profiler.start happens on the capture thread
+    assert len(prof.starts) == 1
+    # window_steps=2 records close the window and stop the profiler
+    trig.on_record({"step": 1})
+    trig.on_record({"step": 2})
+    t.join(timeout=10)
+    assert not t.is_alive(), "capture did not close on step records"
+    assert prof.stops == 1
+    assert trig.wait_idle(5)
+    # the stub wrote no trace: the attribution error is surfaced, the
+    # trigger is reusable
+    att = trig.last_attribution()
+    assert att["trigger"] == "slow_step" and "error" in att
+
+
+def test_trigger_cooldown_and_hourly_cap(monkeypatch):
+    monkeypatch.setenv("PDTPU_PROFILE_ON_ANOMALY", "1")
+    reg, prof, trig = mk_trigger(cooldown_s=3600.0)
+    t = trig.arm("slow_step")
+    t.join(timeout=10)
+    assert trig.arm("slow_step") is None
+    assert skipped(reg, "cooldown") == 1
+
+    reg2, prof2, trig2 = mk_trigger(cooldown_s=0.0, max_captures_per_h=2)
+    for _ in range(2):
+        th = trig2.arm("recompile")
+        assert th is not None
+        th.join(timeout=10)
+    assert trig2.arm("recompile") is None
+    assert skipped(reg2, "cap") == 1
+    assert len(prof2.starts) == 2
+
+
+def test_trigger_anomaly_listener_arms_and_page_enrichment_falls_back(
+        monkeypatch):
+    """on_anomaly arms a capture; enrich_alert blocks for it and ships
+    whatever attribution exists (here: a monkeypatched one, since the
+    stub writes no real trace). warn-severity alerts are never
+    enriched."""
+    monkeypatch.setenv("PDTPU_PROFILE_ON_ANOMALY", "1")
+    # the long cooldown gates enrich_alert's own re-arm, so it must
+    # fall back to the anomaly-armed attribution
+    reg, prof, trig = mk_trigger(cooldown_s=3600.0)
+    trig._attribute = lambda logdir, t: {
+        "culprit_kernels": [{"kernel": "dot.3", "why": "test"}]}
+    trig.on_anomaly({"step": 9, "t": time.time()}, "slow_step")
+    trig.on_record({"step": 10})
+    trig.on_record({"step": 11})
+    assert trig.wait_idle(10)
+
+    class FakeAlert:
+        name = "StepAnomalyRatio"
+        severity = "page"
+
+    ann = trig.enrich_alert(FakeAlert())
+    assert ann["culprit_kernels"][0]["kernel"] == "dot.3"
+    assert ann["attribution_trigger"] == "slow_step"
+    FakeAlert.severity = "warn"
+    assert trig.enrich_alert(FakeAlert()) is None

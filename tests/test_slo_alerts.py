@@ -80,7 +80,8 @@ def test_default_slos_cover_the_stack():
     assert set(specs) == {
         "PsShardAvailability", "PsPullLatency", "ServingAvailability",
         "ServingTenantLatency", "ServingTenantAvailability",
-        "DeltaStaleness"}
+        "DeltaStaleness", "StepAnomalyRatio"}
+    assert specs["StepAnomalyRatio"].total_metric == "steps/total"
     assert specs["PsShardAvailability"].group_by == "shard"
     assert specs["ServingTenantLatency"].group_by == "tenant"
     assert specs["ServingTenantLatency"].field == "p99"
